@@ -1,0 +1,24 @@
+"""dmlc_tpu: a TPU-native data & distributed-substrate framework.
+
+A from-scratch rebuild of the capabilities of crazy-cat/dmlc-core
+(reference at /root/reference), designed TPU-first:
+
+  - portable Stream/filesystem layer with pluggable protocols  (io/)
+  - bit-exact splittable RecordIO format                        (io/recordio)
+  - partitioned record ingestion with threaded prefetch         (io/input_split)
+  - sparse RowBlock data structures + LibSVM/CSV/LibFM parsers  (data/)
+  - typed Parameter / Registry / Config systems                 (param, registry, config)
+  - binary serialization wire-compatible with dmlc::Stream      (serializer)
+  - sharded host->HBM feeds over jax.sharding meshes            (tpu/)
+  - XLA collective surface (psum/all_gather/... over ICI/DCN)   (tpu/collective)
+  - sequence/context-parallel ring primitives                   (parallel/)
+  - distributed job launcher + rank rendezvous tracker          (tracker/)
+"""
+
+__version__ = "0.1.0"
+
+from . import base, common, concurrency, config, param, registry, serializer  # noqa: F401
+from .base import DMLCError, ParamError, get_env  # noqa: F401
+from .config import Config  # noqa: F401
+from .param import Parameter, field  # noqa: F401
+from .registry import Registry  # noqa: F401

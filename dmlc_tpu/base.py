@@ -1,0 +1,109 @@
+"""Core error type, check macros, and typed environment access.
+
+TPU-native rebuild of the reference's L0 layer:
+  - dmlc::Error / CHECK / LOG        (reference: include/dmlc/logging.h:26-155)
+  - GetEnv<T>                        (reference: include/dmlc/parameter.h:1026-1036)
+  - feature flags                    (reference: include/dmlc/base.h:50-121)
+
+Unlike the reference (preprocessor macros), checks here are plain functions —
+idiomatic Python — but they preserve the contract: a failed check raises
+``DMLCError`` (the analog of ``dmlc::Error`` thrown under
+``DMLC_LOG_FATAL_THROW=1``) carrying the formatted message.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional, Type, TypeVar, Union
+
+__all__ = [
+    "DMLCError",
+    "ParamError",
+    "check",
+    "check_eq",
+    "check_ne",
+    "check_lt",
+    "check_le",
+    "check_gt",
+    "check_ge",
+    "check_notnone",
+    "get_env",
+]
+
+
+class DMLCError(RuntimeError):
+    """Exception for all fatal checks (analog of ``dmlc::Error``, logging.h:26)."""
+
+
+class ParamError(ValueError, DMLCError):
+    """Raised on invalid parameter values (analog of ``dmlc::ParamError``,
+    parameter.h:89)."""
+
+
+def check(cond: Any, msg: Union[str, Callable[[], str]] = "") -> None:
+    """Analog of ``CHECK(cond) << msg`` (logging.h:104). Raises DMLCError."""
+    if not cond:
+        text = msg() if callable(msg) else str(msg)
+        raise DMLCError(f"Check failed: {text}")
+
+
+def _binary_check(op_name: str, ok: bool, x: Any, y: Any, msg: str) -> None:
+    if not ok:
+        raise DMLCError(f"Check failed: {x!r} {op_name} {y!r} {msg}")
+
+
+def check_eq(x: Any, y: Any, msg: str = "") -> None:
+    _binary_check("==", x == y, x, y, msg)
+
+
+def check_ne(x: Any, y: Any, msg: str = "") -> None:
+    _binary_check("!=", x != y, x, y, msg)
+
+
+def check_lt(x: Any, y: Any, msg: str = "") -> None:
+    _binary_check("<", x < y, x, y, msg)
+
+
+def check_le(x: Any, y: Any, msg: str = "") -> None:
+    _binary_check("<=", x <= y, x, y, msg)
+
+
+def check_gt(x: Any, y: Any, msg: str = "") -> None:
+    _binary_check(">", x > y, x, y, msg)
+
+
+def check_ge(x: Any, y: Any, msg: str = "") -> None:
+    _binary_check(">=", x >= y, x, y, msg)
+
+
+def check_notnone(x: Any, msg: str = "") -> Any:
+    if x is None:
+        raise DMLCError(f"Check failed: value is None {msg}")
+    return x
+
+
+_T = TypeVar("_T")
+
+_BOOL_TRUE = {"1", "true", "yes", "on"}
+_BOOL_FALSE = {"0", "false", "no", "off", ""}
+
+
+def get_env(key: str, default: _T, ty: Optional[Type[_T]] = None) -> _T:
+    """Typed environment lookup (analog of ``dmlc::GetEnv<T>``,
+    parameter.h:1026-1036). The type is inferred from ``default`` unless
+    ``ty`` is given explicitly."""
+    val = os.environ.get(key)
+    if val is None:
+        return default
+    ty = ty or type(default)
+    if ty is bool:
+        low = val.strip().lower()
+        if low in _BOOL_TRUE:
+            return True  # type: ignore[return-value]
+        if low in _BOOL_FALSE:
+            return False  # type: ignore[return-value]
+        raise ParamError(f"cannot parse env {key}={val!r} as bool")
+    try:
+        return ty(val)  # type: ignore[call-arg]
+    except (TypeError, ValueError) as exc:
+        raise ParamError(f"cannot parse env {key}={val!r} as {ty.__name__}") from exc

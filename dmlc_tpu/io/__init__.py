@@ -1,0 +1,18 @@
+"""IO layer: streams, URIs, virtual filesystems, RecordIO, input splits."""
+
+from .stream import (  # noqa: F401
+    FileStream,
+    MemoryBytesStream,
+    MemoryFixedSizeStream,
+    SeekStream,
+    Serializable,
+    Stream,
+)
+from .uri import URI, URISpec  # noqa: F401
+from .filesys import FileInfo, FileSystem, register_filesystem  # noqa: F401
+from .recordio import (  # noqa: F401
+    KMAGIC,
+    RecordIOChunkReader,
+    RecordIOReader,
+    RecordIOWriter,
+)

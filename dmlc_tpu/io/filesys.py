@@ -1,0 +1,112 @@
+"""Virtual filesystem: FileInfo, FileSystem ABC, and protocol dispatch.
+
+Rebuild of reference src/io/filesys.h:54-125 (FileInfo/FileSystem) and the
+protocol->singleton dispatch in src/io.cc:31-60. Protocols are pluggable via
+:func:`register_filesystem`; unknown protocols raise, matching the
+"compile with DMLC_USE_X=1" FATAL of the reference.
+
+TPU-native mapping (SURVEY.md §2.4): local + GCS play the roles of the
+reference's local + S3; HDFS/Azure are optional and absent here by default,
+but the dispatch architecture makes them drop-in.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..base import DMLCError
+from .stream import SeekStream, Stream
+from .uri import URI
+
+__all__ = ["FileInfo", "FileSystem", "register_filesystem"]
+
+
+@dataclass
+class FileInfo:
+    """path + size + type (filesys.h:54-72)."""
+
+    path: URI = field(default_factory=lambda: URI(""))
+    size: int = 0
+    type: str = "file"  # 'file' | 'directory'
+
+
+class FileSystem(abc.ABC):
+    """Abstract filesystem (filesys.h:75-125)."""
+
+    @abc.abstractmethod
+    def get_path_info(self, path: URI) -> FileInfo: ...
+
+    @abc.abstractmethod
+    def list_directory(self, path: URI) -> List[FileInfo]: ...
+
+    def list_directory_recursive(self, path: URI) -> List[FileInfo]:
+        """Default recursive walk built on list_directory (filesys.h:96-108)."""
+        out: List[FileInfo] = []
+        stack = [path]
+        while stack:
+            p = stack.pop()
+            for info in self.list_directory(p):
+                if info.type == "directory":
+                    stack.append(info.path)
+                else:
+                    out.append(info)
+        return out
+
+    @abc.abstractmethod
+    def open(self, path: URI, mode: str, allow_null: bool = False) -> Optional[Stream]: ...
+
+    @abc.abstractmethod
+    def open_for_read(self, path: URI, allow_null: bool = False) -> Optional[SeekStream]: ...
+
+    # ---- dispatch (io.cc:31-60) ----------------------------------------
+    _registry: Dict[str, Callable[[URI], "FileSystem"]] = {}
+    _instances: Dict[str, "FileSystem"] = {}
+
+    @staticmethod
+    def get_instance(path: URI) -> "FileSystem":
+        proto = path.protocol
+        key = proto + path.host  # per-host singletons for bucket/namenode FSes
+        inst = FileSystem._instances.get(key)
+        if inst is not None:
+            return inst
+        factory = FileSystem._registry.get(proto)
+        if factory is None:
+            raise DMLCError(
+                f"unknown filesystem protocol {proto!r}; registered: "
+                f"{sorted(FileSystem._registry)}"
+            )
+        inst = factory(path)
+        FileSystem._instances[key] = inst
+        return inst
+
+
+def register_filesystem(protocol: str, factory: Callable[[URI], FileSystem]) -> None:
+    """Register a protocol (e.g. 'gs://') -> FileSystem factory."""
+    FileSystem._registry[protocol] = factory
+
+
+# built-in registrations
+def _init_builtin() -> None:
+    from .local_filesys import LocalFileSystem
+
+    local = lambda _uri: LocalFileSystem()  # noqa: E731
+    register_filesystem("file://", local)
+
+    try:
+        from .http_filesys import HTTPFileSystem
+
+        register_filesystem("http://", lambda u: HTTPFileSystem())
+        register_filesystem("https://", lambda u: HTTPFileSystem())
+    except ImportError:  # optional backend not present
+        pass
+    try:
+        from .gcs_filesys import GCSFileSystem
+
+        register_filesystem("gs://", lambda u: GCSFileSystem())
+    except ImportError:  # optional backend not present
+        pass
+
+
+_init_builtin()

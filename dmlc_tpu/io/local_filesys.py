@@ -1,0 +1,63 @@
+"""Local filesystem backend.
+
+Rebuild of reference src/io/local_filesys.{h,cc}: stat/opendir listing
+(local_filesys.cc:28-90), FILE*-backed streams (:92-172), and the
+stdin/stdout special paths.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Optional
+
+from ..base import DMLCError
+from .filesys import FileInfo, FileSystem
+from .stream import FileStream, SeekStream, Stream
+from .uri import URI
+
+__all__ = ["LocalFileSystem"]
+
+
+class LocalFileSystem(FileSystem):
+    def get_path_info(self, path: URI) -> FileInfo:
+        st = os.stat(path.name)
+        return FileInfo(
+            path=path,
+            size=st.st_size,
+            type="directory" if os.path.isdir(path.name) else "file",
+        )
+
+    def list_directory(self, path: URI) -> List[FileInfo]:
+        out: List[FileInfo] = []
+        for entry in sorted(os.listdir(path.name)):
+            full = os.path.join(path.name, entry)
+            u = URI(path.protocol + path.host + full)
+            st = os.stat(full)
+            out.append(
+                FileInfo(
+                    path=u,
+                    size=st.st_size,
+                    type="directory" if os.path.isdir(full) else "file",
+                )
+            )
+        return out
+
+    def open(self, path: URI, mode: str, allow_null: bool = False) -> Optional[Stream]:
+        # stdin/stdout special paths (local_filesys.cc:100-109)
+        if path.name == "stdin":
+            return FileStream(sys.stdin.buffer, own=False)
+        if path.name == "stdout":
+            return FileStream(sys.stdout.buffer, own=False)
+        binmode = mode if "b" in mode else mode + "b"
+        try:
+            f = open(path.name, binmode)
+        except OSError as exc:
+            if allow_null:
+                return None
+            raise DMLCError(f"LocalFileSystem.open {path.name!r}: {exc}") from exc
+        return FileStream(f)
+
+    def open_for_read(self, path: URI, allow_null: bool = False) -> Optional[SeekStream]:
+        strm = self.open(path, "r", allow_null=allow_null)
+        return strm  # FileStream is a SeekStream

@@ -1,0 +1,71 @@
+"""glog-style leveled logging with a pluggable sink.
+
+Rebuild of reference include/dmlc/logging.h:104-155 (LOG(severity) macros) and
+the ``CustomLogMessage`` pluggable sink (logging.h:233-252). Severity FATAL
+raises :class:`dmlc_tpu.base.DMLCError` (the ``DMLC_LOG_FATAL_THROW=1``
+behavior the reference defaults to for library use).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+import threading
+from typing import Callable, Optional
+
+from .base import DMLCError
+
+__all__ = ["log", "info", "warning", "error", "fatal", "set_log_sink", "set_verbosity"]
+
+_LEVELS = {"DEBUG": 0, "INFO": 1, "WARNING": 2, "ERROR": 3, "FATAL": 4}
+_lock = threading.Lock()
+_sink: Optional[Callable[[str], None]] = None
+_verbosity = 1  # default: INFO and above
+
+
+def set_log_sink(sink: Optional[Callable[[str], None]]) -> None:
+    """Install a custom sink receiving fully-formatted lines (analog of
+    ``CustomLogMessage::Log``, logging.h:233-252). ``None`` restores stderr."""
+    global _sink
+    _sink = sink
+
+
+def set_verbosity(level: str) -> None:
+    global _verbosity
+    _verbosity = _LEVELS[level.upper()]
+
+
+def _format(level: str, msg: str) -> str:
+    ts = time.strftime("%H:%M:%S")
+    return f"[{ts}] {level}: {msg}"
+
+
+def log(level: str, msg: str) -> None:
+    level = level.upper()
+    if level == "FATAL":
+        raise DMLCError(msg)
+    if _LEVELS[level] < _verbosity:
+        return
+    line = _format(level, msg)
+    with _lock:
+        if _sink is not None:
+            _sink(line)
+        else:
+            print(line, file=sys.stderr, flush=True)
+
+
+def info(msg: str) -> None:
+    log("INFO", msg)
+
+
+def warning(msg: str) -> None:
+    log("WARNING", msg)
+
+
+def error(msg: str) -> None:
+    log("ERROR", msg)
+
+
+def fatal(msg: str) -> None:
+    """Raises DMLCError (DMLC_LOG_FATAL_THROW behavior, base.h:20-22)."""
+    raise DMLCError(msg)

@@ -1,0 +1,17 @@
+"""Test configuration: force a deterministic 8-device virtual CPU mesh.
+
+Multi-chip sharding is validated on a virtual CPU mesh
+(xla_force_host_platform_device_count), per the TPU-rebuild test strategy;
+real-chip benchmarks live in bench.py, not tests.
+"""
+
+import os
+
+# must be set before jax is imported anywhere in the test session
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,166 @@
+"""Parameter/Config/Registry tests (mirror reference unittest_param.cc,
+unittest_config.cc, test/registry_test.cc)."""
+
+import pytest
+
+from dmlc_tpu import Config, DMLCError, ParamError, Parameter, Registry, field
+from dmlc_tpu.base import get_env
+from dmlc_tpu.io.stream import MemoryBytesStream
+from dmlc_tpu.param import ParamInitOption
+
+
+class LearningParam(Parameter):
+    float_param = field(float, 0.01).set_range(0.0, 1.0).set_describe("a float")
+    int_param = field(int, 5).set_lower_bound(0)
+    name = field(str, "sgd")
+    opt = field(str, "adam").add_enum("adam").add_enum("sgd").add_alias("optimizer")
+    flag = field(bool, False)
+
+
+def test_defaults():
+    p = LearningParam()
+    assert p.float_param == 0.01 and p.int_param == 5 and p.opt == "adam"
+
+
+def test_init_kwargs_with_string_coercion():
+    p = LearningParam()
+    p.init({"float_param": "0.5", "int_param": "7", "flag": "true"})
+    assert p.float_param == 0.5 and p.int_param == 7 and p.flag is True
+
+
+def test_out_of_range_raises():
+    # mirrors unittest_param.cc:9-21 (float out of range -> ParamError)
+    p = LearningParam()
+    with pytest.raises(ParamError, match="float_param"):
+        p.init({"float_param": "2.5"})
+    with pytest.raises(ParamError, match="int_param"):
+        p.init({"int_param": -1})
+
+
+def test_bad_type_raises():
+    with pytest.raises(ParamError):
+        LearningParam().init({"int_param": "not_an_int"})
+
+
+def test_enum_and_alias():
+    p = LearningParam()
+    p.init({"optimizer": "sgd"})
+    assert p.opt == "sgd"
+    with pytest.raises(ParamError, match="opt"):
+        p.init({"opt": "rmsprop"})
+
+
+def test_unknown_key_policies():
+    p = LearningParam()
+    unknown = p.init({"mystery": 1}, ParamInitOption.ALLOW_UNKNOWN)
+    assert unknown == {"mystery": 1}
+    with pytest.raises(ParamError, match="mystery"):
+        p.init({"mystery": 1}, ParamInitOption.ALL_MATCH)
+    # hidden keys are dunder-shaped and skipped (parameter.h:399-404)
+    assert p.init({"__hidden__": 1}, ParamInitOption.ALLOW_HIDDEN) == {}
+    with pytest.raises(ParamError, match="_notdunder"):
+        p.init({"_notdunder": 1}, ParamInitOption.ALLOW_HIDDEN)
+
+
+def test_required_field():
+    class Req(Parameter):
+        must = field(int)
+
+    with pytest.raises(ParamError, match="must"):
+        Req().init({})
+    r = Req()
+    r.init({"must": 3})
+    assert r.must == 3
+
+
+def test_dict_json_roundtrip():
+    p = LearningParam()
+    p.init({"float_param": 0.25})
+    s = MemoryBytesStream()
+    p.save(s)
+    s.seek(0)
+    q = LearningParam()
+    q.load(s)
+    assert q.float_param == 0.25
+    assert set(p.to_dict()) == {"float_param", "int_param", "name", "opt", "flag"}
+
+
+def test_doc_string():
+    doc = LearningParam.doc_string()
+    assert "float_param" in doc and "range=[0.0, 1.0]" in doc and "a float" in doc
+
+
+def test_update_dict():
+    p = LearningParam()
+    kw = {"float_param": "0.125", "extra": "x"}
+    p.update_dict(kw)
+    assert kw["float_param"] == 0.125 and kw["extra"] == "x"
+
+
+def test_get_env(monkeypatch):
+    monkeypatch.setenv("DMLC_TEST_ENV_I", "42")
+    monkeypatch.setenv("DMLC_TEST_ENV_B", "true")
+    assert get_env("DMLC_TEST_ENV_I", 0) == 42
+    assert get_env("DMLC_TEST_ENV_B", False) is True
+    assert get_env("DMLC_TEST_ENV_MISSING", 7) == 7
+
+
+# ---- Config (unittest_config.cc:115) -----------------------------------
+
+def test_config_basic():
+    cfg = Config("k1 = v1\n# comment\nk2=3.5\n\nk3 = \"quoted # not comment\"\n")
+    assert cfg.get_param("k1") == "v1"
+    assert cfg.get_param("k2") == "3.5"
+    assert cfg.get_param("k3") == "quoted # not comment"
+    assert "k4" not in cfg
+
+
+def test_config_trailing_comment_and_override():
+    cfg = Config("a = 1 # one\na = 2\n")
+    assert cfg.get_param("a") == "2"
+    assert cfg.items() == [("a", "2")]
+
+
+def test_config_multi_value():
+    cfg = Config("a=1\na=2\n", multi_value=True)
+    assert cfg.get_all("a") == ["1", "2"]
+    assert cfg.items() == [("a", "1"), ("a", "2")]
+
+
+def test_config_proto_string():
+    cfg = Config('x = a"b\n')
+    assert cfg.to_proto_string() == 'x : "a\\"b"\n'
+
+
+def test_config_bad_line():
+    with pytest.raises(DMLCError):
+        Config("not_a_kv_line\n")
+
+
+# ---- Registry ----------------------------------------------------------
+
+def test_registry_register_find_alias():
+    reg = Registry.get("test_kind_a")
+
+    @reg.register("tree")
+    def make_tree(depth=3):
+        return ("tree", depth)
+
+    reg.entry("tree").describe("a tree factory").add_argument("depth", "int", "max depth")
+    reg.add_alias("tree", "gbtree")
+    assert reg.create("tree", depth=5) == ("tree", 5)
+    assert reg.create("gbtree") == ("tree", 3)
+    assert reg.find("nope") is None
+    assert reg.list_all_names() == ["gbtree", "tree"]
+    assert reg.entry("tree").description == "a tree factory"
+
+
+def test_registry_duplicate_and_unknown():
+    reg = Registry.get("test_kind_b")
+    reg.register("x", lambda: 1)
+    with pytest.raises(DMLCError):
+        reg.register("x", lambda: 2)
+    reg.register("x", lambda: 2, override=True)
+    assert reg.create("x") == 2
+    with pytest.raises(DMLCError, match="unknown"):
+        reg.create("zzz")

@@ -1,0 +1,100 @@
+"""Serializer round trips (mirrors reference test/unittest/unittest_serializer.cc)."""
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.io.stream import MemoryBytesStream, MemoryFixedSizeStream
+from dmlc_tpu import serializer as ser
+from dmlc_tpu.base import DMLCError
+
+
+def roundtrip(value, spec, factory=None):
+    s = MemoryBytesStream()
+    ser.write(s, value, spec)
+    s.seek(0)
+    return ser.read(s, spec, factory)
+
+
+def test_scalars():
+    assert roundtrip(42, "i32") == 42
+    assert roundtrip(-7, "i64") == -7
+    assert roundtrip(2**63 - 1, "i64") == 2**63 - 1
+    assert roundtrip(3.5, "f32") == 3.5
+    assert roundtrip(True, "bool") is True
+
+
+def test_string_and_bytes():
+    assert roundtrip("héllo wörld", "str") == "héllo wörld"
+    assert roundtrip(b"\x00\xff\x01", "bytes") == b"\x00\xff\x01"
+
+
+def test_pod_vector_fast_path():
+    v = np.arange(1000, dtype=np.float32)
+    out = roundtrip(v, ("vec", "f32"))
+    np.testing.assert_array_equal(v, out)
+
+
+def test_vector_of_strings():
+    v = ["a", "bb", "", "dddd"]
+    assert roundtrip(v, ("vec", "str")) == v
+
+
+def test_map_of_vectors():
+    # the exact shape used in reference call stack 3.4 (map<k, vector<v>>)
+    m = {"x": np.array([1, 2, 3], dtype=np.int32), "y": np.array([], dtype=np.int32)}
+    out = roundtrip(m, ("map", "str", ("vec", "i32")))
+    assert set(out) == {"x", "y"}
+    np.testing.assert_array_equal(out["x"], m["x"])
+    assert out["y"].size == 0
+
+
+def test_nested_composites():
+    v = [{"a": [(1, 2.5)]}, {}]
+    spec = ("vec", ("map", "str", ("vec", ("pair", "i32", "f64"))))
+    assert roundtrip(v, spec) == v
+
+
+def test_custom_saveload_class():
+    class MyObj:
+        def __init__(self, x=0, tags=None):
+            self.x = x
+            self.tags = tags or []
+
+        def save(self, strm):
+            ser.write(strm, self.x, "i32")
+            ser.write(strm, self.tags, ("vec", "str"))
+
+        def load(self, strm):
+            self.x = ser.read(strm, "i32")
+            self.tags = ser.read(strm, ("vec", "str"))
+
+    obj = MyObj(5, ["p", "q"])
+    out = roundtrip(obj, "obj", factory=MyObj)
+    assert out.x == 5 and out.tags == ["p", "q"]
+
+
+def test_wire_format_is_dmlc_compatible():
+    """uint64 little-endian length prefix + raw data (serializer.h:105-170)."""
+    s = MemoryBytesStream()
+    ser.write(s, "ab", "str")
+    raw = s.getvalue()
+    assert raw == b"\x02\x00\x00\x00\x00\x00\x00\x00ab"
+    s2 = MemoryBytesStream()
+    ser.write(s2, np.array([1], dtype=np.uint32), ("vec", "u32"))
+    assert s2.getvalue() == b"\x01\x00\x00\x00\x00\x00\x00\x00\x01\x00\x00\x00"
+
+
+def test_truncated_stream_raises():
+    s = MemoryBytesStream(b"\x08\x00\x00\x00\x00\x00\x00\x00ab")  # claims 8, has 2
+    with pytest.raises(DMLCError):
+        ser.read(s, "str")
+
+
+def test_fixed_size_stream_overflow():
+    buf = bytearray(4)
+    s = MemoryFixedSizeStream(buf)
+    s.write(b"abcd")
+    with pytest.raises(DMLCError):
+        s.write(b"e")
+    s.seek(0)
+    assert s.read(4) == b"abcd"

@@ -1,0 +1,181 @@
+"""ThreadedIter lifecycle under a racy producer (mirrors reference
+test/unittest/unittest_threaditer.cc — randomized producer delays to shake
+out races/deadlocks, BeforeFirst mid-stream)."""
+
+import random
+import time
+
+import pytest
+
+from dmlc_tpu.base import DMLCError
+from dmlc_tpu.concurrency import ConcurrentBlockingQueue, MultiThreadedIter, ThreadedIter
+
+
+class Source:
+    """Produces boxed ints 0..n-1 with random delays (unittest_threaditer.cc:9-16)."""
+
+    def __init__(self, n, seed=0, max_delay=0.002):
+        self.n = n
+        self.i = 0
+        self.rng = random.Random(seed)
+        self.max_delay = max_delay
+        self.recycled_hits = 0
+
+    def next(self, recycled):
+        if self.max_delay:
+            time.sleep(self.rng.random() * self.max_delay)
+        if self.i >= self.n:
+            return None
+        if recycled is not None:
+            self.recycled_hits += 1
+            recycled[0] = self.i
+            out = recycled
+        else:
+            out = [self.i]
+        self.i += 1
+        return out
+
+    def before_first(self):
+        self.i = 0
+
+
+def drain(it):
+    out = []
+    while True:
+        ok, v = it.next()
+        if not ok:
+            return out
+        out.append(v[0])
+        it.recycle(v)
+
+
+def test_basic_order_and_recycle():
+    src = Source(200, max_delay=0)
+    it = ThreadedIter(src.next, src.before_first, max_capacity=4)
+    assert drain(it) == list(range(200))
+    assert src.recycled_hits > 0, "free-list recycling never engaged"
+    it.destroy()
+
+
+def test_racy_producer():
+    src = Source(100, seed=42)
+    it = ThreadedIter(src.next, src.before_first, max_capacity=2)
+    assert drain(it) == list(range(100))
+    it.destroy()
+
+
+def test_before_first_mid_stream():
+    src = Source(50, max_delay=0.001)
+    it = ThreadedIter(src.next, src.before_first, max_capacity=2)
+    got = []
+    for _ in range(10):
+        ok, v = it.next()
+        assert ok
+        got.append(v[0])
+        it.recycle(v)
+    assert got == list(range(10))
+    it.before_first()
+    assert drain(it) == list(range(50))
+    it.destroy()
+
+
+def test_repeated_epochs():
+    src = Source(30, max_delay=0)
+    it = ThreadedIter(src.next, src.before_first, max_capacity=8)
+    for _ in range(5):
+        assert drain(it) == list(range(30))
+        it.before_first()
+    it.destroy()
+
+
+def test_producer_exception_propagates():
+    def bad_next(recycled):
+        raise ValueError("boom")
+
+    it = ThreadedIter(bad_next, None, max_capacity=2)
+    with pytest.raises(DMLCError, match="boom"):
+        it.next()
+    it.destroy()
+
+
+def test_destroy_while_blocked():
+    """destroy with a full queue and no consumer progress must not hang
+    (threadediter.h:236-269 destroy-while-blocked)."""
+    src = Source(10_000, max_delay=0)
+    it = ThreadedIter(src.next, src.before_first, max_capacity=2)
+    ok, v = it.next()
+    assert ok
+    start = time.time()
+    it.destroy()
+    assert time.time() - start < 5.0
+
+
+def test_concurrent_queue_fifo_and_kill():
+    q = ConcurrentBlockingQueue(max_size=4)
+    for i in range(4):
+        assert q.push(i)
+    assert q.pop() == (True, 0)
+    q.signal_for_kill()
+    assert q.push(99) is False
+    # drain remaining then fail
+    assert q.pop()[0] is True
+    assert q.pop()[0] is True
+    assert q.pop()[0] is True
+    assert q.pop() == (False, None)
+
+
+def test_concurrent_queue_priority():
+    q = ConcurrentBlockingQueue(priority=True)
+    q.push("low", priority=1)
+    q.push("high", priority=10)
+    q.push("mid", priority=5)
+    assert q.pop() == (True, "high")
+    assert q.pop() == (True, "mid")
+    assert q.pop() == (True, "low")
+
+
+def test_multithreaded_iter():
+    items = list(range(100))
+    idx = [0]
+
+    def source_next():
+        if idx[0] >= len(items):
+            return None
+        v = items[idx[0]]
+        idx[0] += 1
+        return v
+
+    mit = MultiThreadedIter(source_next, lambda x: x * 2, num_threads=3)
+    out = []
+    while True:
+        ok, v = mit.next()
+        if not ok:
+            break
+        out.append(v)
+    assert sorted(out) == [2 * i for i in range(100)]
+    # exhausted iterator keeps returning end-of-stream, never blocks
+    assert mit.next() == (False, None)
+    mit.destroy()
+
+
+def test_multithreaded_iter_worker_exception():
+    idx = [0]
+
+    def source_next():
+        if idx[0] >= 10:
+            return None
+        idx[0] += 1
+        return idx[0]
+
+    def bad_work(x):
+        if x == 5:
+            raise ValueError("worker boom")
+        return x
+
+    mit = MultiThreadedIter(source_next, bad_work, num_threads=2)
+    with pytest.raises(DMLCError, match="worker boom"):
+        while True:
+            ok, _ = mit.next()
+            if not ok:
+                break
+    mit.destroy()
