@@ -1,0 +1,169 @@
+"""Parser interfaces: streaming RowBlock producers over InputSplits.
+
+Rebuild of reference src/data/parser.h:23-126 (ParserImpl / ThreadedParser)
+and src/data/text_parser.h (TextParserBase: pull a chunk via
+InputSplit.next_chunk, parse it — the reference fans out with OpenMP across
+chunk slices; here the chunk parse itself is numpy-vectorized and a
+background thread overlaps parse with IO, with the C++ native core as the
+planned hot path).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from ..base import DMLCError, check
+from ..common import get_time
+from ..concurrency import ThreadedIter
+from ..io import input_split as isplit
+from ..io.uri import URISpec
+from ..registry import Registry
+from .row_block import RowBlock, RowBlockContainer
+
+__all__ = [
+    "Parser",
+    "TextParserBase",
+    "ThreadedParser",
+    "register_parser",
+    "create_parser",
+]
+
+
+class Parser:
+    """One-pass streaming iterator of RowBlocks (parser.h:23-50)."""
+
+    def parse_next(self) -> Optional[List[RowBlockContainer]]:
+        """Produce the next group of containers, or None at end."""
+        raise NotImplementedError
+
+    def before_first(self) -> None:
+        raise NotImplementedError
+
+    def bytes_read(self) -> int:
+        raise NotImplementedError
+
+    def __iter__(self):
+        """Iterate RowBlocks (flattening container groups)."""
+        while True:
+            group = self.parse_next()
+            if group is None:
+                return
+            for c in group:
+                if c.size:
+                    yield c.get_block()
+
+
+class TextParserBase(Parser):
+    """Chunk-pull + parse loop (text_parser.h:30-118). Subclasses implement
+    ``parse_chunk(data: bytes, out: RowBlockContainer)``."""
+
+    def __init__(self, source: isplit.InputSplit, nthread: int = 2):
+        self._source = source
+        self._bytes_read = 0
+        self._nthread = nthread
+
+    def parse_chunk(self, data: bytes, out: RowBlockContainer) -> None:
+        raise NotImplementedError
+
+    def parse_next(self) -> Optional[List[RowBlockContainer]]:
+        chunk = self._source.next_chunk()
+        if chunk is None:
+            return None
+        data = bytes(chunk)
+        self._bytes_read += len(data)
+        out = RowBlockContainer()
+        self.parse_chunk(data, out)
+        return [out]
+
+    def before_first(self) -> None:
+        self._source.before_first()
+        self._bytes_read = 0
+
+    def bytes_read(self) -> int:
+        return self._bytes_read
+
+    def close(self) -> None:
+        if hasattr(self._source, "close"):
+            self._source.close()
+
+
+class ThreadedParser(Parser):
+    """Background-thread prefetch wrapper (parser.h:75-126, capacity 8)."""
+
+    def __init__(self, base: Parser, max_capacity: int = 8):
+        self._base = base
+        self._iter = ThreadedIter(
+            lambda recycled: base.parse_next(),
+            base.before_first,
+            max_capacity=max_capacity,
+        )
+
+    def parse_next(self) -> Optional[List[RowBlockContainer]]:
+        ok, group = self._iter.next()
+        return group if ok else None
+
+    def before_first(self) -> None:
+        self._iter.before_first()
+
+    def bytes_read(self) -> int:
+        return self._base.bytes_read()
+
+    def close(self) -> None:
+        self._iter.destroy()
+        if hasattr(self._base, "close"):
+            self._base.close()
+
+
+# ---- registry + factory (data.cc:62-107,150-158) -----------------------
+
+PARSER_REGISTRY = Registry.get("data_parser")
+
+
+def register_parser(name: str):
+    """DMLC_REGISTER_DATA_PARSER analog (data.h:330-333). The factory
+    signature is ``(uri, args: dict, part_index, num_parts) -> Parser``."""
+    return PARSER_REGISTRY.register(name)
+
+
+def create_parser(
+    uri: str,
+    part_index: int = 0,
+    num_parts: int = 1,
+    type: str = "auto",
+    threaded: bool = True,
+    **extra_args,
+) -> Parser:
+    """Parser factory (data.cc:62-84): URI query args are parser params;
+    ``type='auto'`` resolves via ``format=`` arg, defaulting to libsvm."""
+    spec = URISpec(uri, part_index, num_parts)
+    args = dict(spec.args)
+    args.update({k: str(v) for k, v in extra_args.items()})
+    if type == "auto":
+        type = args.get("format", "libsvm")
+    entry = PARSER_REGISTRY.find(type)
+    if entry is None:
+        raise DMLCError(
+            f"unknown data format {type!r}; known: {PARSER_REGISTRY.list_all_names()}"
+        )
+    parser = entry.body(spec.uri, args, part_index, num_parts)
+    if threaded:
+        return ThreadedParser(parser)
+    return parser
+
+
+class MetricLogger:
+    """MB/s progress logging every 10MB (basic_row_iter.h:68-75 behavior,
+    kept as a compat feature per SURVEY.md §5)."""
+
+    def __init__(self, log_fn: Callable[[str], None], interval_mb: float = 10.0):
+        self._log = log_fn
+        self._interval = interval_mb * (1 << 20)
+        self._next_mark = self._interval
+        self._start = get_time()
+
+    def update(self, bytes_read: int) -> None:
+        if bytes_read >= self._next_mark:
+            elapsed = max(get_time() - self._start, 1e-9)
+            mb = bytes_read / (1 << 20)
+            self._log(f"{mb:.0f} MB read, {mb / elapsed:.2f} MB/sec")
+            self._next_mark += self._interval

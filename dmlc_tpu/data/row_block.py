@@ -1,0 +1,253 @@
+"""Sparse-row data structures: Row, RowBlock, RowBlockContainer.
+
+Rebuild of reference include/dmlc/data.h:69-214 (Row/RowBlock zero-copy CSR
+views) and src/data/row_block.h:26-205 (owning growable container with
+binary Save/Load). Arrays are numpy, which is what feeds straight into
+``jax.Array`` on the TPU path (dmlc_tpu.tpu.feed).
+
+Binary Save/Load is wire-compatible with the reference
+(row_block.h:183-203): offset/label/weight/field/index/value as u64-length-
+prefixed vectors, then max_field/max_index as raw IndexType scalars.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..base import check
+from .. import serializer as ser
+
+__all__ = ["Row", "RowBlock", "RowBlockContainer", "real_t", "index_t"]
+
+# data.h:23-29 — real_t = float32, default index_t = uint32
+real_t = np.float32
+index_t = np.uint32
+
+
+class Row:
+    """A zero-copy view of one instance (data.h:69-148)."""
+
+    __slots__ = ("label", "weight", "qid", "field", "index", "value")
+
+    def __init__(self, label, weight, qid, field, index, value):
+        self.label = label
+        self.weight = weight
+        self.qid = qid
+        self.field = field
+        self.index = index
+        self.value = value
+
+    @property
+    def length(self) -> int:
+        return len(self.index)
+
+    def get_value(self, i: int) -> float:
+        """Safe even when value is None (implicit 1.0, data.h:110-113)."""
+        return 1.0 if self.value is None else float(self.value[i])
+
+    def get_weight(self) -> float:
+        return 1.0 if self.weight is None else float(self.weight)
+
+    def sdot(self, dense_weight: np.ndarray) -> float:
+        """Sparse dot with a dense vector (data.h:134-148)."""
+        check(
+            self.length == 0 or int(self.index.max()) < len(dense_weight),
+            "feature index exceeds bound",
+        )
+        if self.value is None:
+            return float(dense_weight[self.index].sum())
+        return float((dense_weight[self.index] * self.value).sum())
+
+
+class RowBlock:
+    """CSR batch view (data.h:160-214)."""
+
+    __slots__ = ("offset", "label", "weight", "qid", "field", "index", "value")
+
+    def __init__(
+        self,
+        offset: np.ndarray,
+        label: np.ndarray,
+        index: np.ndarray,
+        value: Optional[np.ndarray] = None,
+        weight: Optional[np.ndarray] = None,
+        qid: Optional[np.ndarray] = None,
+        field: Optional[np.ndarray] = None,
+    ):
+        self.offset = offset
+        self.label = label
+        self.weight = weight
+        self.qid = qid
+        self.field = field
+        self.index = index
+        self.value = value
+
+    @property
+    def size(self) -> int:
+        return len(self.offset) - 1
+
+    def __len__(self) -> int:
+        return self.size
+
+    def __getitem__(self, i: int) -> Row:
+        check(0 <= i < self.size, "row index out of range")
+        lo, hi = int(self.offset[i]), int(self.offset[i + 1])
+        return Row(
+            label=float(self.label[i]) if self.label is not None else 0.0,
+            weight=float(self.weight[i]) if self.weight is not None else None,
+            qid=int(self.qid[i]) if self.qid is not None else None,
+            field=self.field[lo:hi] if self.field is not None else None,
+            index=self.index[lo:hi],
+            value=self.value[lo:hi] if self.value is not None else None,
+        )
+
+    def slice(self, begin: int, end: int) -> "RowBlock":
+        """Sub-block view sharing storage (data.h:189-208)."""
+        check(0 <= begin <= end <= self.size, "bad slice range")
+        return RowBlock(
+            offset=self.offset[begin : end + 1],
+            label=self.label[begin:end],
+            weight=self.weight[begin:end] if self.weight is not None else None,
+            qid=self.qid[begin:end] if self.qid is not None else None,
+            field=self.field,
+            index=self.index,
+            value=self.value,
+        )
+
+    def mem_cost_bytes(self) -> int:
+        """Approximate memory cost (data.h:336-361)."""
+        cost = self.offset.nbytes + self.label.nbytes
+        ndata = int(self.offset[-1]) - int(self.offset[0])
+        for arr in (self.weight, self.qid):
+            if arr is not None:
+                cost += arr.nbytes
+        for arr in (self.field, self.index, self.value):
+            if arr is not None:
+                cost += ndata * arr.itemsize
+        return cost
+
+    def __iter__(self):
+        for i in range(self.size):
+            yield self[i]
+
+
+class RowBlockContainer:
+    """Owning growable CSR container (src/data/row_block.h:26-205)."""
+
+    def __init__(self, index_dtype=index_t):
+        self._idt = np.dtype(index_dtype)
+        self.clear()
+
+    def clear(self) -> None:
+        self.offset = [0]
+        self.label = []
+        self.weight = []
+        self.qid = []
+        self.field = []
+        self.index = []
+        self.value = []
+        self.max_field = 0
+        self.max_index = 0
+
+    @property
+    def size(self) -> int:
+        return len(self.offset) - 1
+
+    def mem_cost_bytes(self) -> int:
+        return 8 * len(self.offset) + 4 * len(self.label) + 4 * len(self.index) + 4 * len(self.value)
+
+    def push(
+        self,
+        label: float,
+        index: Sequence[int],
+        value: Optional[Sequence[float]] = None,
+        weight: Optional[float] = None,
+        qid: Optional[int] = None,
+        field: Optional[Sequence[int]] = None,
+    ) -> None:
+        """Push one row (row_block.h:110-140); tracks max_index/max_field."""
+        self.label.append(label)
+        if weight is not None:
+            self.weight.append(weight)
+        if qid is not None:
+            self.qid.append(qid)
+        self.index.extend(index)
+        if len(index):
+            self.max_index = max(self.max_index, int(max(index)))
+        if value is not None:
+            self.value.extend(value)
+        if field is not None:
+            self.field.extend(field)
+            if len(field):
+                self.max_field = max(self.max_field, int(max(field)))
+        self.offset.append(len(self.index))
+
+    def push_arrays(
+        self,
+        labels: np.ndarray,
+        offsets: np.ndarray,
+        index: np.ndarray,
+        value: Optional[np.ndarray] = None,
+        weight: Optional[np.ndarray] = None,
+        field: Optional[np.ndarray] = None,
+    ) -> None:
+        """Bulk push of a parsed chunk (vectorized analog of
+        Push(RowBlock), row_block.h:142-179)."""
+        base = self.offset[-1]
+        self.offset.extend((offsets[1:] + base).tolist())
+        self.label.extend(labels.tolist())
+        self.index.extend(index.tolist())
+        if index.size:
+            self.max_index = max(self.max_index, int(index.max()))
+        if value is not None:
+            self.value.extend(value.tolist())
+        if weight is not None:
+            self.weight.extend(weight.tolist())
+        if field is not None:
+            self.field.extend(field.tolist())
+            if field.size:
+                self.max_field = max(self.max_field, int(field.max()))
+
+    def get_block(self) -> RowBlock:
+        """Freeze into a RowBlock view (row_block.h:87-108)."""
+        n = self.size
+        nval = len(self.index)
+        return RowBlock(
+            offset=np.asarray(self.offset, dtype=np.uint64),
+            label=np.asarray(self.label, dtype=real_t),
+            weight=np.asarray(self.weight, dtype=real_t) if len(self.weight) == n and n else None,
+            qid=np.asarray(self.qid, dtype=np.uint64) if len(self.qid) == n and n else None,
+            field=np.asarray(self.field, dtype=self._idt) if len(self.field) == nval and nval else None,
+            index=np.asarray(self.index, dtype=self._idt),
+            value=np.asarray(self.value, dtype=real_t) if len(self.value) == nval and nval else None,
+        )
+
+    # ---- binary round trip, reference wire format (row_block.h:183-203)
+    def save(self, strm) -> None:
+        ser.write_array(strm, np.asarray(self.offset, dtype=np.uint64))
+        ser.write_array(strm, np.asarray(self.label, dtype=real_t))
+        ser.write_array(strm, np.asarray(self.weight, dtype=real_t))
+        ser.write_array(strm, np.asarray(self.field, dtype=self._idt))
+        ser.write_array(strm, np.asarray(self.index, dtype=self._idt))
+        ser.write_array(strm, np.asarray(self.value, dtype=real_t))
+        strm.write(np.asarray([self.max_field, self.max_index], dtype=self._idt).tobytes())
+
+    def load(self, strm) -> bool:
+        """Returns False at clean EOF (row_block.h:195-203)."""
+        head = strm.read(8)
+        if len(head) < 8:
+            return False
+        import struct as _struct
+
+        (n,) = _struct.unpack("<Q", head)
+        self.offset = np.frombuffer(strm.read_exact(8 * n), dtype=np.uint64).tolist()
+        self.label = ser.read_array(strm, real_t).tolist()
+        self.weight = ser.read_array(strm, real_t).tolist()
+        self.field = ser.read_array(strm, self._idt).tolist()
+        self.index = ser.read_array(strm, self._idt).tolist()
+        self.value = ser.read_array(strm, real_t).tolist()
+        tail = np.frombuffer(strm.read_exact(2 * self._idt.itemsize), dtype=self._idt)
+        self.max_field, self.max_index = int(tail[0]), int(tail[1])
+        return True

@@ -16,3 +16,15 @@ from .recordio import (  # noqa: F401
     RecordIOReader,
     RecordIOWriter,
 )
+from . import input_split  # noqa: F401
+from .input_split import (  # noqa: F401
+    IndexedRecordIOSplitter,
+    InputSplit,
+    InputSplitBase,
+    LineSplitter,
+    RecordIOSplitter,
+    SingleFileSplit,
+)
+from .input_split_shuffle import InputSplitShuffle, create_shuffled  # noqa: F401
+from .threaded_input_split import ThreadedInputSplit  # noqa: F401
+from .cached_input_split import CachedInputSplit  # noqa: F401

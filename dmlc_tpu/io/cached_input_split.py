@@ -1,0 +1,129 @@
+"""Cache-on-first-pass wrapper: stream chunks to a local cache file while
+serving them; later epochs replay from the cache.
+
+Rebuild of reference src/io/cached_input_split.h:63-189. Selected by the
+``#cachefile`` URI sugar (src/io.cc:109-113). Cache layout: u64 chunk size +
+raw chunk bytes, repeated. ``reset_partition`` is unsupported, matching the
+reference (:87-89).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Optional
+
+from ..base import DMLCError
+from ..concurrency import ThreadedIter
+from .input_split import ChunkCursor, InputSplit, InputSplitBase
+
+__all__ = ["CachedInputSplit"]
+
+_U64 = struct.Struct("<Q")
+
+
+class CachedInputSplit(InputSplit):
+    def __init__(self, base: InputSplitBase, cache_file: str):
+        self._base = base
+        self._cache_path = cache_file
+        self._chunk: Optional[ChunkCursor] = None
+        if os.path.exists(self._cache_path):
+            # a completed cache from an earlier run: replay immediately
+            self._writer = None
+            self._cache_f = open(self._cache_path, "rb")
+            self._iter = ThreadedIter(self._read_cache_chunk, self._reopen_cache, 2)
+        else:
+            self._cache_f = None
+            self._writer = open(self._cache_path + ".tmp", "wb")
+            self._iter = ThreadedIter(self._produce_and_cache, None, 2)
+
+    # ---- first pass: read base, tee to cache (cached_input_split.h:63-86)
+    def _produce_and_cache(self, recycled):
+        data = self._base._load_chunk()
+        if data is None:
+            # finalize on EOF so a single-epoch run still produces the cache
+            # (reference finalizes on destruction)
+            self._finish_cache()
+            return None
+        self._writer.write(_U64.pack(len(data)))
+        self._writer.write(data)
+        return data
+
+    def _finish_cache(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            os.replace(self._cache_path + ".tmp", self._cache_path)
+            self._writer = None
+            self._base.close()
+
+    # ---- replay pass ---------------------------------------------------
+    def _reopen_cache(self) -> None:
+        self._cache_f.seek(0)
+
+    def _read_cache_chunk(self, recycled):
+        hdr = self._cache_f.read(8)
+        if len(hdr) < 8:
+            return None
+        (n,) = _U64.unpack(hdr)
+        data = self._cache_f.read(n)
+        if len(data) != n:
+            raise DMLCError(f"corrupt cache file {self._cache_path}")
+        return data
+
+    # ---- InputSplit interface ------------------------------------------
+    def next_record(self) -> Optional[memoryview]:
+        while True:
+            if self._chunk is not None:
+                rec = self._base.extract_next_record(self._chunk)
+                if rec is not None:
+                    return rec
+                self._chunk = None
+            ok, data = self._iter.next()
+            if not ok:
+                return None
+            self._chunk = ChunkCursor(data)
+
+    def next_chunk(self) -> Optional[memoryview]:
+        self._chunk = None
+        ok, data = self._iter.next()
+        return memoryview(data) if ok else None
+
+    def before_first(self) -> None:
+        # drain the first pass (completing the cache), then switch to replay
+        if self._cache_f is None:
+            while True:
+                ok, _ = self._iter.next()
+                if not ok:
+                    break
+            self._iter.destroy()
+            self._finish_cache()  # no-op if the producer already finalized
+            self._cache_f = open(self._cache_path, "rb")
+            self._iter = ThreadedIter(self._read_cache_chunk, self._reopen_cache, 2)
+        else:
+            self._iter.before_first()
+        self._chunk = None
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        raise DMLCError(
+            "CachedInputSplit does not support reset_partition "
+            "(cached_input_split.h:87-89)"
+        )
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        self._base.hint_chunk_size(chunk_size)
+
+    def get_total_size(self) -> int:
+        return self._base.get_total_size()
+
+    def close(self) -> None:
+        self._iter.destroy()
+        if self._writer is not None:
+            # first pass never reached EOF: the partial cache is unusable
+            self._writer.close()
+            self._writer = None
+            tmp = self._cache_path + ".tmp"
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        if self._cache_f is not None:
+            self._cache_f.close()
+        self._base.close()

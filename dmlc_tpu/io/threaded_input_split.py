@@ -1,0 +1,72 @@
+"""Double-buffered background prefetch wrapper over an InputSplitBase.
+
+Rebuild of reference src/io/threaded_input_split.h:23-101: a producer thread
+pulls chunks via the base split while the consumer extracts records from the
+previous chunk — capacity 2 (double buffering), applied by default by the
+factory (src/io.cc:108-113).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..concurrency import ThreadedIter
+from .input_split import ChunkCursor, InputSplit, InputSplitBase
+
+__all__ = ["ThreadedInputSplit"]
+
+
+class ThreadedInputSplit(InputSplit):
+    def __init__(self, base: InputSplitBase, max_capacity: int = 2):
+        self._base = base
+        self._cap = max_capacity
+        self._chunk: Optional[ChunkCursor] = None
+        self._iter: ThreadedIter = ThreadedIter(
+            self._produce, self._rewind, max_capacity=max_capacity
+        )
+
+    def _produce(self, recycled):
+        data = self._base._load_chunk()  # runs on the producer thread
+        return None if data is None else data
+
+    def _rewind(self) -> None:
+        self._base.before_first()
+
+    # ---- InputSplit interface ------------------------------------------
+    def next_record(self) -> Optional[memoryview]:
+        while True:
+            if self._chunk is not None:
+                rec = self._base.extract_next_record(self._chunk)
+                if rec is not None:
+                    return rec
+                self._chunk = None
+            ok, data = self._iter.next()
+            if not ok:
+                return None
+            self._chunk = ChunkCursor(data)
+
+    def next_chunk(self) -> Optional[memoryview]:
+        self._chunk = None
+        ok, data = self._iter.next()
+        return memoryview(data) if ok else None
+
+    def before_first(self) -> None:
+        self._iter.before_first()
+        self._chunk = None
+
+    def reset_partition(self, part_index: int, num_parts: int) -> None:
+        # must quiesce the producer before mutating the base split
+        self._iter.destroy()
+        self._base.reset_partition(part_index, num_parts)
+        self._chunk = None
+        self._iter = ThreadedIter(self._produce, self._rewind, max_capacity=self._cap)
+
+    def hint_chunk_size(self, chunk_size: int) -> None:
+        self._base.hint_chunk_size(chunk_size)
+
+    def get_total_size(self) -> int:
+        return self._base.get_total_size()
+
+    def close(self) -> None:
+        self._iter.destroy()
+        self._base.close()

@@ -1,0 +1,216 @@
+"""Data-layer tests (mirror reference libsvm_parser_test.cc,
+csv_parser_test.cc, libfm_parser_test.cc, dataiter_test.cc and the
+RowBlockContainer save/load round trip)."""
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.base import DMLCError
+from dmlc_tpu.data import (
+    BasicRowIter,
+    CSVParserParam,
+    RowBlockContainer,
+    create_parser,
+    create_row_iter,
+)
+from dmlc_tpu.io.stream import MemoryBytesStream
+
+
+LIBSVM_SAMPLE = b"""1 0:0.5 3:1.2 7:-4
+0 1:2 2:3.5
+1 4:1
+0
+1:0.5 5:1.5
+"""
+
+
+def write(tmp_path, name, data):
+    p = tmp_path / name
+    p.write_bytes(data)
+    return str(p)
+
+
+# ---------- libsvm ------------------------------------------------------
+
+def test_libsvm_basic(tmp_path):
+    uri = write(tmp_path, "a.libsvm", LIBSVM_SAMPLE)
+    it = create_row_iter(uri, 0, 1, "libsvm")
+    blocks = list(it)
+    assert len(blocks) == 1
+    b = blocks[0]
+    assert b.size == 5
+    np.testing.assert_allclose(b.label, [1, 0, 1, 0, 1])
+    r0 = b[0]
+    np.testing.assert_array_equal(r0.index, [0, 3, 7])
+    np.testing.assert_allclose(r0.value, [0.5, 1.2, -4])
+    assert b[3].length == 0          # empty row
+    assert it.num_col() == 8
+
+
+def test_libsvm_implicit_value_one(tmp_path):
+    uri = write(tmp_path, "b.libsvm", b"1 3 5:2.5\n")
+    (blk,) = list(create_row_iter(uri, 0, 1, "libsvm"))
+    r = blk[0]
+    np.testing.assert_array_equal(r.index, [3, 5])
+    np.testing.assert_allclose(r.value, [1.0, 2.5])
+
+
+def test_libsvm_instance_weight(tmp_path):
+    uri = write(tmp_path, "w.libsvm", b"1:0.25 0:1\n0:2.0 1:1\n")
+    (blk,) = list(create_row_iter(uri, 0, 1, "libsvm"))
+    np.testing.assert_allclose(blk.weight, [0.25, 2.0])
+    np.testing.assert_allclose(blk.label, [1, 0])
+
+
+def test_libsvm_partitions_cover(tmp_path):
+    lines = [
+        (f"{i % 2} " + " ".join(f"{j}:{i * 0.1 + j}" for j in range(i % 5))).strip()
+        for i in range(100)
+    ]
+    uri = write(tmp_path, "part.libsvm", ("\n".join(lines) + "\n").encode())
+    total = 0
+    labels = []
+    for part in range(3):
+        parser = create_parser(uri, part, 3, "libsvm")
+        for blk in parser:
+            total += blk.size
+            labels.extend(blk.label.tolist())
+    assert total == 100
+    np.testing.assert_allclose(labels, [i % 2 for i in range(100)])
+
+
+def test_libsvm_sdot():
+    c = RowBlockContainer()
+    c.push(1.0, [0, 2], [2.0, 3.0])
+    blk = c.get_block()
+    w = np.array([1.0, 10.0, 100.0], dtype=np.float32)
+    assert blk[0].sdot(w) == pytest.approx(302.0)
+
+
+# ---------- csv ---------------------------------------------------------
+
+def test_csv_with_label_column(tmp_path):
+    uri = write(tmp_path, "c.csv", b"1,0.5,2.5\n0,1.5,3.5\n")
+    it = create_row_iter(uri + "?format=csv&label_column=0", 0, 1, "auto")
+    (blk,) = list(it)
+    np.testing.assert_allclose(blk.label, [1, 0])
+    np.testing.assert_allclose(blk[0].value, [0.5, 2.5])
+    np.testing.assert_array_equal(blk[0].index, [0, 1])
+    assert it.num_col() == 2
+
+
+def test_csv_no_label(tmp_path):
+    uri = write(tmp_path, "d.csv", b"1.5,2.5\n3.5,4.5\n")
+    (blk,) = list(create_row_iter(uri, 0, 1, "csv"))
+    np.testing.assert_allclose(blk.label, [0, 0])
+    np.testing.assert_allclose(blk[1].value, [3.5, 4.5])
+
+
+def test_csv_param_validation():
+    p = CSVParserParam()
+    p.init({"label_column": "2"})
+    assert p.label_column == 2
+
+
+def test_csv_inconsistent_columns_raises(tmp_path):
+    uri = write(tmp_path, "bad.csv", b"1,2\n3\n")
+    with pytest.raises((DMLCError, ValueError)):
+        list(create_row_iter(uri, 0, 1, "csv"))
+
+
+# ---------- libfm -------------------------------------------------------
+
+def test_libfm(tmp_path):
+    uri = write(tmp_path, "e.libfm", b"1 2:3:0.5 4:7:1.5\n0 1:0:2\n")
+    (blk,) = list(create_row_iter(uri, 0, 1, "libfm"))
+    np.testing.assert_allclose(blk.label, [1, 0])
+    r0 = blk[0]
+    np.testing.assert_array_equal(r0.field, [2, 4])
+    np.testing.assert_array_equal(r0.index, [3, 7])
+    np.testing.assert_allclose(r0.value, [0.5, 1.5])
+
+
+def test_libfm_bad_triple(tmp_path):
+    uri = write(tmp_path, "bad.libfm", b"1 2:3\n")
+    with pytest.raises(DMLCError):
+        list(create_parser(uri, 0, 1, "libfm", threaded=False))
+
+
+# ---------- factory -----------------------------------------------------
+
+def test_auto_format_defaults_to_libsvm(tmp_path):
+    uri = write(tmp_path, "f.txt", b"1 0:1\n")
+    (blk,) = list(create_parser(uri, 0, 1, "auto"))
+    assert blk.size == 1
+
+
+def test_unknown_format(tmp_path):
+    uri = write(tmp_path, "g.txt", b"x\n")
+    with pytest.raises(DMLCError, match="unknown data format"):
+        create_parser(uri, 0, 1, "parquet")
+
+
+# ---------- RowBlock mechanics -----------------------------------------
+
+def test_rowblock_slice_and_memcost():
+    c = RowBlockContainer()
+    for i in range(10):
+        c.push(float(i), [i, i + 1], [1.0, 2.0])
+    blk = c.get_block()
+    s = blk.slice(2, 5)
+    assert s.size == 3
+    np.testing.assert_allclose(s.label, [2, 3, 4])
+    np.testing.assert_array_equal(s[0].index, [2, 3])
+    assert blk.mem_cost_bytes() > 0
+    assert c.max_index == 10
+
+
+def test_rowblock_container_save_load_roundtrip():
+    c = RowBlockContainer()
+    c.push(1.0, [1, 5], [0.5, 1.5], weight=2.0)
+    c.push(0.0, [2], [3.0], weight=1.0)
+    s = MemoryBytesStream()
+    c.save(s)
+    s.seek(0)
+    d = RowBlockContainer()
+    assert d.load(s)
+    assert d.offset == c.offset
+    np.testing.assert_allclose(d.label, c.label)
+    np.testing.assert_allclose(d.value, c.value)
+    assert d.max_index == c.max_index
+    assert not d.load(s)  # clean EOF
+
+
+# ---------- disk row iter ----------------------------------------------
+
+def test_disk_row_iter_cache(tmp_path):
+    lines = "\n".join(f"{i % 2} 0:{i} 1:{i * 2}" for i in range(50)) + "\n"
+    base = write(tmp_path, "h.libsvm", lines.encode())
+    cache = str(tmp_path / "h.cache")
+    it = create_row_iter(base + "#" + cache, 0, 1, "libsvm")
+    import os
+
+    epoch1 = [blk.label.tolist() for blk in it]
+    assert os.path.exists(cache)  # num_parts==1: no .splitN.partI suffix
+    epoch2 = [blk.label.tolist() for blk in it]
+    assert epoch1 == epoch2
+    assert sum(len(x) for x in epoch1) == 50
+    assert it.num_col() == 2
+    it.close()
+
+
+def test_disk_row_iter_reuses_existing_cache(tmp_path):
+    lines = "\n".join(f"1 0:{i}" for i in range(20)) + "\n"
+    base = write(tmp_path, "i.libsvm", lines.encode())
+    cache = str(tmp_path / "i.cache")
+    it1 = create_row_iter(base + "#" + cache, 0, 1, "libsvm")
+    n1 = sum(blk.size for blk in it1)
+    it1.close()
+    # second iter must load from cache (delete source to prove it)
+    import os
+
+    os.remove(base)
+    it2 = create_row_iter(base + "#" + cache, 0, 1, "libsvm")
+    n2 = sum(blk.size for blk in it2)
+    assert n1 == n2 == 20
+    it2.close()

@@ -1,0 +1,349 @@
+"""InputSplit partition/determinism tests (mirror reference
+test/split_repeat_read_test.cc and split_read_test.cc, plus the coverage the
+reference lacks: exhaustive part/num_parts sweeps on text and recordio)."""
+
+import os
+import random
+
+import pytest
+
+from dmlc_tpu.base import DMLCError
+from dmlc_tpu.io import input_split as isplit
+from dmlc_tpu.io.input_split_shuffle import create_shuffled
+from dmlc_tpu.io.recordio import RecordIOWriter
+from dmlc_tpu.io.stream import MemoryBytesStream
+
+
+# ---------- fixtures ----------------------------------------------------
+
+def make_text_files(tmp_path, n_files=3, lines_per_file=57, seed=0):
+    rng = random.Random(seed)
+    all_lines = []
+    paths = []
+    for i in range(n_files):
+        p = tmp_path / f"data{i}.txt"
+        lines = [
+            f"file{i}-line{j}-" + "x" * rng.randint(0, 40) for j in range(lines_per_file)
+        ]
+        p.write_bytes(("\n".join(lines) + "\n").encode())
+        all_lines.extend(lines)
+        paths.append(str(p))
+    return ";".join(paths), all_lines
+
+
+def make_recordio_file(tmp_path, n=211, seed=1, name="data.rec"):
+    rng = random.Random(seed)
+    recs = []
+    strm = MemoryBytesStream()
+    w = RecordIOWriter(strm)
+    import struct
+
+    magic = struct.pack("<I", 0xCED7230A)
+    for i in range(n):
+        body = bytes(rng.getrandbits(8) for _ in range(rng.randint(0, 120)))
+        if rng.random() < 0.3 and len(body) >= 8:
+            pos = ((rng.randrange(0, len(body) - 4) >> 2) << 2)
+            body = body[:pos] + magic + body[pos + 4 :]
+        recs.append(body)
+        w.write_record(body)
+    p = tmp_path / name
+    p.write_bytes(strm.getvalue())
+    return str(p), recs
+
+
+def read_all(split):
+    return [bytes(r) for r in split]
+
+
+# ---------- text splits -------------------------------------------------
+
+def test_text_single_part_reads_all_lines(tmp_path):
+    uri, lines = make_text_files(tmp_path)
+    sp = isplit.create(uri, 0, 1, "text", threaded=False)
+    assert [r.decode() for r in read_all(sp)] == lines
+
+
+@pytest.mark.parametrize("num_parts", [2, 3, 4, 7, 16])
+def test_text_partitions_cover_exactly(tmp_path, num_parts):
+    """No loss, no dup, order preserved within parts (split_repeat_read_test)."""
+    uri, lines = make_text_files(tmp_path)
+    got = []
+    for part in range(num_parts):
+        sp = isplit.create(uri, part, num_parts, "text", threaded=False)
+        got.extend(r.decode() for r in read_all(sp))
+        sp.close()
+    assert got == lines, f"partition mismatch at num_parts={num_parts}"
+
+
+def test_text_repeat_read_deterministic(tmp_path):
+    """before_first + re-read must be byte-identical (split_repeat_read_test.cc:8-57)."""
+    uri, _ = make_text_files(tmp_path)
+    sp = isplit.create(uri, 1, 3, "text", threaded=False)
+    first = read_all(sp)
+    for _ in range(3):
+        sp.before_first()
+        assert read_all(sp) == first
+
+
+def test_text_tiny_chunks_force_overflow_carry(tmp_path):
+    """Small chunk size exercises the overflow path heavily."""
+    uri, lines = make_text_files(tmp_path, n_files=1, lines_per_file=100)
+    sp = isplit.create(uri, 0, 1, "text", threaded=False)
+    sp.hint_chunk_size(64)
+    assert [r.decode() for r in read_all(sp)] == lines
+
+
+def test_text_chunk_smaller_than_record_grows(tmp_path):
+    p = tmp_path / "long.txt"
+    long_line = "a" * 10000
+    p.write_bytes((long_line + "\nshort\n").encode())
+    sp = isplit.create(str(p), 0, 1, "text", threaded=False)
+    sp.hint_chunk_size(16)  # much smaller than the record
+    out = [r.decode() for r in read_all(sp)]
+    assert out == [long_line, "short"]
+
+
+def test_text_crlf_and_blank_lines(tmp_path):
+    p = tmp_path / "crlf.txt"
+    p.write_bytes(b"a\r\nb\n\nc\r")
+    sp = isplit.create(str(p), 0, 1, "text", threaded=False)
+    # consecutive EOL chars are skipped as one separator (line_split.cc:41-44)
+    assert [bytes(r) for r in sp] == [b"a", b"b", b"c"]
+
+
+def test_directory_uri(tmp_path):
+    d = tmp_path / "dir"
+    d.mkdir()
+    (d / "a.txt").write_bytes(b"1\n2\n")
+    (d / "b.txt").write_bytes(b"3\n")
+    sp = isplit.create(str(d), 0, 1, "text", threaded=False)
+    assert sorted(bytes(r).decode() for r in sp) == ["1", "2", "3"]
+
+
+def test_regex_uri(tmp_path):
+    d = tmp_path / "rx"
+    d.mkdir()
+    (d / "part-001").write_bytes(b"a\n")
+    (d / "part-002").write_bytes(b"b\n")
+    (d / "other").write_bytes(b"c\n")
+    sp = isplit.create(str(d / "part-.*"), 0, 1, "text", threaded=False)
+    assert sorted(bytes(r).decode() for r in sp) == ["a", "b"]
+
+
+def test_missing_uri_raises(tmp_path):
+    with pytest.raises(DMLCError, match="Cannot find"):
+        isplit.create(str(tmp_path / "nope" / "*.txt"), 0, 1, "text", threaded=False)
+
+
+def test_get_total_size(tmp_path):
+    uri, _ = make_text_files(tmp_path)
+    sp = isplit.create(uri, 0, 1, "text", threaded=False)
+    total = sum(
+        os.path.getsize(u) for u in uri.split(";")
+    )
+    assert sp.get_total_size() == total
+
+
+# ---------- recordio splits --------------------------------------------
+
+def test_recordio_single_part(tmp_path):
+    path, recs = make_recordio_file(tmp_path)
+    sp = isplit.create(path, 0, 1, "recordio", threaded=False)
+    assert read_all(sp) == recs
+
+
+@pytest.mark.parametrize("num_parts", [2, 3, 5, 8])
+def test_recordio_partitions_cover_exactly(tmp_path, num_parts):
+    path, recs = make_recordio_file(tmp_path)
+    got = []
+    for part in range(num_parts):
+        sp = isplit.create(path, part, num_parts, "recordio", threaded=False)
+        got.extend(read_all(sp))
+        sp.close()
+    assert got == recs
+
+
+def test_recordio_multi_file(tmp_path):
+    p1, r1 = make_recordio_file(tmp_path, n=83, seed=5, name="a.rec")
+    p2, r2 = make_recordio_file(tmp_path, n=91, seed=6, name="b.rec")
+    got = []
+    for part in range(4):
+        sp = isplit.create(f"{p1};{p2}", part, 4, "recordio", threaded=False)
+        got.extend(read_all(sp))
+    assert got == r1 + r2
+
+
+def test_recordio_small_chunks(tmp_path):
+    path, recs = make_recordio_file(tmp_path, n=60)
+    sp = isplit.create(path, 0, 1, "recordio", threaded=False)
+    sp.hint_chunk_size(128)
+    assert read_all(sp) == recs
+
+
+# ---------- wrappers ----------------------------------------------------
+
+def test_threaded_wrapper_matches_plain(tmp_path):
+    uri, lines = make_text_files(tmp_path)
+    sp = isplit.create(uri, 0, 1, "text", threaded=True)
+    assert [r.decode() for r in read_all(sp)] == lines
+    sp.before_first()
+    assert [r.decode() for r in read_all(sp)] == lines
+    sp.close()
+
+
+def test_threaded_reset_partition(tmp_path):
+    uri, lines = make_text_files(tmp_path)
+    sp = isplit.create(uri, 0, 2, "text", threaded=True)
+    part0 = read_all(sp)
+    sp.reset_partition(1, 2)
+    part1 = read_all(sp)
+    assert [r.decode() for r in part0 + part1] == lines
+    sp.close()
+
+
+def test_cached_wrapper(tmp_path):
+    uri, lines = make_text_files(tmp_path, n_files=1)
+    cache = str(tmp_path / "cache.bin")
+    sp = isplit.create(f"{uri}#{cache}", 0, 1, "text")
+    first = [r.decode() for r in read_all(sp)]
+    assert first == lines
+    sp.before_first()
+    assert os.path.exists(cache + ".split1.part0") or os.path.exists(cache)
+    second = [r.decode() for r in read_all(sp)]
+    assert second == lines
+    with pytest.raises(DMLCError):
+        sp.reset_partition(0, 2)
+    sp.close()
+
+
+def test_cached_wrapper_replay_from_existing_cache(tmp_path):
+    """Regression: replay path must open the cache before the producer runs."""
+    uri, lines = make_text_files(tmp_path, n_files=1)
+    cache = str(tmp_path / "cache2.bin")
+    sp = isplit.create(f"{uri}#{cache}", 0, 1, "text")
+    assert [bytes(r).decode() for r in read_all(sp)] == lines  # single epoch only
+    sp.close()
+    # cache must exist after a single-epoch run (finalized at EOF)
+    assert os.path.exists(cache)
+    sp2 = isplit.create(f"{uri}#{cache}", 0, 1, "text")
+    assert [bytes(r).decode() for r in read_all(sp2)] == lines
+    sp2.close()
+
+
+def test_single_file_split_chunks_cover_whole_file(tmp_path):
+    """Regression: next_chunk must not drop bytes past the first 4MiB."""
+    p = tmp_path / "big.txt"
+    blob = (b"z" * 255 + b"\n") * ((5 << 20) // 256)  # ~5 MiB
+    p.write_bytes(blob)
+    sp = isplit.SingleFileSplit(str(p))
+    total = 0
+    while True:
+        c = sp.next_chunk()
+        if c is None:
+            break
+        total += len(c)
+    assert total == len(blob)
+
+
+def test_indexed_out_of_range_rank_is_empty(tmp_path):
+    """Regression: an out-of-range rank must serve zero records."""
+    path, idx, recs = make_indexed_recordio(tmp_path, n=4)
+    sp = isplit.create(path, 0, 1, "indexed_recordio", index_uri=idx)
+    assert len(read_all(sp)) == 4
+    sp.reset_partition(5, 6)  # nstep=1, rank 5 >= 4 records
+    assert read_all(sp) == []
+
+
+def test_recordio_tiny_hint_does_not_crash(tmp_path):
+    path, recs = make_recordio_file(tmp_path, n=20)
+    sp = isplit.create(path, 0, 1, "recordio", threaded=False)
+    sp.hint_chunk_size(4)  # clamped to the safe floor
+    assert read_all(sp) == recs
+
+
+def test_shuffle_split_covers_all_and_reshuffles(tmp_path):
+    uri, lines = make_text_files(tmp_path, n_files=2, lines_per_file=40)
+    sp = create_shuffled(uri, 0, 1, "text", num_shuffle_parts=4, shuffle_seed=3)
+    epoch1 = [r.decode() for r in read_all(sp)]
+    assert sorted(epoch1) == sorted(lines)
+    sp.before_first()
+    epoch2 = [r.decode() for r in read_all(sp)]
+    assert sorted(epoch2) == sorted(lines)
+    # with 4 sub-splits the visit order should differ between epochs (w.h.p.)
+    assert epoch1 != lines or epoch2 != lines or epoch1 != epoch2
+
+
+# ---------- indexed recordio -------------------------------------------
+
+def make_indexed_recordio(tmp_path, n=50, seed=9):
+    rng = random.Random(seed)
+    strm = MemoryBytesStream()
+    w = RecordIOWriter(strm)
+    offsets = []
+    recs = []
+    for i in range(n):
+        offsets.append(len(strm.getvalue()))
+        body = f"record-{i}-".encode() + bytes(
+            rng.getrandbits(8) for _ in range(rng.randint(0, 50))
+        )
+        recs.append(body)
+        w.write_record(body)
+    path = tmp_path / "indexed.rec"
+    path.write_bytes(strm.getvalue())
+    idx_path = tmp_path / "indexed.idx"
+    idx_path.write_text("".join(f"{i} {off}\n" for i, off in enumerate(offsets)))
+    return str(path), str(idx_path), recs
+
+
+def test_indexed_sequential(tmp_path):
+    path, idx, recs = make_indexed_recordio(tmp_path)
+    sp = isplit.create(path, 0, 1, "indexed_recordio", index_uri=idx)
+    assert read_all(sp) == recs
+
+
+@pytest.mark.parametrize("num_parts", [2, 3, 7])
+def test_indexed_record_granular_partition(tmp_path, num_parts):
+    path, idx, recs = make_indexed_recordio(tmp_path)
+    got = []
+    for part in range(num_parts):
+        sp = isplit.create(
+            path, part, num_parts, "indexed_recordio", index_uri=idx
+        )
+        got.extend(read_all(sp))
+    assert got == recs  # record-granular: exact cover in order
+
+
+def test_indexed_shuffle_covers_and_differs(tmp_path):
+    path, idx, recs = make_indexed_recordio(tmp_path)
+    sp = isplit.create(
+        path, 0, 1, "indexed_recordio", index_uri=idx, shuffle=True, seed=5
+    )
+    epoch1 = read_all(sp)
+    assert sorted(epoch1) == sorted(recs)
+    assert epoch1 != recs  # shuffled order differs w.h.p. for 50 records
+    sp.before_first()
+    epoch2 = read_all(sp)
+    assert sorted(epoch2) == sorted(recs)
+    assert epoch2 != epoch1  # fresh permutation each epoch
+
+
+def test_indexed_shuffle_seed_reproducible(tmp_path):
+    path, idx, recs = make_indexed_recordio(tmp_path)
+    a = read_all(
+        isplit.create(path, 0, 1, "indexed_recordio", index_uri=idx, shuffle=True, seed=7)
+    )
+    b = read_all(
+        isplit.create(path, 0, 1, "indexed_recordio", index_uri=idx, shuffle=True, seed=7)
+    )
+    assert a == b
+
+
+# ---------- single file / stdin ----------------------------------------
+
+def test_single_file_split(tmp_path):
+    p = tmp_path / "single.txt"
+    p.write_bytes(b"x\ny\nz")
+    sp = isplit.SingleFileSplit(str(p))
+    assert [bytes(r) for r in sp] == [b"x", b"y", b"z"]
+    sp.before_first()
+    assert sp.next_record() is not None
