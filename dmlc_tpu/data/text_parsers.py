@@ -84,24 +84,23 @@ class CSVParser(TextParserBase):
         if not lines:
             return
         ncol = lines[0].count(delim) + 1
+        # reference csv_parser.h CHECK-fails on ragged rows; validate per
+        # line up front so the flat fast path can never reassign cells
+        # across row boundaries
+        for ln in lines:
+            check(
+                ln.count(delim) + 1 == ncol,
+                f"CSV has inconsistent column counts: {ln[:80]!r}",
+            )
         flat = delim.join(lines)
         try:
             arr = np.fromiter(
                 map(float, flat.split(delim)), dtype=np.float64,
-                count=flat.count(delim) + 1,
+                count=len(lines) * ncol,
             )
-        except ValueError:
-            arr = np.empty(0)  # non-numeric cell: take the fallback path
-        if arr.size != len(lines) * ncol:
-            # ragged or non-numeric rows: fall back to per-line parse
-            rows = []
-            for ln in lines:
-                cols = [float(x) for x in ln.split(delim)]
-                check(len(cols) == ncol, "CSV has inconsistent column counts")
-                rows.append(cols)
-            arr = np.asarray(rows, dtype=np.float64)
-        else:
-            arr = arr.reshape(len(lines), ncol)
+        except ValueError as e:
+            raise DMLCError(f"CSV: non-numeric cell: {e}") from e
+        arr = arr.reshape(len(lines), ncol)
         lc = self.param.label_column
         if lc >= 0:
             check(lc < ncol, f"label_column {lc} >= num columns {ncol}")

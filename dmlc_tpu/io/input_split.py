@@ -373,7 +373,7 @@ class LineSplitter(InputSplitBase):
         n = data.rfind(b"\n")
         r = data.rfind(b"\r")
         last = max(n, r)
-        return last + 1 if last > 0 else 0
+        return last + 1 if last >= 0 else 0
 
     def extract_next_record(self, chunk: ChunkCursor) -> Optional[memoryview]:
         if chunk.pos >= chunk.end:

@@ -1,0 +1,35 @@
+"""Parallelism layer: device meshes, XLA collectives, sequence/context
+parallelism, and pipeline scheduling.
+
+This is the TPU-native replacement for the reference's distributed
+substrate (tracker-computed tree+ring overlays consumed by rabit/ps-lite,
+/root/reference/tracker/dmlc_tracker/tracker.py:165-252).  On TPU the data
+plane is XLA collectives over ICI/DCN; the mesh axes here define the rank
+contract that the tracker layer (dmlc_tpu.tracker) gang-schedules.
+"""
+
+from .mesh import (  # noqa: F401
+    AXIS_DP,
+    AXIS_EP,
+    AXIS_PP,
+    AXIS_SP,
+    AXIS_TP,
+    MESH_AXES,
+    MeshConfig,
+    build_mesh,
+    factorize_devices,
+)
+from .collectives import (  # noqa: F401
+    all_gather,
+    all_reduce,
+    all_to_all,
+    axis_rank,
+    axis_size,
+    barrier_sum,
+    broadcast,
+    ppermute_ring,
+    reduce_scatter,
+)
+from .ring_attention import ring_attention, ring_attention_reference  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401
+from .pipeline import pipeline_spmd  # noqa: F401

@@ -1,0 +1,143 @@
+"""Device-mesh conventions for the framework.
+
+The reference's parallelism model is SPMD data parallelism only: the
+tracker assigns each worker a (rank, world_size) pair and an overlay
+topology (binomial tree + ring, /root/reference/tracker/dmlc_tracker/
+tracker.py:165-252), and InputSplit partitions bytes by
+(part_index, num_parts).
+
+The TPU rebuild generalizes rank to a coordinate in a named
+`jax.sharding.Mesh`.  Five canonical axes:
+
+  dp — data parallelism       (batch dimension; gradient all-reduce)
+  pp — pipeline parallelism   (layer stages; ppermute activations)
+  sp — sequence parallelism   (context/ring attention; KV rotation)
+  tp — tensor parallelism     (heads / hidden shards; all-gather/reduce-scatter)
+  ep — expert parallelism     (MoE experts; all_to_all token routing)
+
+The InputSplit contract maps onto the mesh as
+    part_index = flattened index over (dp, sp)   [data-bearing axes]
+    num_parts  = dp_size * sp_size
+so each chip streams exactly its shard of the input bytes into HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+AXIS_DP = "dp"
+AXIS_PP = "pp"
+AXIS_SP = "sp"
+AXIS_TP = "tp"
+AXIS_EP = "ep"
+
+#: Canonical axis order.  pp outermost so pipeline stages land on
+#: contiguous device groups (cheap ppermute over ICI neighbours); tp
+#: innermost so tensor-parallel collectives ride the fastest links —
+#: mirrors the megatron-style ordering the scaling playbook recommends.
+MESH_AXES: Tuple[str, ...] = (AXIS_PP, AXIS_DP, AXIS_SP, AXIS_EP, AXIS_TP)
+
+
+def _largest_pow2_divisor(n: int, cap: int) -> int:
+    d = 1
+    while d * 2 <= cap and n % (d * 2) == 0:
+        d *= 2
+    return d
+
+
+def factorize_devices(
+    n_devices: int,
+    *,
+    pp: Optional[int] = None,
+    dp: Optional[int] = None,
+    sp: Optional[int] = None,
+    ep: Optional[int] = None,
+    tp: Optional[int] = None,
+) -> Dict[str, int]:
+    """Pick mesh-axis sizes whose product is ``n_devices``.
+
+    Fixed axes are honoured exactly; free axes are assigned greedily in
+    the order tp, sp, pp (factors of 2, capped at 2 each when devices are
+    scarce) with the remainder going to dp.  This gives small test meshes
+    (8 virtual devices) a non-trivial shard on every interesting axis.
+    """
+    fixed = {AXIS_PP: pp, AXIS_DP: dp, AXIS_SP: sp, AXIS_EP: ep, AXIS_TP: tp}
+    rem = n_devices
+    for name, size in fixed.items():
+        if size is not None:
+            if rem % size != 0:
+                raise ValueError(
+                    f"axis {name}={size} does not divide remaining {rem} devices"
+                )
+            rem //= size
+    # Greedy assignment for unfixed axes (ep defaults to 1: experts are
+    # additionally sharded over tp inside the model, see models/moe.py).
+    for name, cap in ((AXIS_TP, 2), (AXIS_SP, 2), (AXIS_PP, 2)):
+        if fixed[name] is None:
+            d = _largest_pow2_divisor(rem, cap)
+            fixed[name] = d
+            rem //= d
+    if fixed[AXIS_EP] is None:
+        fixed[AXIS_EP] = 1
+    if fixed[AXIS_DP] is None:
+        fixed[AXIS_DP] = rem
+        rem = 1
+    if rem != 1:
+        raise ValueError(
+            f"mesh {fixed} does not use all {n_devices} devices (left={rem})"
+        )
+    return {name: int(fixed[name]) for name in MESH_AXES}
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Declarative mesh shape; ``build()`` realizes it over real devices."""
+
+    shape: Dict[str, int]
+
+    @property
+    def n_devices(self) -> int:
+        return int(math.prod(self.shape.values()))
+
+    def axis_size(self, name: str) -> int:
+        return self.shape[name]
+
+    @property
+    def data_parts(self) -> int:
+        """num_parts for the InputSplit contract (data-bearing axes)."""
+        return self.shape[AXIS_DP] * self.shape[AXIS_SP]
+
+    def part_index(self, coords: Dict[str, int]) -> int:
+        """Flattened (dp, sp) coordinate → InputSplit part_index."""
+        return coords[AXIS_DP] * self.shape[AXIS_SP] + coords[AXIS_SP]
+
+
+def build_mesh(
+    n_devices: Optional[int] = None,
+    *,
+    devices: Optional[Sequence] = None,
+    **axis_sizes,
+):
+    """Create a `jax.sharding.Mesh` with the canonical five axes.
+
+    ``n_devices`` defaults to all local devices.  Axis sizes may be pinned
+    via keyword args (``tp=4``); the rest are factorized automatically.
+    """
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    n = len(devices)
+    shape = factorize_devices(n, **axis_sizes)
+    dev_array = np.asarray(devices).reshape([shape[a] for a in MESH_AXES])
+    return jax.sharding.Mesh(dev_array, MESH_AXES)
+
+
+def mesh_config(mesh) -> MeshConfig:
+    return MeshConfig(shape={a: mesh.shape[a] for a in mesh.axis_names})

@@ -1,0 +1,83 @@
+"""SPMD pipeline parallelism: GPipe-style microbatch schedule over the
+``pp`` mesh axis using collective permutes.
+
+New capability relative to the reference (which is data-parallel only,
+SURVEY.md §2.7); designed the TPU way: every pp rank runs the same traced
+program (no per-stage programs, no host scheduler), activations advance
+one stage per step via `lax.ppermute` over ICI neighbours, and the bubble
+is the standard M + P - 1 steps for M microbatches over P stages.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_spmd(
+    stage_fn: Callable,
+    stage_params,
+    x_microbatches,
+    *,
+    axis_name: str = "pp",
+):
+    """Run a P-stage pipeline inside shard_map.
+
+    stage_fn(params, x) -> y must preserve the activation shape (standard
+    transformer blocks do).  ``stage_params`` is this rank's stage's
+    parameter pytree (stack the per-stage params on a leading axis and
+    shard it over pp outside).  ``x_microbatches``: [M, mb, ...] — the
+    full input, replicated or broadcast; only stage 0 consumes it.
+
+    Returns [M, mb, ...] outputs, valid on every rank (broadcast from the
+    last stage).
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    m = x_microbatches.shape[0]
+    total = m + n - 1
+    state0 = jnp.zeros_like(x_microbatches[0])
+    outputs0 = jnp.zeros_like(x_microbatches)
+    perm_fwd = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(t, carry):
+        outputs, state = carry
+        # stage 0 ingests microbatch t (clamped; steps past M reuse the
+        # last microbatch but their results are never written)
+        feed = x_microbatches[jnp.minimum(t, m - 1)]
+        x_in = jnp.where(my == 0, feed, state)
+        y = stage_fn(stage_params, x_in)
+        out_idx = t - (n - 1)  # microbatch finishing at the last stage
+        write = (my == n - 1) & (out_idx >= 0)
+        idx = jnp.clip(out_idx, 0, m - 1)
+        outputs = jnp.where(
+            write, outputs.at[idx].set(y), outputs
+        )
+        state = lax.ppermute(y, axis_name, perm_fwd)
+        return outputs, state
+
+    outputs, _ = lax.fori_loop(0, total, step, (outputs0, state0))
+    # broadcast finished outputs from the last stage to all pp ranks
+    is_last = (my == n - 1)
+    contrib = jnp.where(is_last, outputs, jnp.zeros_like(outputs))
+    return lax.psum(contrib, axis_name)
+
+
+def make_pipeline(mesh, stage_fn, *, axis_name: str = "pp"):
+    """shard_map wrapper: params stacked on leading stage axis, sharded pp."""
+    from jax.sharding import PartitionSpec as P
+
+    def inner(params_stacked, x_mb):
+        local = jax.tree.map(lambda p: p[0], params_stacked)
+        return pipeline_spmd(stage_fn, local, x_mb, axis_name=axis_name)
+
+    return jax.shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(),
+        check_vma=False,
+    )

@@ -1,0 +1,141 @@
+"""Ring attention: exact attention over sequence shards via ICI neighbour
+exchange.
+
+Long-context capability is new relative to the reference (dmlc-core
+predates it — SURVEY.md §5); what carries over is the partitioning
+contract: the sequence dimension is sharded by the same
+(part_index, num_parts) scheme InputSplit uses for bytes
+(/root/reference/src/io/input_split_base.cc:30-64), with part_index =
+mesh coordinate along the ``sp`` axis.
+
+Algorithm: each sp shard holds Q for its sequence block and rotates the
+K/V blocks around the ring with `lax.ppermute`, folding each block into a
+flash-attention-style online softmax (running max + denominator), so the
+full-sequence result is exact while peak memory stays O(T/sp).  The KV
+rotation overlaps with compute at the XLA level (async collective
+permute on TPU).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_BIG = -1e30
+
+
+def _block_attend(q, k, v, *, scale, mask):
+    """One Q-block × KV-block partial attention.
+
+    Returns (p @ v, row_max, row_sum) in f32 accumulators.
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; mask: [Tq, Tk] bool or None.
+    """
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None, :, :], s, _NEG_BIG)
+    m = jnp.max(s, axis=-1)                      # [B, H, Tq]
+    p = jnp.exp(s - m[..., None])
+    if mask is not None:
+        p = p * mask[None, None, :, :].astype(p.dtype)
+    l = jnp.sum(p, axis=-1)                      # [B, H, Tq]
+    pv = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )                                            # [B, Tq, H, D]
+    return pv, m, l
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+):
+    """Exact multi-head attention over a ring of sequence shards.
+
+    Call inside `jax.shard_map` with q/k/v already sequence-sharded:
+    shapes [B, T_local, H, D] where T_global = T_local * axis_size(sp).
+    Head layouts may additionally be tensor-sharded; this function only
+    touches the sequence dimension.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, t_local, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+
+    q_pos = jnp.arange(t_local)  # local positions; global = blk*t_local + pos
+    acc0 = jnp.zeros((b, t_local, h, d), jnp.float32)
+    m0 = jnp.full((b, h, t_local), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((b, h, t_local), jnp.float32)
+
+    def step(i, carry):
+        acc, m, l, k_blk, v_blk = carry
+        src = (my - i) % n  # ring position the held KV block originated from
+        if causal:
+            # global causal mask between my Q block and the src KV block
+            gq = my * t_local + q_pos[:, None]
+            gk = src * t_local + q_pos[None, :]
+            mask = gq >= gk
+        else:
+            mask = None
+        pv, bm, bl = _block_attend(q, k_blk, v_blk, scale=scale, mask=mask)
+        m_new = jnp.maximum(m, bm)
+        corr = jnp.exp(m - m_new)          # rescale old accumulator
+        bcor = jnp.exp(bm - m_new)         # rescale this block
+        l_new = l * corr + bl * bcor
+        acc_new = (
+            acc * jnp.transpose(corr, (0, 2, 1))[..., None]
+            + pv * jnp.transpose(bcor, (0, 2, 1))[..., None]
+        )
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return acc_new, m_new, l_new, k_next, v_next
+
+    acc, m, l, _, _ = lax.fori_loop(0, n, step, (acc0, m0, l0, k, v))
+    denom = jnp.transpose(jnp.maximum(l, 1e-20), (0, 2, 1))[..., None]
+    return (acc / denom).astype(q.dtype)
+
+
+def ring_attention_reference(q, k, v, *, causal: bool = True, scale=None):
+    """Unsharded full attention — the correctness oracle for ring_attention.
+
+    q/k/v: [B, T, H, D] (full sequence on one device).
+    """
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None, None], s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
+
+
+def make_sharded_ring_attention(mesh, *, causal: bool = True):
+    """Wrap ring_attention in shard_map over (sp sequence, tp heads)."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, "sp", "tp", None)
+    fn = functools.partial(ring_attention, axis_name="sp", causal=causal)
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )

@@ -1,0 +1,50 @@
+"""Ulysses-style sequence parallelism: all-to-all head↔sequence re-shard.
+
+The complement to ring attention: instead of rotating KV blocks, use one
+all_to_all to convert sequence-sharded activations [B, T/sp, H, D] into
+head-sharded [B, T, H/sp, D], run ordinary full attention locally, and
+all_to_all back.  Cheaper than ring when H >= sp and the full T fits in
+HBM; ring wins for extreme context lengths.  Both honour the same
+(part_index, num_parts) sequence-partition contract (parallel.mesh).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from jax import lax
+
+from .ring_attention import ring_attention_reference
+
+
+def ulysses_attention(
+    q,
+    k,
+    v,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    attn_fn: Optional[Callable] = None,
+):
+    """Attention over sequence shards via two all_to_alls.
+
+    Call inside `jax.shard_map`; q/k/v: [B, T_local, H, D] with H divisible
+    by axis_size(sp).  attn_fn(q, k, v, causal=...) runs on the re-sharded
+    [B, T_global, H_local, D] blocks (defaults to exact softmax attention);
+    it receives ``causal`` as a keyword so custom kernels honour the mask.
+    """
+    if attn_fn is None:
+        attn_fn = lambda q, k, v, causal: ring_attention_reference(
+            q, k, v, causal=causal
+        )
+
+    def seq_to_heads(x):
+        # [B, T/sp, H, D] -> [B, T, H/sp, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = attn_fn(qh, kh, vh, causal=causal)
+    return heads_to_seq(out)

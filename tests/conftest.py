@@ -3,15 +3,24 @@
 Multi-chip sharding is validated on a virtual CPU mesh
 (xla_force_host_platform_device_count), per the TPU-rebuild test strategy;
 real-chip benchmarks live in bench.py, not tests.
+
+The container boots with an experimental TPU PJRT plugin pre-registered
+(JAX_PLATFORMS=axon via sitecustomize), so an env-var setdefault is not
+enough — we must override the platform through jax.config before first
+backend use.
 """
 
 import os
 
 # must be set before jax is imported anywhere in the test session
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -118,6 +118,20 @@ def test_csv_inconsistent_columns_raises(tmp_path):
         list(create_row_iter(uri, 0, 1, "csv"))
 
 
+def test_csv_ragged_with_coincident_token_count_raises(tmp_path):
+    # 6 tokens == 3 lines * 2 cols: the flat fast path must not silently
+    # reassign cells across row boundaries (regression)
+    uri = write(tmp_path, "bad2.csv", b"1,2\n3,4,5\n6\n")
+    with pytest.raises((DMLCError, ValueError)):
+        list(create_row_iter(uri, 0, 1, "csv"))
+
+
+def test_csv_non_numeric_cell_raises_framework_error(tmp_path):
+    uri = write(tmp_path, "bad3.csv", b"1,abc\n2,3\n")
+    with pytest.raises((DMLCError, ValueError)):
+        list(create_row_iter(uri, 0, 1, "csv"))
+
+
 # ---------- libfm -------------------------------------------------------
 
 def test_libfm(tmp_path):
