@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Benchmark: RecordIO InputSplit record-read throughput vs the reference.
+
+Measures the #1 hot path (SURVEY.md §3.1) the way the reference's own
+harness does (test/split_read_test.cc): iterate every record of a
+RecordIO file through InputSplit and report MB/s.  The baseline is the
+reference C++ implementation compiled from /root/reference on this
+machine and run on the same file — a true same-hardware, same-data
+comparison.  The data file is written by OUR RecordIO writer and read by
+the REFERENCE reader, so every run also re-proves bit-exact format
+compatibility.
+
+Prints exactly ONE JSON line on stdout:
+  {"metric": ..., "value": ..., "unit": "MB/s", "vs_baseline": ...}
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+WORK = "/tmp/dmlc_tpu_bench"
+DATA = os.path.join(WORK, "data.rec")
+REFBIN = os.path.join(WORK, "refbench")
+TARGET_PAYLOAD = 128 << 20  # 128 MB
+TRIALS = 3
+
+REF_MAIN = r"""
+#include <dmlc/io.h>
+#include <dmlc/timer.h>
+#include <cstdio>
+#include <memory>
+int main(int argc, char *argv[]) {
+  if (argc < 2) { fprintf(stderr, "usage: prog uri\n"); return 1; }
+  std::unique_ptr<dmlc::InputSplit> split(
+      dmlc::InputSplit::Create(argv[1], 0, 1, "recordio"));
+  dmlc::InputSplit::Blob blob;
+  double start = dmlc::GetTime();
+  size_t bytes = 0, n = 0;
+  while (split->NextRecord(&blob)) { bytes += blob.size; ++n; }
+  double dt = dmlc::GetTime() - start;
+  printf("%.3f %zu %zu\n", bytes / 1.0e6 / dt, bytes, n);
+  return 0;
+}
+"""
+
+REF_SOURCES = [
+    "src/io.cc",
+    "src/io/input_split_base.cc",
+    "src/io/line_split.cc",
+    "src/io/recordio_split.cc",
+    "src/io/indexed_recordio_split.cc",
+    "src/io/local_filesys.cc",
+    "src/io/filesys.cc",
+    "src/recordio.cc",
+]
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def ensure_data():
+    if os.path.exists(DATA) and os.path.getsize(DATA) > TARGET_PAYLOAD:
+        return
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from dmlc_tpu.io.recordio import RecordIOWriter
+    from dmlc_tpu.io.stream import Stream
+
+    log(f"bench: writing {TARGET_PAYLOAD >> 20} MB RecordIO to {DATA}")
+    rng = np.random.default_rng(0)
+    with Stream.create(DATA, "w") as s:
+        w = RecordIOWriter(s)
+        total = 0
+        while total < TARGET_PAYLOAD:
+            n = int(rng.integers(32 << 10, 96 << 10))
+            w.write_record(rng.integers(0, 256, n, dtype=np.uint8).tobytes())
+            total += n
+
+
+def ensure_refbin():
+    if os.path.exists(REFBIN):
+        return True
+    main_cc = os.path.join(WORK, "ref_main.cc")
+    with open(main_cc, "w") as f:
+        f.write(REF_MAIN)
+    cmd = (
+        ["g++", "-O3", "-std=c++11", "-I/root/reference/include",
+         "-DDMLC_USE_HDFS=0", "-DDMLC_USE_S3=0", "-DDMLC_USE_AZURE=0",
+         main_cc]
+        + [os.path.join("/root/reference", s) for s in REF_SOURCES]
+        + ["-o", REFBIN, "-pthread"]
+    )
+    log("bench: compiling reference baseline harness")
+    r = subprocess.run(cmd, capture_output=True, text=True)
+    if r.returncode != 0:
+        log(f"bench: reference build failed:\n{r.stderr[:2000]}")
+        return False
+    return True
+
+
+def run_reference():
+    best = 0.0
+    for _ in range(TRIALS):
+        out = subprocess.run(
+            [REFBIN, DATA], capture_output=True, text=True, check=True
+        ).stdout.split()
+        best = max(best, float(out[0]))
+    return best
+
+
+def run_ours():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from dmlc_tpu.io import input_split
+
+    best = 0.0
+    for _ in range(TRIALS):
+        split = input_split.create(DATA, 0, 1, "recordio")
+        t0 = time.perf_counter()
+        nbytes = 0
+        while True:
+            rec = split.next_record()
+            if rec is None:
+                break
+            nbytes += len(rec)
+        dt = time.perf_counter() - t0
+        split.close()
+        best = max(best, nbytes / 1.0e6 / dt)
+    return best
+
+
+def main():
+    os.makedirs(WORK, exist_ok=True)
+    ensure_data()
+    ours = run_ours()
+    baseline = None
+    if ensure_refbin():
+        baseline = run_reference()
+        log(f"bench: ours={ours:.1f} MB/s reference={baseline:.1f} MB/s")
+    result = {
+        "metric": "recordio_inputsplit_read_MBps",
+        "value": round(ours, 1),
+        "unit": "MB/s",
+        "vs_baseline": round(ours / baseline, 3) if baseline else None,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -202,8 +202,11 @@ class InputSplitBase(InputSplit):
         return the number of bytes skipped."""
         raise NotImplementedError
 
-    def find_last_record_begin(self, buf: memoryview) -> int:
-        """Return the offset of the last record start within buf (0 if none)."""
+    def find_last_record_begin(self, buf) -> int:
+        """Return the offset of the last record start within buf (0 if none).
+
+        ``buf`` is bytes-like with find/rfind (bytes or bytearray — the hot
+        path passes the chunk bytearray to avoid a full copy)."""
         raise NotImplementedError
 
     def extract_next_record(self, chunk: ChunkCursor) -> Optional[memoryview]:
@@ -263,14 +266,32 @@ class InputSplitBase(InputSplit):
             size = self._offset_end - self._offset_curr
         if size == 0:
             return b""
-        out = bytearray()
-        while len(out) < size:
-            data = self._fs.read(size - len(out))
-            self._offset_curr += len(data)
-            out += data
-            if len(out) == size:
-                break
-            if not data:
+        # fast path: one read satisfies the request (no staging copy)
+        data = self._fs.read(size)
+        self._offset_curr += len(data)
+        if len(data) == size:
+            return data
+        # slow path (file seam): delegate the seam-crossing loop to
+        # _read_into so the partition-boundary logic lives in one place
+        out = bytearray(size)
+        out[: len(data)] = data
+        n = len(data) + self._read_into(memoryview(out), len(data))
+        return bytes(out[:n])
+
+    def _read_into(self, mv: memoryview, start: int) -> int:
+        """Fill mv[start:] from the partition, crossing file seams.
+        Returns bytes read (may stop early only at partition end)."""
+        if self._offset_begin >= self._offset_end:
+            return 0
+        size = len(mv) - start
+        if self._offset_curr + size > self._offset_end:
+            size = self._offset_end - self._offset_curr
+        done = 0
+        while done < size:
+            n = self._fs.readinto(mv[start + done : start + size])
+            self._offset_curr += n
+            done += n
+            if n == 0:
                 check(
                     self._offset_curr == self._file_offset[self._file_ptr + 1],
                     "file offset not calculated correctly",
@@ -280,23 +301,32 @@ class InputSplitBase(InputSplit):
                 self._file_ptr += 1
                 self._fs.close()
                 self._fs = self._filesys.open_for_read(self._files[self._file_ptr].path)
-        return bytes(out)
+        return done
 
-    def read_chunk(self, max_size: int) -> Optional[bytes]:
+    def read_chunk(self, max_size: int) -> Optional[bytearray]:
         """One chunk with overflow carry. Returns None at EOF; b'' when the
-        overflow alone exceeds ``max_size`` (caller must grow the buffer)."""
+        overflow alone exceeds ``max_size`` (caller must grow the buffer).
+
+        Single-allocation hot path: the chunk buffer is filled in place via
+        readinto; only the (small) carried-over tail is copied.
+        """
         if max_size <= len(self._overflow):
             return b""
         olen = len(self._overflow)
-        buf = self._overflow + self.read(max_size - olen)
-        self._overflow = b""
-        if len(buf) == 0:
+        buf = bytearray(max_size)
+        buf[:olen] = self._overflow
+        total = olen + self._read_into(memoryview(buf), olen)
+        if total == 0:
+            self._overflow = b""
             return None
-        if len(buf) != max_size:
+        self._overflow = b""
+        if total != max_size:
+            del buf[total:]
             return buf
-        cut = self.find_last_record_begin(memoryview(buf))
-        self._overflow = buf[cut:]
-        return buf[:cut]
+        cut = self.find_last_record_begin(buf)
+        self._overflow = bytes(memoryview(buf)[cut:])
+        del buf[cut:]
+        return buf
 
     def _load_chunk(self) -> Optional[bytes]:
         """Chunk::Load with geometric growth (input_split_base.cc:241-258)."""
@@ -367,11 +397,11 @@ class LineSplitter(InputSplitBase):
             nstep += 1
         return nstep
 
-    def find_last_record_begin(self, buf: memoryview) -> int:
-        # last EOL + 1, or 0 (line_split.cc:27-34)
-        data = bytes(buf)
-        n = data.rfind(b"\n")
-        r = data.rfind(b"\r")
+    def find_last_record_begin(self, buf) -> int:
+        # last EOL + 1, or 0 (line_split.cc:27-34); buf is bytes-like
+        # (bytearray in the hot path — no copy)
+        n = buf.rfind(b"\n")
+        r = buf.rfind(b"\r")
         last = max(n, r)
         return last + 1 if last >= 0 else 0
 
@@ -423,18 +453,18 @@ class RecordIOSplitter(InputSplitBase):
                     break
         return nstep - 8
 
-    def find_last_record_begin(self, buf: memoryview) -> int:
-        # backward u32 scan from end-2 words (recordio_split.cc:26-42)
-        data = bytes(buf)
-        check(len(data) % 4 == 0, "unaligned recordio chunk")
-        check(len(data) >= 8, "recordio chunk too small")
-        hi = len(data) - 4  # a head needs magic at idx plus lrec at idx+4
+    def find_last_record_begin(self, buf) -> int:
+        # backward u32 scan from end-2 words (recordio_split.cc:26-42);
+        # buf is bytes-like (bytearray in the hot path — no copy)
+        check(len(buf) % 4 == 0, "unaligned recordio chunk")
+        check(len(buf) >= 8, "recordio chunk too small")
+        hi = len(buf) - 4  # a head needs magic at idx plus lrec at idx+4
         while True:
-            idx = data.rfind(_MAGIC_BYTES, 0, hi)
+            idx = buf.rfind(_MAGIC_BYTES, 0, hi)
             if idx <= 0:
                 return 0
             if idx % 4 == 0:
-                cflag = decode_flag(_U32.unpack_from(data, idx + 4)[0])
+                cflag = decode_flag(_U32.unpack_from(buf, idx + 4)[0])
                 if cflag in (0, 1):
                     return idx
             hi = idx + 3  # next candidate strictly below idx

@@ -37,6 +37,17 @@ class Stream(abc.ABC):
     def write(self, data: bytes) -> int:
         """Write all bytes; returns count written."""
 
+    def readinto(self, mv: memoryview) -> int:
+        """Read up to len(mv) bytes into ``mv``; returns count (0 at EOF).
+
+        Default falls back to read()+copy; concrete streams override with
+        a true zero-copy fill (the ingest hot path depends on it).
+        """
+        data = self.read(len(mv))
+        n = len(data)
+        mv[:n] = data
+        return n
+
     def close(self) -> None:
         pass
 
@@ -180,6 +191,10 @@ class FileStream(SeekStream):
 
     def read(self, size: int) -> bytes:
         return self._f.read(size)
+
+    def readinto(self, mv: memoryview) -> int:
+        n = self._f.readinto(mv)
+        return 0 if n is None else n
 
     def write(self, data: bytes) -> int:
         return self._f.write(data)

@@ -1,0 +1,250 @@
+"""Flagship model: decoder-only transformer LM, 5-way parallel.
+
+Parallelism map (axes from parallel.mesh):
+  dp — batch sharding; gradient reduction via the loss pmean transpose
+  pp — layer stages scheduled by parallel.pipeline (collective permute)
+  sp — sequence sharding; exact ring attention (parallel.ring_attention)
+  tp — megatron-style head/ffn/vocab sharding (psum at row-parallel outs)
+  ep — MoE expert sharding with soft gating (psum over ep⊗tp)
+
+One code path serves both the sharded SPMD body (inside jax.shard_map
+with VMA checking, so psum/pvary transposes produce correct synced
+gradients automatically) and the unsharded single-chip oracle
+(ShardAxes()) — tests assert the two losses are bit-close.
+
+MoE gating is full-softmax (dense dispatch): every ep shard computes its
+local experts for all tokens and the weighted combine psums over
+(ep, tp).  Top-k routing with all_to_all token exchange is the planned
+fast path; dense dispatch is exact and keeps shapes static for XLA.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.core import (
+    ShardAxes,
+    embed_lookup,
+    rms_norm,
+    rope,
+    softmax_xent,
+    swiglu_ffn,
+)
+from ..parallel.mesh import AXIS_DP, AXIS_EP, AXIS_PP, AXIS_SP, AXIS_TP
+from ..parallel.pipeline import pipeline_spmd
+from ..parallel.ring_attention import ring_attention, ring_attention_reference
+
+SHARDED_AXES = ShardAxes(tp=AXIS_TP, sp=AXIS_SP, ep=AXIS_EP, pp=AXIS_PP, dp=AXIS_DP)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 256
+    d_model: int = 64
+    n_heads: int = 4
+    head_dim: int = 16
+    d_ff: int = 128
+    n_layers: int = 4          # total; must divide by pp stages
+    n_experts: int = 2         # 1 = dense FFN
+    microbatches: int = 2      # pipeline schedule M
+    dtype: str = "float32"     # bf16 for real runs; f32 for CPU tests
+
+    @property
+    def jdtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def init_params(key, cfg: TransformerConfig, n_stages: int = 1):
+    """Global (unsharded) parameter pytree; blocks stacked [S, L/S, ...]."""
+    assert cfg.n_layers % n_stages == 0
+    lps = cfg.n_layers // n_stages
+    e, h, d, f, x = cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff, cfg.n_experts
+    keys = iter(jax.random.split(key, 16))
+
+    def norm(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(cfg.jdtype)
+
+    blk = {
+        "ln1": jnp.ones((n_stages, lps, e), cfg.jdtype),
+        "ln2": jnp.ones((n_stages, lps, e), cfg.jdtype),
+        "wq": norm(next(keys), (n_stages, lps, e, h, d)),
+        "wk": norm(next(keys), (n_stages, lps, e, h, d)),
+        "wv": norm(next(keys), (n_stages, lps, e, h, d)),
+        "wo": norm(next(keys), (n_stages, lps, h, d, e)),
+        "gate": norm(next(keys), (n_stages, lps, e, x)),
+        "w_in": norm(next(keys), (n_stages, lps, x, e, f)),
+        "w_gate": norm(next(keys), (n_stages, lps, x, e, f)),
+        "w_out": norm(next(keys), (n_stages, lps, x, f, e)),
+    }
+    return {
+        "embed": norm(next(keys), (cfg.vocab, e)),
+        "unembed": norm(next(keys), (e, cfg.vocab)),
+        "ln_f": jnp.ones((e,), cfg.jdtype),
+        "blocks": blk,
+    }
+
+
+def param_specs():
+    """PartitionSpecs matching init_params' pytree structure."""
+    blk = {
+        "ln1": P(AXIS_PP),
+        "ln2": P(AXIS_PP),
+        "wq": P(AXIS_PP, None, None, AXIS_TP, None),
+        "wk": P(AXIS_PP, None, None, AXIS_TP, None),
+        "wv": P(AXIS_PP, None, None, AXIS_TP, None),
+        "wo": P(AXIS_PP, None, AXIS_TP, None, None),
+        "gate": P(AXIS_PP),
+        "w_in": P(AXIS_PP, None, AXIS_EP, None, AXIS_TP),
+        "w_gate": P(AXIS_PP, None, AXIS_EP, None, AXIS_TP),
+        "w_out": P(AXIS_PP, None, AXIS_EP, AXIS_TP, None),
+    }
+    return {
+        "embed": P(AXIS_TP, None),
+        "unembed": P(None, AXIS_TP),
+        "ln_f": P(),
+        "blocks": blk,
+    }
+
+
+def _attention(x, p, positions, axes: ShardAxes):
+    """Multi-head attention; heads tp-sharded, sequence sp-sharded."""
+    q = jnp.einsum("bte,ehd->bthd", x, p["wq"])
+    k = jnp.einsum("bte,ehd->bthd", x, p["wk"])
+    v = jnp.einsum("bte,ehd->bthd", x, p["wv"])
+    q = rope(q, positions)
+    k = rope(k, positions)
+    if axes.sp is not None:
+        o = ring_attention(q, k, v, axis_name=axes.sp, causal=True)
+    else:
+        o = ring_attention_reference(q, k, v, causal=True)
+    y = jnp.einsum("bthd,hde->bte", o, p["wo"])
+    if axes.tp is not None:
+        y = lax.psum(y, axes.tp)
+    return y
+
+
+def _moe_ffn(x, p, axes: ShardAxes):
+    """Soft-gated MoE; experts sharded over (ep, tp), combined in one psum."""
+    n_local = p["w_in"].shape[0]
+    gate_logits = jnp.einsum("bte,ex->btx", x, p["gate"])  # [B,T,X_global]
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    if axes.ep is not None:
+        off = lax.axis_index(axes.ep) * n_local
+        local_probs = lax.dynamic_slice_in_dim(probs, off, n_local, axis=-1)
+    else:
+        local_probs = probs
+
+    def one_expert(w_in, w_gate, w_out):
+        return swiglu_ffn(x, w_in, w_gate, w_out, axes, reduce=False)
+
+    ys = jax.vmap(one_expert)(p["w_in"], p["w_gate"], p["w_out"])  # [Xl,B,T,E]
+    y = jnp.einsum("xbte,btx->bte", ys, local_probs.astype(ys.dtype))
+    reduce_axes = tuple(a for a in (axes.ep, axes.tp) if a is not None)
+    if reduce_axes:
+        y = lax.psum(y, reduce_axes)
+    return y
+
+
+def _block(x, p, positions, axes: ShardAxes):
+    x = x + _attention(rms_norm(x, p["ln1"]), p, positions, axes)
+    x = x + _moe_ffn(rms_norm(x, p["ln2"]), p, axes)
+    return x
+
+
+def _stage_fn(stage_params, x, positions, axes: ShardAxes):
+    """Apply this stage's L/S blocks via scan over the layer dim."""
+
+    def body(h, layer_p):
+        return _block(h, layer_p, positions, axes), None
+
+    out, _ = lax.scan(body, x, stage_params)
+    return out
+
+
+def forward_local(params, ids, labels, cfg: TransformerConfig, axes: ShardAxes):
+    """Per-device loss.  ids/labels: [B_local, T_local] (dp × sp shards).
+
+    Inside shard_map, `params` are the local shards; with ShardAxes()
+    this is the unsharded oracle.  Returns scalar mean loss (f32),
+    fully reduced over (dp, sp) when those axes are present.
+    """
+    b, t_local = ids.shape
+    sp_rank = lax.axis_index(axes.sp) if axes.sp is not None else 0
+    positions = sp_rank * t_local + jnp.arange(t_local)
+
+    x = embed_lookup(params["embed"], ids, axes).astype(cfg.jdtype)
+
+    blocks = params["blocks"]
+    if axes.pp is not None:
+        stage_params = jax.tree.map(lambda a: a[0], blocks)  # local S=1
+        m = cfg.microbatches
+        assert b % m == 0, f"batch {b} must divide microbatches {m}"
+        xmb = x.reshape(m, b // m, t_local, cfg.d_model)
+        out = pipeline_spmd(
+            lambda p_, h: _stage_fn(p_, h, positions, axes),
+            stage_params,
+            xmb,
+            axis_name=axes.pp,
+        )
+        x = out.reshape(b, t_local, cfg.d_model)
+    else:
+        n_stages = blocks["ln1"].shape[0]
+        for s in range(n_stages):
+            stage_params = jax.tree.map(lambda a: a[s], blocks)
+            x = _stage_fn(stage_params, x, positions, axes)
+
+    x = rms_norm(x, params["ln_f"])
+    logits = jnp.einsum("bte,ev->btv", x, params["unembed"])
+    loss = softmax_xent(logits, labels, axes)  # [B, T_local]
+    loss = jnp.mean(loss)
+    reduce_axes = tuple(a for a in (axes.dp, axes.sp) if a is not None)
+    if reduce_axes:
+        loss = lax.pmean(loss, reduce_axes)
+    return loss
+
+
+def unsharded_loss(params, ids, labels, cfg: TransformerConfig):
+    """Single-device oracle (also the single-chip entry() forward)."""
+    return forward_local(params, ids, labels, cfg, ShardAxes())
+
+
+def make_train_step(mesh, cfg: TransformerConfig, optimizer=None):
+    """Build a jitted SPMD train step over ``mesh``.
+
+    Returns (train_step, init_state) where
+      train_step(params, opt_state, ids, labels) -> (params, opt_state, loss)
+    ids/labels are global [B, T] arrays sharded P(dp, sp).
+    """
+    import optax
+
+    if optimizer is None:
+        optimizer = optax.adamw(1e-3)
+    specs = param_specs()
+    data_spec = P(AXIS_DP, AXIS_SP)
+
+    local = jax.shard_map(
+        lambda p, i, l: jax.value_and_grad(
+            lambda pp_: forward_local(pp_, i, l, cfg, SHARDED_AXES)
+        )(p),
+        mesh=mesh,
+        in_specs=(specs, data_spec, data_spec),
+        out_specs=(P(), specs),
+    )
+
+    def train_step(params, opt_state, ids, labels):
+        loss, grads = local(params, ids, labels)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    def init_state(params):
+        return optimizer.init(params)
+
+    return jax.jit(train_step), init_state

@@ -1,0 +1,16 @@
+"""Core TPU ops: norms, rotary embeddings, sharded embedding/softmax.
+
+These are the MXU-facing building blocks of the model layer — large
+batched matmuls in bf16/f32 with collectives only where tensor sharding
+demands them.  New capability relative to the reference (dmlc-core has no
+compute ops); the sharding conventions follow parallel.mesh.
+"""
+
+from .core import (  # noqa: F401
+    ShardAxes,
+    embed_lookup,
+    rms_norm,
+    rope,
+    softmax_xent,
+    swiglu_ffn,
+)

@@ -94,6 +94,23 @@ def all_to_all(x, axis: AxisName, *, split_axis: int, concat_axis: int, tiled: b
     )
 
 
+def match_vma(x, ref):
+    """Cast x's varying-manual-axes type up to ref's.
+
+    Needed for loop carries under VMA-checked shard_map: an invariant
+    initial accumulator that folds in device-varying values must be typed
+    varying from the start.  No-op outside shard_map / when already
+    varying on ref's axes.
+    """
+    try:
+        want = jax.typeof(ref).vma - jax.typeof(x).vma
+    except AttributeError:
+        return x
+    if not want:
+        return x
+    return lax.pcast(x, tuple(want), to="varying")
+
+
 def barrier_sum(axis: AxisName):
     """A cheap synchronisation point: psum of a scalar 1 (returns world size)."""
     return lax.psum(jnp.ones((), jnp.int32), axis)

@@ -35,12 +35,17 @@ def pipeline_spmd(
     Returns [M, mb, ...] outputs, valid on every rank (broadcast from the
     last stage).
     """
+    from .collectives import match_vma
+
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     m = x_microbatches.shape[0]
     total = m + n - 1
-    state0 = jnp.zeros_like(x_microbatches[0])
-    outputs0 = jnp.zeros_like(x_microbatches)
+    # carries vary over the input's axes AND pp (my-dependent writes,
+    # ppermuted state): match x's vma then add pp via `my`, which is
+    # already pp-varying — keeping match_vma's version-compat guard.
+    state0 = match_vma(match_vma(jnp.zeros_like(x_microbatches[0]), x_microbatches), my)
+    outputs0 = match_vma(match_vma(jnp.zeros_like(x_microbatches), x_microbatches), my)
     perm_fwd = [(j, (j + 1) % n) for j in range(n)]
 
     def step(t, carry):

@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .collectives import match_vma as _match_vma
+
 _NEG_BIG = -1e30
 
 
@@ -77,6 +79,9 @@ def ring_attention(
     acc0 = jnp.zeros((b, t_local, h, d), jnp.float32)
     m0 = jnp.full((b, h, t_local), _NEG_BIG, jnp.float32)
     l0 = jnp.zeros((b, h, t_local), jnp.float32)
+    # loop carries become device-varying (they fold in varying K/V blocks);
+    # under VMA-checked shard_map the initial values must carry that type
+    acc0, m0, l0 = (_match_vma(a, q) for a in (acc0, m0, l0))
 
     def step(i, carry):
         acc, m, l, k_blk, v_blk = carry

@@ -1,0 +1,91 @@
+"""Flagship transformer: sharded SPMD loss must match the unsharded
+oracle, and the full 5-way-parallel train step must run and learn."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dmlc_tpu.models import (
+    TransformerConfig,
+    init_params,
+    make_train_step,
+    param_specs,
+    unsharded_loss,
+)
+from dmlc_tpu.parallel import build_mesh
+
+CFG = TransformerConfig(
+    vocab=64, d_model=32, n_heads=4, head_dim=8, d_ff=32,
+    n_layers=2, n_experts=2, microbatches=2,
+)
+
+
+def _data(key, b=4, t=16, vocab=64):
+    ids = jax.random.randint(key, (b, t), 0, vocab)
+    labels = jnp.roll(ids, -1, axis=1)
+    return ids, labels
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    # pp=2, sp=2, tp=2: every interesting axis non-trivial on 8 devices
+    return build_mesh(8, pp=2, sp=2, tp=2, dp=1, ep=1)
+
+
+def test_sharded_loss_matches_oracle(mesh):
+    params = init_params(jax.random.PRNGKey(0), CFG, n_stages=2)
+    ids, labels = _data(jax.random.PRNGKey(1))
+    want = float(unsharded_loss(params, ids, labels, CFG))
+
+    from dmlc_tpu.models.transformer import SHARDED_AXES, forward_local
+
+    specs = param_specs()
+    fn = jax.shard_map(
+        lambda p, i, l: forward_local(p, i, l, CFG, SHARDED_AXES),
+        mesh=mesh, in_specs=(specs, P("dp", "sp"), P("dp", "sp")),
+        out_specs=P(),
+    )
+    got = float(jax.jit(fn)(params, ids, labels))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_train_step_learns(mesh):
+    params = init_params(jax.random.PRNGKey(0), CFG, n_stages=2)
+    step, init_state = make_train_step(mesh, CFG)
+    opt_state = init_state(params)
+    ids, labels = _data(jax.random.PRNGKey(2))
+    losses = []
+    for _ in range(8):
+        params, opt_state, loss = step(params, opt_state, ids, labels)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
+
+
+def test_gradients_match_oracle(mesh):
+    """Sharded grads (via VMA transposes) == unsharded autodiff grads."""
+    params = init_params(jax.random.PRNGKey(0), CFG, n_stages=2)
+    ids, labels = _data(jax.random.PRNGKey(3))
+
+    from dmlc_tpu.models.transformer import SHARDED_AXES, forward_local
+
+    specs = param_specs()
+    gfn = jax.shard_map(
+        lambda p, i, l: jax.grad(
+            lambda q: forward_local(q, i, l, CFG, SHARDED_AXES)
+        )(p),
+        mesh=mesh, in_specs=(specs, P("dp", "sp"), P("dp", "sp")),
+        out_specs=specs,
+    )
+    got = jax.jit(gfn)(params, ids, labels)
+    want = jax.grad(lambda q: unsharded_loss(q, ids, labels, CFG))(params)
+    flat_g, _ = jax.tree.flatten(got)
+    flat_w, tree = jax.tree.flatten(want)
+    paths = jax.tree.flatten_with_path(want)[0]
+    for (path, w), g in zip(paths, flat_g):
+        np.testing.assert_allclose(
+            np.asarray(g), np.asarray(w), atol=5e-5, rtol=1e-3,
+            err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}",
+        )
