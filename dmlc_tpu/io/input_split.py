@@ -266,16 +266,8 @@ class InputSplitBase(InputSplit):
             size = self._offset_end - self._offset_curr
         if size == 0:
             return b""
-        # fast path: one read satisfies the request (no staging copy)
-        data = self._fs.read(size)
-        self._offset_curr += len(data)
-        if len(data) == size:
-            return data
-        # slow path (file seam): delegate the seam-crossing loop to
-        # _read_into so the partition-boundary logic lives in one place
         out = bytearray(size)
-        out[: len(data)] = data
-        n = len(data) + self._read_into(memoryview(out), len(data))
+        n = self._read_into(memoryview(out), 0)
         return bytes(out[:n])
 
     def _read_into(self, mv: memoryview, start: int) -> int:
@@ -303,9 +295,10 @@ class InputSplitBase(InputSplit):
                 self._fs = self._filesys.open_for_read(self._files[self._file_ptr].path)
         return done
 
-    def read_chunk(self, max_size: int) -> Optional[bytearray]:
-        """One chunk with overflow carry. Returns None at EOF; b'' when the
-        overflow alone exceeds ``max_size`` (caller must grow the buffer).
+    def read_chunk(self, max_size: int):
+        """One chunk (bytes-like) with overflow carry. Returns None at EOF;
+        an empty buffer when the overflow alone exceeds ``max_size``
+        (caller must grow the buffer).
 
         Single-allocation hot path: the chunk buffer is filled in place via
         readinto; only the (small) carried-over tail is copied.
@@ -328,7 +321,7 @@ class InputSplitBase(InputSplit):
         del buf[cut:]
         return buf
 
-    def _load_chunk(self) -> Optional[bytes]:
+    def _load_chunk(self):  # -> Optional[bytes-like]
         """Chunk::Load with geometric growth (input_split_base.cc:241-258)."""
         size = self._chunk_bytes
         while True:

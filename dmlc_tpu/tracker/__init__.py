@@ -1,0 +1,18 @@
+"""Distributed job launcher + rank-rendezvous tracker (L7).
+
+Rebuild of the reference control plane (tracker/dmlc_tracker/): the
+tracker assigns ranks, computes the binomial-tree + shared-ring overlay,
+and brokers peer connections over a TCP protocol (magic 0xff99); launch
+backends start worker/server processes on local, ssh, mpi, sge, slurm
+and TPU-VM clusters.  Unlike the reference, the worker-side protocol
+client ships here too (tracker.client) so the rendezvous is testable
+in-repo, and ssh/slurm are actually routed in the dispatcher (fixing
+reference submit.py:42-53 which leaves them unreachable).
+
+On TPU the data plane is XLA collectives (parallel/); this layer remains
+the control plane: gang-scheduling, retries, rank contract, env vars.
+"""
+
+from .protocol import MAGIC, FrameSocket, link_maps  # noqa: F401
+from .rendezvous import PSTracker, RabitTracker, submit_job  # noqa: F401
+from .client import TrackerClient  # noqa: F401

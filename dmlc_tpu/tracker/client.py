@@ -1,0 +1,174 @@
+"""Worker-side rendezvous client + host-side tree collectives.
+
+The reference keeps the worker half of the tracker protocol downstream
+(in rabit); shipping it here makes the rendezvous testable in-repo and
+gives native consumers a host-side allreduce fallback for control-plane
+data (the TPU data plane is XLA collectives, parallel/collectives.py).
+
+Peer links established through tracker brokering are real TCP
+connections; peers identify themselves with (MAGIC, rank) frames after
+connect.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from .protocol import MAGIC, FrameSocket
+
+__all__ = ["TrackerClient"]
+
+
+class TrackerClient:
+    """One worker's connection to the tracker and its peer overlay."""
+
+    def __init__(self, tracker_uri: Optional[str] = None,
+                 tracker_port: Optional[int] = None,
+                 jobid: Optional[str] = None):
+        self.tracker_uri = tracker_uri or os.environ.get(
+            "DMLC_TRACKER_URI", "127.0.0.1")
+        self.tracker_port = int(
+            tracker_port or os.environ.get("DMLC_TRACKER_PORT", "9091"))
+        self.jobid = jobid or os.environ.get("DMLC_TASK_ID", "NULL")
+        self.rank = -1
+        self.world_size = -1
+        self.parent = -1
+        self.tree_nbrs = []
+        self.ring_prev = -1
+        self.ring_next = -1
+        self.links: Dict[int, FrameSocket] = {}
+        self._listener: Optional[socket.socket] = None
+
+    # ---- tracker session helpers ---------------------------------------
+    def _dial(self) -> FrameSocket:
+        s = socket.create_connection((self.tracker_uri, self.tracker_port))
+        fs = FrameSocket(s)
+        fs.send_int(MAGIC)
+        assert fs.recv_int() == MAGIC
+        return fs
+
+    def _session(self, cmd: str, rank: int, world: int) -> FrameSocket:
+        fs = self._dial()
+        fs.send_int(rank)
+        fs.send_int(world)
+        fs.send_str(self.jobid)
+        fs.send_str(cmd)
+        return fs
+
+    # ---- rendezvous ----------------------------------------------------
+    def start(self, world_size: int = -1, cmd: str = "start") -> "TrackerClient":
+        """Rendezvous: obtain rank + topology, establish peer links."""
+        self._listener = socket.socket()
+        self._listener.bind(("0.0.0.0", 0))
+        self._listener.listen(16)
+        my_port = self._listener.getsockname()[1]
+
+        fs = self._session(cmd, self.rank, world_size)
+        self.rank = fs.recv_int()
+        self.parent = fs.recv_int()
+        self.world_size = fs.recv_int()
+        n_nbrs = fs.recv_int()
+        self.tree_nbrs = [fs.recv_int() for _ in range(n_nbrs)]
+        self.ring_prev = fs.recv_int()
+        self.ring_next = fs.recv_int()
+
+        # brokering dance: report already-good links, connect to assigned
+        # peers, then report our accept port
+        good = sorted(self.links.keys())
+        fs.send_int(len(good))
+        for r in good:
+            fs.send_int(r)
+        n_conn = fs.recv_int()
+        n_accept = fs.recv_int()
+        for _ in range(n_conn):
+            host = fs.recv_str()
+            port = fs.recv_int()
+            peer_rank = fs.recv_int()
+            ps = FrameSocket(socket.create_connection((host, port)))
+            ps.send_int(MAGIC)
+            ps.send_int(self.rank)
+            assert ps.recv_int() == MAGIC
+            got = ps.recv_int()
+            assert got == peer_rank, (got, peer_rank)
+            self.links[peer_rank] = ps
+        fs.send_int(0)          # nerr
+        fs.send_int(my_port)
+        fs.close()
+
+        for _ in range(n_accept):
+            conn, _ = self._listener.accept()
+            ps = FrameSocket(conn)
+            assert ps.recv_int() == MAGIC
+            peer_rank = ps.recv_int()
+            ps.send_int(MAGIC)
+            ps.send_int(self.rank)
+            self.links[peer_rank] = ps
+        return self
+
+    def recover(self) -> "TrackerClient":
+        """Reconnect after restart keeping our rank (tracker 'recover')."""
+        assert self.rank >= 0
+        for fs in self.links.values():
+            fs.close()
+        self.links = {}
+        return self.start(cmd="recover")
+
+    # ---- tracker utility commands --------------------------------------
+    def log(self, msg: str) -> None:
+        fs = self._session("print", self.rank, -1)
+        fs.send_str(msg)
+        fs.close()
+
+    def shutdown(self) -> None:
+        fs = self._session("shutdown", self.rank, -1)
+        fs.close()
+        for ps in self.links.values():
+            ps.close()
+        self.links = {}
+        if self._listener is not None:
+            self._listener.close()
+
+    # ---- host-side tree collectives ------------------------------------
+    def _send_array(self, fs: FrameSocket, arr: np.ndarray) -> None:
+        data = arr.tobytes()
+        fs.send_int(len(data))
+        fs.sock.sendall(data)
+
+    def _recv_array(self, fs: FrameSocket, like: np.ndarray) -> np.ndarray:
+        n = fs.recv_int()
+        return np.frombuffer(fs.recv_all(n), dtype=like.dtype).reshape(like.shape)
+
+    def allreduce_sum(self, arr: np.ndarray) -> np.ndarray:
+        """Binomial-tree allreduce (reduce to root, broadcast back)."""
+        arr = np.ascontiguousarray(arr)
+        if self.world_size <= 1:
+            return arr.copy()
+        children = [r for r in self.tree_nbrs if r != self.parent]
+        acc = arr.astype(arr.dtype, copy=True)
+        for c in children:
+            acc += self._recv_array(self.links[c], acc)
+        if self.parent >= 0:
+            self._send_array(self.links[self.parent], acc)
+            acc = self._recv_array(self.links[self.parent], acc)
+        for c in children:
+            self._send_array(self.links[c], acc)
+        return acc
+
+    def broadcast(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
+        """Tree broadcast from root (root's value wins everywhere)."""
+        arr = np.ascontiguousarray(arr)
+        if self.world_size <= 1:
+            return arr.copy()
+        assert root == 0, "tree broadcast is rooted at rank 0"
+        children = [r for r in self.tree_nbrs if r != self.parent]
+        out = arr
+        if self.parent >= 0:
+            out = self._recv_array(self.links[self.parent], arr)
+        for c in children:
+            self._send_array(self.links[c], out)
+        return out.copy() if out is arr else out

@@ -1,0 +1,77 @@
+"""CLI options for dmlc-submit (reference tracker/dmlc_tracker/opts.py).
+
+Memory strings accept g/m suffixes like the reference (opts.py:39-57).
+The cluster list adds ``tpu-vm`` (gang-scheduling onto TPU VM slices —
+the YARN-AM role) and actually exposes ssh/slurm, which the reference
+parses but never routes (submit.py:42-53)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+CLUSTERS = ["local", "ssh", "mpi", "sge", "slurm", "mesos", "yarn", "tpu-vm"]
+
+
+def parse_memory_mb(text: str) -> int:
+    t = text.strip().lower()
+    if t.endswith("g"):
+        return int(float(t[:-1]) * 1024)
+    if t.endswith("m"):
+        return int(float(t[:-1]))
+    return int(t)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dmlc-submit",
+        description="submit a distributed dmlc_tpu job",
+    )
+    p.add_argument("--cluster", default=os.environ.get("DMLC_SUBMIT_CLUSTER"),
+                   choices=CLUSTERS, help="cluster backend")
+    p.add_argument("--num-workers", required=True, type=int)
+    p.add_argument("--num-servers", default=0, type=int)
+    p.add_argument("--worker-cores", default=1, type=int)
+    p.add_argument("--server-cores", default=1, type=int)
+    p.add_argument("--worker-memory", default="1g")
+    p.add_argument("--server-memory", default="1g")
+    p.add_argument("--jobname", default=None)
+    p.add_argument("--queue", default="default")
+    p.add_argument("--log-level", default="INFO",
+                   choices=["INFO", "DEBUG", "WARNING", "ERROR"])
+    p.add_argument("--log-file", default=None)
+    p.add_argument("--host-ip", default=None,
+                   help="tracker bind IP (default: auto-detect)")
+    p.add_argument("--host-file", default=None,
+                   help="hosts for ssh/mpi/tpu-vm backends, one ip[:port] per line")
+    p.add_argument("--sge-log-dir", default=None)
+    p.add_argument("--slurm-worker-nodes", default=None, type=int)
+    p.add_argument("--slurm-server-nodes", default=None, type=int)
+    p.add_argument("--mesos-master", default=os.environ.get("DMLC_MESOS_MASTER"))
+    p.add_argument("--sync-dst-dir", default=None,
+                   help="rsync the working dir to this path on each host first")
+    p.add_argument("--max-attempts", default=3, type=int,
+                   help="per-task restart budget (DMLC_NUM_ATTEMPT contract)")
+    p.add_argument("--env", action="append", default=[],
+                   metavar="KEY=VALUE", help="extra env passed to every task")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="command to run on every task")
+    return p
+
+
+def get_opts(argv=None) -> argparse.Namespace:
+    args = build_parser().parse_args(argv)
+    if args.cluster is None:
+        raise SystemExit("--cluster required (or set DMLC_SUBMIT_CLUSTER)")
+    if not args.command:
+        raise SystemExit("missing command to run")
+    if args.command and args.command[0] == "--":
+        args.command = args.command[1:]
+    args.worker_memory_mb = parse_memory_mb(args.worker_memory)
+    args.server_memory_mb = parse_memory_mb(args.server_memory)
+    extra = {}
+    for kv in args.env:
+        k, _, v = kv.partition("=")
+        extra[k] = v
+    args.extra_env = extra
+    return args

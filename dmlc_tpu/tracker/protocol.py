@@ -1,0 +1,118 @@
+"""Tracker wire protocol + overlay topology math.
+
+Wire format (compatible with the reference tracker protocol,
+tracker/dmlc_tracker/tracker.py:24-50): native-endian int32 frames;
+strings as [len:int32][utf8 bytes]; sessions open with an exchange of
+the magic 0xff99.
+
+Topology (tracker.py:165-252 behavior): a binomial tree over ranks
+(heap-shaped: children of r are 2r+1, 2r+2; parent (r+1)//2-1) plus a
+ring that shares edges with the tree, found by DFS; ranks are then
+relabeled to follow ring order so rank r's ring neighbours are
+(r-1, r+1) mod n — which is also what makes the contract line up with
+ICI torus neighbours when ranks map to mesh coordinates.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Dict, List, Tuple
+
+MAGIC = 0xFF99
+
+_INT = struct.Struct("@i")
+
+
+class FrameSocket:
+    """int32/string framing over a TCP socket."""
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+
+    def recv_all(self, nbytes: int) -> bytes:
+        chunks = []
+        got = 0
+        while got < nbytes:
+            c = self.sock.recv(min(nbytes - got, 65536))
+            if not c:
+                raise ConnectionError("peer closed mid-frame")
+            got += len(c)
+            chunks.append(c)
+        return b"".join(chunks)
+
+    def recv_int(self) -> int:
+        return _INT.unpack(self.recv_all(4))[0]
+
+    def send_int(self, v: int) -> None:
+        self.sock.sendall(_INT.pack(v))
+
+    def send_str(self, s: str) -> None:
+        data = s.encode()
+        self.send_int(len(data))
+        self.sock.sendall(data)
+
+    def recv_str(self) -> str:
+        return self.recv_all(self.recv_int()).decode()
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Overlay topology
+# ---------------------------------------------------------------------------
+
+def binomial_tree(n: int) -> Tuple[Dict[int, List[int]], Dict[int, int]]:
+    """Heap-shaped binomial tree: (neighbor_map, parent_map)."""
+    tree: Dict[int, List[int]] = {}
+    parent: Dict[int, int] = {}
+    for r in range(n):
+        nbrs = []
+        if r > 0:
+            nbrs.append((r + 1) // 2 - 1)
+        if 2 * r + 1 < n:
+            nbrs.append(2 * r + 1)
+        if 2 * r + 2 < n:
+            nbrs.append(2 * r + 2)
+        tree[r] = nbrs
+        parent[r] = (r + 1) // 2 - 1  # -1 for root
+    return tree, parent
+
+
+def _dfs_ring(tree: Dict[int, List[int]], parent: Dict[int, int], r: int) -> List[int]:
+    """DFS order that tends to share edges with the tree (tracker.py:193-210
+    behavior, including the reversed-last-child walk)."""
+    children = [v for v in tree[r] if v != parent[r]]
+    order = [r]
+    for i, v in enumerate(children):
+        sub = _dfs_ring(tree, parent, v)
+        if i == len(children) - 1:
+            sub.reverse()
+        order += sub
+    return order
+
+
+def link_maps(n: int):
+    """(tree_map, parent_map, ring_map) with ranks relabeled to ring order.
+
+    After relabeling, ring_map[r] == ((r-1) % n, (r+1) % n); tree edges
+    are expressed in the new labels.
+    """
+    tree, parent = binomial_tree(n)
+    order = _dfs_ring(tree, parent, 0)
+    assert len(order) == n
+    relabel = {old: new for new, old in enumerate(order)}
+    tree2 = {relabel[r]: [relabel[v] for v in vs] for r, vs in tree.items()}
+    parent2 = {
+        relabel[r]: (relabel[p] if p >= 0 else -1) for r, p in parent.items()
+    }
+    ring2 = {r: ((r - 1) % n, (r + 1) % n) for r in range(n)}
+    return tree2, parent2, ring2
+
+
+def resolve_ip(host: str) -> str:
+    return socket.getaddrinfo(host, None)[0][4][0]

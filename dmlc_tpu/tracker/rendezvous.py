@@ -1,0 +1,309 @@
+"""Rank rendezvous server (RabitTracker) + PS scheduler bootstrap.
+
+Behavioral rebuild of tracker/dmlc_tracker/tracker.py:137-433: TCP
+server on a scanned port, handshake (magic, rank, world_size, jobid,
+cmd ∈ {start, recover, shutdown, print}), batch rank assignment sorted
+by host for locality, connection brokering between peers, `recover`
+re-issuing topology to restarted workers, job wall-time logging.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import subprocess
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .protocol import MAGIC, FrameSocket, link_maps, resolve_ip
+
+logger = logging.getLogger("dmlc_tpu.tracker")
+
+
+class WorkerEntry:
+    """One accepted worker connection (SlaveEntry analog)."""
+
+    def __init__(self, sock: socket.socket, addr):
+        self.sock = FrameSocket(sock)
+        self.host = resolve_ip(addr[0])
+        magic = self.sock.recv_int()
+        if magic != MAGIC:
+            raise ConnectionError(f"invalid magic {magic:#x} from {self.host}")
+        self.sock.send_int(MAGIC)
+        self.rank = self.sock.recv_int()
+        self.world_size = self.sock.recv_int()
+        self.jobid = self.sock.recv_str()
+        self.cmd = self.sock.recv_str()
+        self.wait_accept = 0
+        self.port: Optional[int] = None
+
+    def decide_rank(self, job_map: Dict[str, int]) -> int:
+        if self.rank >= 0:
+            return self.rank
+        if self.jobid != "NULL" and self.jobid in job_map:
+            return job_map[self.jobid]
+        return -1
+
+    def assign_rank(self, rank, wait_conn, tree_map, parent_map, ring_map):
+        """Send topology, then broker peer connections until the worker
+        reports zero errors.  Returns ranks whose accept quota filled."""
+        self.rank = rank
+        nnset = set(tree_map[rank])
+        rprev, rnext = ring_map[rank]
+        self.sock.send_int(rank)
+        self.sock.send_int(parent_map[rank])
+        self.sock.send_int(len(tree_map))
+        self.sock.send_int(len(nnset))
+        for r in nnset:
+            self.sock.send_int(r)
+        if rprev != -1 and rprev != rank:
+            nnset.add(rprev)
+            self.sock.send_int(rprev)
+        else:
+            self.sock.send_int(-1)
+        if rnext != -1 and rnext != rank:
+            nnset.add(rnext)
+            self.sock.send_int(rnext)
+        else:
+            self.sock.send_int(-1)
+        while True:
+            ngood = self.sock.recv_int()
+            goodset = {self.sock.recv_int() for _ in range(ngood)}
+            assert goodset.issubset(nnset), (goodset, nnset)
+            badset = nnset - goodset
+            conset = [r for r in badset if r in wait_conn]
+            self.sock.send_int(len(conset))
+            self.sock.send_int(len(badset) - len(conset))
+            for r in conset:
+                self.sock.send_str(wait_conn[r].host)
+                self.sock.send_int(wait_conn[r].port)
+                self.sock.send_int(r)
+            nerr = self.sock.recv_int()
+            if nerr != 0:
+                continue
+            self.port = self.sock.recv_int()
+            done = []
+            for r in conset:
+                wait_conn[r].wait_accept -= 1
+                if wait_conn[r].wait_accept == 0:
+                    done.append(r)
+            for r in done:
+                wait_conn.pop(r, None)
+            self.wait_accept = len(badset) - len(conset)
+            return done
+
+
+class RabitTracker:
+    """Rendezvous server; one thread accepts workers until all shut down."""
+
+    def __init__(self, host_ip: str, n_workers: int,
+                 port: int = 9091, port_end: int = 9999):
+        family = socket.getaddrinfo(host_ip, None)[0][0]
+        sock = socket.socket(family, socket.SOCK_STREAM)
+        for p in range(port, port_end):
+            try:
+                sock.bind((host_ip, p))
+                self.port = p
+                break
+            except OSError:
+                continue
+        else:
+            raise OSError(f"no free tracker port in [{port},{port_end})")
+        sock.listen(256)
+        self.sock = sock
+        self.host_ip = host_ip
+        self.n_workers = n_workers
+        self.thread: Optional[threading.Thread] = None
+        self.start_time: Optional[float] = None
+        self.end_time: Optional[float] = None
+        logger.info("tracker listening on %s:%d", host_ip, self.port)
+
+    def worker_envs(self) -> Dict[str, str]:
+        return {
+            "DMLC_TRACKER_URI": self.host_ip,
+            "DMLC_TRACKER_PORT": str(self.port),
+        }
+
+    def _accept_loop(self, n_workers: int) -> None:
+        shutdown: Dict[int, WorkerEntry] = {}
+        wait_conn: Dict[int, WorkerEntry] = {}
+        job_map: Dict[str, int] = {}
+        pending: List[WorkerEntry] = []
+        tree_map = None
+        parent_map = ring_map = None
+        todo: List[int] = []
+
+        while len(shutdown) != n_workers:
+            fd, addr = self.sock.accept()
+            try:
+                w = WorkerEntry(fd, addr)
+            except ConnectionError as e:
+                logger.warning("rejected connection: %s", e)
+                fd.close()
+                continue
+            if w.cmd == "print":
+                logger.info("%s", w.sock.recv_str().strip())
+                continue
+            if w.cmd == "shutdown":
+                assert w.rank >= 0 and w.rank not in shutdown
+                assert w.rank not in wait_conn
+                shutdown[w.rank] = w
+                logger.debug("shutdown from rank %d", w.rank)
+                continue
+            assert w.cmd in ("start", "recover"), w.cmd
+            if tree_map is None:
+                assert w.cmd == "start"
+                if w.world_size > 0:
+                    n_workers = w.world_size
+                tree_map, parent_map, ring_map = link_maps(n_workers)
+                todo = list(range(n_workers))
+            else:
+                assert w.world_size in (-1, n_workers)
+            if w.cmd == "recover":
+                assert w.rank >= 0
+
+            rank = w.decide_rank(job_map)
+            if rank == -1:
+                assert todo, "no rank slots left"
+                pending.append(w)
+                if len(pending) == len(todo):
+                    pending.sort(key=lambda x: x.host)  # locality
+                    for p in pending:
+                        rank = todo.pop(0)
+                        if p.jobid != "NULL":
+                            job_map[p.jobid] = rank
+                        p.assign_rank(rank, wait_conn, tree_map, parent_map,
+                                      ring_map)
+                        if p.wait_accept > 0:
+                            wait_conn[rank] = p
+                        logger.debug("assigned rank %d to %s", p.rank, p.host)
+                    pending = []
+                if not todo:
+                    logger.info("@tracker all %d workers started", n_workers)
+                    self.start_time = time.time()
+            else:
+                w.assign_rank(rank, wait_conn, tree_map, parent_map, ring_map)
+                if w.wait_accept > 0:
+                    wait_conn[rank] = w
+                logger.debug("%s from rank %d", w.cmd, w.rank)
+        self.end_time = time.time()
+        if self.start_time is not None:
+            logger.info("@tracker %.3f secs between start and finish",
+                        self.end_time - self.start_time)
+
+    def start(self, n_workers: Optional[int] = None) -> None:
+        n = self.n_workers if n_workers is None else n_workers
+        self.error: Optional[BaseException] = None
+
+        def run():
+            try:
+                self._accept_loop(n)
+            except BaseException as e:  # surfaced by join()/_await_job
+                self.error = e
+                logger.error("tracker accept loop died: %s", e)
+
+        self.thread = threading.Thread(target=run, daemon=True)
+        self.thread.start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        assert self.thread is not None
+        deadline = None if timeout is None else time.time() + timeout
+        while self.thread.is_alive():
+            self.thread.join(0.1)
+            if deadline is not None and time.time() > deadline:
+                raise TimeoutError("tracker did not finish in time")
+        if self.error is not None:
+            raise RuntimeError(f"tracker failed: {self.error}") from self.error
+
+    def alive(self) -> bool:
+        return self.thread is not None and self.thread.is_alive()
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class PSTracker:
+    """Parameter-server scheduler bootstrap (tracker.py:336-386 analog):
+    runs the scheduler process locally with the PS env contract."""
+
+    def __init__(self, host_ip: str, cmd: Optional[str], envs: Dict[str, str],
+                 port: int = 9091, port_end: int = 9999):
+        self.host_ip = host_ip
+        self.cmd = cmd
+        self.thread = None
+        if cmd is None:
+            # find a free port for the scheduler without holding it
+            probe = socket.socket()
+            probe.bind((host_ip, 0))
+            self.port = probe.getsockname()[1]
+            probe.close()
+            return
+        probe = socket.socket()
+        probe.bind((host_ip, 0))
+        self.port = probe.getsockname()[1]
+        probe.close()
+        env = os.environ.copy()
+        env.update(envs)
+        env.update({
+            "DMLC_ROLE": "scheduler",
+            "DMLC_PS_ROOT_URI": str(self.host_ip),
+            "DMLC_PS_ROOT_PORT": str(self.port),
+        })
+
+        def run():
+            subprocess.check_call(self.cmd, shell=True, env=env)
+
+        self.thread = threading.Thread(target=run, daemon=True)
+        self.thread.start()
+
+    def worker_envs(self) -> Dict[str, str]:
+        return {
+            "DMLC_PS_ROOT_URI": str(self.host_ip),
+            "DMLC_PS_ROOT_PORT": str(self.port),
+        }
+
+    def join(self) -> None:
+        if self.thread is not None:
+            self.thread.join()
+
+
+def submit_job(n_workers: int, n_servers: int, fun_submit, host_ip: str = "auto",
+               pscmd: Optional[str] = None, join: bool = True):
+    """Start tracker(s), call fun_submit(n_workers, n_servers, envs), wait.
+
+    The reference's tracker.submit (tracker.py:410-433): rabit path when
+    n_servers == 0, PS path otherwise.
+    """
+    if host_ip == "auto":
+        host_ip = os.environ.get("DMLC_TRACKER_URI") or _default_host_ip()
+    envs = {"DMLC_NUM_WORKER": str(n_workers),
+            "DMLC_NUM_SERVER": str(n_servers)}
+    rabit = None
+    if n_servers == 0:
+        rabit = RabitTracker(host_ip, n_workers)
+        envs.update(rabit.worker_envs())
+        rabit.start(n_workers)
+    else:
+        ps = PSTracker(host_ip, pscmd, envs)
+        envs.update(ps.worker_envs())
+    fun_submit(n_workers, n_servers, envs)
+    if join and rabit is not None:
+        rabit.join()
+    return rabit
+
+
+def _default_host_ip() -> str:
+    """Best-effort local IP (no egress needed: UDP connect is routing-only)."""
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("10.255.255.255", 1))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"

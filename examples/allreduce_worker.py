@@ -1,0 +1,29 @@
+"""Minimal distributed worker: rendezvous through the DMLC env contract,
+tree-allreduce a vector, report through the tracker's print relay.
+
+Run under the launcher:
+    bin/dmlc-submit --cluster local --num-workers 4 -- python examples/allreduce_worker.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from dmlc_tpu.tracker.client import TrackerClient  # noqa: E402
+
+
+def main():
+    client = TrackerClient()
+    client.start()
+    out = client.allreduce_sum(np.full(4, float(client.rank + 1)))
+    expected = client.world_size * (client.world_size + 1) / 2
+    assert np.allclose(out, expected), (out, expected)
+    client.log(f"rank {client.rank}/{client.world_size}: allreduce OK -> {out[0]}")
+    client.shutdown()
+
+
+if __name__ == "__main__":
+    main()

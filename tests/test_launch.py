@@ -1,0 +1,132 @@
+"""Launcher tests: local backend end-to-end through real subprocesses,
+GangScheduler retry/blacklist semantics, command builders, CLI opts."""
+
+import os
+import subprocess
+import sys
+from types import SimpleNamespace
+
+import pytest
+
+from dmlc_tpu.tracker import launch
+from dmlc_tpu.tracker.opts import get_opts, parse_memory_mb
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_opts_parsing():
+    args = get_opts([
+        "--cluster", "local", "--num-workers", "3",
+        "--worker-memory", "2g", "--env", "FOO=bar", "--",
+        "python", "x.py", "--flag",
+    ])
+    assert args.num_workers == 3
+    assert args.worker_memory_mb == 2048
+    assert args.extra_env == {"FOO": "bar"}
+    assert args.command == ["python", "x.py", "--flag"]
+    assert parse_memory_mb("512m") == 512
+
+
+def test_local_submit_end_to_end():
+    args = get_opts([
+        "--cluster", "local", "--num-workers", "3", "--host-ip", "127.0.0.1",
+        "--", sys.executable, os.path.join(REPO, "examples",
+                                           "allreduce_worker.py"),
+    ])
+    tracker = launch.submit_local(args)
+    assert tracker is not None and not tracker.alive()
+    assert tracker.start_time is not None and tracker.end_time is not None
+    tracker.close()
+
+
+def test_cli_end_to_end():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "dmlc-submit"),
+         "--cluster", "local", "--num-workers", "2", "--host-ip", "127.0.0.1",
+         "--", sys.executable,
+         os.path.join(REPO, "examples", "allreduce_worker.py")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "allreduce OK" in r.stderr
+
+
+def test_local_retry_then_fail(tmp_path):
+    # a command that always fails must exhaust max_attempts then raise
+    args = get_opts([
+        "--cluster", "local", "--num-workers", "1", "--host-ip", "127.0.0.1",
+        "--max-attempts", "2", "--", sys.executable, "-c", "exit(1)",
+    ])
+    with pytest.raises(Exception):
+        launch.submit_local(args)
+
+
+class _FakeRunner:
+    def __init__(self, bad_hosts):
+        self.bad_hosts = set(bad_hosts)
+        self.calls = []
+
+    def __call__(self, host, role, task_id, env):
+        self.calls.append((host, role, task_id, int(env["DMLC_NUM_ATTEMPT"])))
+        return 1 if host in self.bad_hosts else 0
+
+
+def test_gang_scheduler_retries_and_blacklists():
+    runner = _FakeRunner(bad_hosts=["bad"])
+    sched = launch.GangScheduler(["bad", "good"], runner,
+                                 max_attempts=3, blacklist_after=2)
+    envs = {"DMLC_TRACKER_URI": "x", "DMLC_TRACKER_PORT": "1"}
+    sched.run_all(n_workers=3, n_servers=0, envs=envs, cluster="tpu-vm")
+    # every task eventually succeeded on 'good' (exactly one ok per task)
+    oks = [c for c in runner.calls if c[0] == "good"]
+    assert sorted(tid for _, _, tid, _ in oks) == [0, 1, 2]
+    assert "bad" in sched.blacklist
+
+
+def test_gang_scheduler_exhausts_attempts():
+    runner = _FakeRunner(bad_hosts=["h0", "h1"])
+    sched = launch.GangScheduler(["h0", "h1"], runner, max_attempts=2,
+                                 blacklist_after=99)
+    with pytest.raises(RuntimeError):
+        sched.run_task("worker", 0, {}, "tpu-vm")
+    assert len(runner.calls) == 2
+    assert [c[3] for c in runner.calls] == [0, 1]  # DMLC_NUM_ATTEMPT counts up
+
+
+def test_command_builders():
+    args = SimpleNamespace(
+        host_file=None, extra_env={"FOO": "1"}, command=["python", "w.py"],
+        queue="q", sge_log_dir=None, slurm_worker_nodes=2,
+        slurm_server_nodes=None, sync_dst_dir=None, jobname="j1",
+        worker_cores=2, server_cores=1, worker_memory_mb=1024,
+        server_memory_mb=512,
+    )
+    envs = {"DMLC_TRACKER_URI": "10.0.0.1", "DMLC_TRACKER_PORT": "9091"}
+
+    mpi = launch.build_mpi_cmd(args, envs, 4, "worker", openmpi=True)
+    assert mpi[:3] == ["mpirun", "-n", "4"]
+    assert "-x" in mpi and any("DMLC_TRACKER_URI=10.0.0.1" in t for t in mpi)
+
+    slurm = launch.build_slurm_cmd(args, envs, "worker", 4)
+    assert slurm[:3] == ["srun", "-n", "4"]
+    assert "-N" in slurm and "2" in slurm
+    assert any(t.startswith("--export=ALL,") and "DMLC_ROLE=worker" in t
+               for t in slurm)
+
+    sge = launch.build_sge_script(args, envs, "worker")
+    assert "SGE_TASK_ID - 1" in sge and "python w.py" in sge
+
+    ssh = launch.build_ssh_cmd("host1:2222", ["python", "w.py"],
+                               {"DMLC_ROLE": "worker", "SECRET": "no"})
+    assert ssh[:2] == ["ssh", "-o"]
+    assert "-p" in ssh and "2222" in ssh
+    remote = ssh[-1]
+    assert "DMLC_ROLE" in remote and "SECRET" not in remote
+
+
+def test_submit_dispatch_routes_all_clusters():
+    from dmlc_tpu.tracker.submit import DISPATCH
+
+    for c in ["local", "ssh", "mpi", "sge", "slurm", "tpu-vm", "yarn",
+              "mesos"]:
+        assert c in DISPATCH

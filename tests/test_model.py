@@ -82,7 +82,6 @@ def test_gradients_match_oracle(mesh):
     got = jax.jit(gfn)(params, ids, labels)
     want = jax.grad(lambda q: unsharded_loss(q, ids, labels, CFG))(params)
     flat_g, _ = jax.tree.flatten(got)
-    flat_w, tree = jax.tree.flatten(want)
     paths = jax.tree.flatten_with_path(want)[0]
     for (path, w), g in zip(paths, flat_g):
         np.testing.assert_allclose(
