@@ -1,0 +1,15 @@
+"""Host→HBM device feed: InputSplit partitions to sharded jax.Arrays.
+
+The TPU bridge the reference never had (SURVEY.md §7 stage 7): RowBlocks
+and RecordIO payloads stream from partitioned ingestion straight into
+device memory with ICI-topology-aware sharding — part_index is the
+flattened (dp, sp) mesh coordinate (parallel.mesh.MeshConfig) — and
+double-buffered prefetch mirroring ThreadedInputSplit.
+"""
+
+from .device_feed import (  # noqa: F401
+    DeviceFeed,
+    libsvm_feed,
+    pack_rowblock,
+    recordio_feed,
+)

@@ -1,0 +1,237 @@
+"""Sharded device feeds.
+
+Design (TPU-first):
+  * each data-bearing mesh coordinate (dp, sp) maps to one InputSplit
+    partition: part_index = dp * sp_size + sp (the same
+    part_index/num_parts contract as the reference's InputSplit,
+    src/io/input_split_base.cc:30-64, lifted onto the mesh);
+  * batches are packed into STATIC shapes (pad/truncate) so XLA compiles
+    one program — no data-dependent shapes;
+  * a producer thread assembles the next global batch and dispatches
+    device transfer while the consumer computes on the current one
+    (double buffering, capacity-2 queue — ThreadedInputSplit behavior,
+    src/io/threaded_input_split.h:23-101);
+  * throughput is logged every 10 MB like the reference's iterators
+    (src/data/basic_row_iter.h:68-75).
+"""
+
+from __future__ import annotations
+
+import threading
+from queue import Queue
+from typing import Callable, Dict, Iterator, Optional
+
+import numpy as np
+
+from ..base import check
+from ..parallel.mesh import AXIS_DP, AXIS_SP, mesh_config
+
+
+def pack_rowblock(blk, batch_size: int, max_nnz: int, num_col: int = 0):
+    """RowBlock (CSR) → fixed-shape dense-index batch dict.
+
+    Returns {label [B], value [B,K], index [B,K], mask [B,K]} float32/int32,
+    rows padded (mask 0) or truncated to K = max_nnz.  Static shapes keep
+    XLA from recompiling per batch.  When num_col > 0, feature indices are
+    clamped to [0, num_col) so downstream gathers into a [num_col] weight
+    vector are always in bounds.
+    """
+    b = min(batch_size, blk.size)
+    label = np.zeros(batch_size, np.float32)
+    value = np.zeros((batch_size, max_nnz), np.float32)
+    index = np.zeros((batch_size, max_nnz), np.int32)
+    mask = np.zeros((batch_size, max_nnz), np.float32)
+    label[:b] = blk.label[:b]
+    offsets = blk.offset
+    for i in range(b):
+        lo, hi = int(offsets[i]), int(offsets[i + 1])
+        k = min(hi - lo, max_nnz)
+        value[i, :k] = blk.value[lo : lo + k]
+        index[i, :k] = blk.index[lo : lo + k]
+        mask[i, :k] = 1.0
+    if num_col > 0:
+        np.minimum(index, num_col - 1, out=index)
+    return {"label": label, "value": value, "index": index, "mask": mask}
+
+
+class DeviceFeed:
+    """Assemble per-partition host batches into one sharded global array.
+
+    ``part_iters``: list of host-side iterators (one per local data
+    partition, in mesh part_index order for this process's addressable
+    devices) yielding dicts of equal-shaped np arrays.  Batches are
+    stacked on the leading axis and placed with a NamedSharding over the
+    data axes, so the leading dim of the global batch is
+    n_parts * per_part_batch.
+    """
+
+    def __init__(self, mesh, part_iters, *, queue_depth: int = 2,
+                 axes=(AXIS_DP, AXIS_SP), log_every_mb: int = 10):
+        import jax
+
+        self.mesh = mesh
+        self.part_iters = part_iters
+        cfg = mesh_config(mesh)
+        n_parts = 1
+        for a in axes:
+            n_parts *= cfg.axis_size(a)
+        check(len(part_iters) == n_parts,
+              f"need {n_parts} partition iterators, got {len(part_iters)}")
+        self.sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(axes)
+        )
+        self._queue: Queue = Queue(maxsize=queue_depth)
+        self._part_done = [False] * len(part_iters)
+        self._template: Optional[Dict[str, np.ndarray]] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._log_every = log_every_mb << 20
+        self._bytes = 0
+        self._last_log = 0
+        self._t0 = None
+
+    # ---- producer ------------------------------------------------------
+    def _assemble(self) -> Optional[Dict[str, "np.ndarray"]]:
+        """Next global batch, or None at epoch end.
+
+        Byte-range partitions hold unequal record counts, so shards drain
+        at different times; drained partitions contribute all-zero
+        (masked-out) batches until every partition is done — SPMD shards
+        step in lockstep AND no records are dropped at the epoch tail."""
+        parts: list = [None] * len(self.part_iters)
+        alive = 0
+        for i, it in enumerate(self.part_iters):
+            if not self._part_done[i]:
+                batch = next(it, None)
+                if batch is None:
+                    self._part_done[i] = True
+                else:
+                    parts[i] = batch
+                    alive += 1
+                    if self._template is None:
+                        self._template = {
+                            k: np.zeros_like(v) for k, v in batch.items()
+                        }
+        if alive == 0:
+            return None
+        for i, p in enumerate(parts):
+            if p is None:
+                parts[i] = self._template
+        keys = parts[0].keys()
+        return {k: np.concatenate([p[k] for p in parts], axis=0)
+                for k in keys}
+
+    def _produce(self):
+        import time
+
+        import jax
+
+        self._t0 = time.perf_counter()
+        while not self._stop.is_set():
+            host = self._assemble()
+            if host is None:
+                self._queue.put(None)
+                return
+            dev = {k: jax.device_put(v, self.sharding)
+                   for k, v in host.items()}
+            self._bytes += sum(v.nbytes for v in host.values())
+            if self._bytes - self._last_log >= self._log_every:
+                dt = time.perf_counter() - self._t0
+                from ..logging import info
+
+                info(
+                    f"feed: {self._bytes / 1e6:.0f} MB to device, "
+                    f"{self._bytes / 1e6 / dt:.2f} MB/sec"
+                )
+                self._last_log = self._bytes
+            self._queue.put(dev)
+
+    # ---- consumer ------------------------------------------------------
+    def __iter__(self) -> Iterator[Dict[str, "object"]]:
+        if self._thread is not None:
+            raise RuntimeError(
+                "DeviceFeed is single-epoch: create a fresh feed per epoch "
+                "(the partition iterators are already exhausted)"
+            )
+        self._thread = threading.Thread(target=self._produce, daemon=True)
+        self._thread.start()
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            yield item
+
+    def close(self):
+        self._stop.set()
+        # drain so the producer can observe the stop flag
+        while not self._queue.empty():
+            self._queue.get_nowait()
+
+    @property
+    def bytes_fed(self) -> int:
+        return self._bytes
+
+
+def libsvm_feed(uri: str, mesh, *, batch_size: int, max_nnz: int,
+                fmt: str = "libsvm", queue_depth: int = 2) -> DeviceFeed:
+    """Sparse text formats (libsvm/csv/libfm) → sharded padded-CSR batches.
+
+    ``batch_size`` is per partition; the global leading dim is
+    batch_size * dp_size * sp_size.
+    """
+    from ..data import create_row_iter
+
+    cfg = mesh_config(mesh)
+    n_parts = cfg.data_parts
+
+    def part_iter(part: int):
+        # one epoch; the caller creates a fresh feed per epoch
+        it = create_row_iter(uri, part, n_parts, fmt)
+        ncol = it.num_col()
+        for blk in it:
+            # re-slice parser blocks into fixed batches
+            for lo in range(0, blk.size, batch_size):
+                sub = blk.slice(lo, min(lo + batch_size, blk.size))
+                yield pack_rowblock(sub, batch_size, max_nnz, ncol)
+
+    iters = [part_iter(p) for p in range(n_parts)]
+    return DeviceFeed(mesh, iters, queue_depth=queue_depth)
+
+
+def recordio_feed(uri: str, mesh, *, batch_records: int, max_bytes: int,
+                  queue_depth: int = 2) -> DeviceFeed:
+    """RecordIO shards → {data [B, max_bytes] uint8, length [B] int32}.
+
+    Payload decode (e.g. images) happens on device or downstream; this
+    feed moves raw record bytes into HBM at full InputSplit throughput.
+    """
+    from ..io import input_split
+
+    cfg = mesh_config(mesh)
+    n_parts = cfg.data_parts
+
+    def part_iter(part: int):
+        split = input_split.create(uri, part, n_parts, "recordio")
+        try:
+            while True:
+                data = np.zeros((batch_records, max_bytes), np.uint8)
+                length = np.zeros(batch_records, np.int32)
+                got = 0
+                while got < batch_records:
+                    rec = split.next_record()
+                    if rec is None:
+                        break
+                    n = min(len(rec), max_bytes)
+                    data[got, :n] = np.frombuffer(rec, np.uint8, n)
+                    length[got] = n
+                    got += 1
+                if got == 0:
+                    return
+                yield {"data": data, "length": length}
+                if got < batch_records:
+                    return
+        finally:
+            split.close()
+
+    iters = [part_iter(p) for p in range(n_parts)]
+    return DeviceFeed(mesh, iters, queue_depth=queue_depth)

@@ -143,21 +143,26 @@ class TrackerClient:
         n = fs.recv_int()
         return np.frombuffer(fs.recv_all(n), dtype=like.dtype).reshape(like.shape)
 
-    def allreduce_sum(self, arr: np.ndarray) -> np.ndarray:
-        """Binomial-tree allreduce (reduce to root, broadcast back)."""
+    def allreduce(self, arr: np.ndarray, op: str = "sum") -> np.ndarray:
+        """Binomial-tree allreduce (reduce to root, broadcast back).
+        op ∈ {sum, max, min}."""
+        fold = {"sum": np.add, "max": np.maximum, "min": np.minimum}[op]
         arr = np.ascontiguousarray(arr)
         if self.world_size <= 1:
             return arr.copy()
         children = [r for r in self.tree_nbrs if r != self.parent]
         acc = arr.astype(arr.dtype, copy=True)
         for c in children:
-            acc += self._recv_array(self.links[c], acc)
+            acc = fold(acc, self._recv_array(self.links[c], acc))
         if self.parent >= 0:
             self._send_array(self.links[self.parent], acc)
             acc = self._recv_array(self.links[self.parent], acc)
         for c in children:
             self._send_array(self.links[c], acc)
         return acc
+
+    def allreduce_sum(self, arr: np.ndarray) -> np.ndarray:
+        return self.allreduce(arr, "sum")
 
     def broadcast(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
         """Tree broadcast from root (root's value wins everywhere)."""

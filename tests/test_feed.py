@@ -1,0 +1,100 @@
+"""Device feed: InputSplit partitions → sharded jax.Arrays on the
+8-device virtual mesh, with prefetch and correct partition placement."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from dmlc_tpu.feed import DeviceFeed, libsvm_feed, pack_rowblock, recordio_feed
+from dmlc_tpu.parallel import build_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    # dp=4, sp=2 -> 8 data partitions, tp/pp/ep trivial
+    return build_mesh(8, dp=4, sp=2, tp=1, pp=1, ep=1)
+
+
+def _write_libsvm(tmp_path, rows=64):
+    lines = []
+    for i in range(rows):
+        lines.append(f"{i % 2} 0:{i}.0 3:{i + 0.5}")
+    p = tmp_path / "train.libsvm"
+    p.write_text("\n".join(lines) + "\n")
+    return str(p)
+
+
+def test_pack_rowblock_shapes():
+    from dmlc_tpu.data.row_block import RowBlockContainer
+
+    c = RowBlockContainer()
+    c.push_arrays(
+        labels=np.array([1.0, 0.0], np.float32),
+        offsets=np.array([0, 2, 5], np.uint64),
+        index=np.array([0, 3, 1, 2, 4], np.uint32),
+        value=np.array([1, 2, 3, 4, 5], np.float32),
+    )
+    blk = c.get_block()
+    out = pack_rowblock(blk, batch_size=4, max_nnz=3, num_col=5)
+    assert out["value"].shape == (4, 3)
+    np.testing.assert_allclose(out["label"], [1, 0, 0, 0])
+    np.testing.assert_allclose(out["value"][0], [1, 2, 0])
+    np.testing.assert_allclose(out["mask"][1], [1, 1, 1])  # truncated row
+    np.testing.assert_allclose(out["value"][1], [3, 4, 5])
+
+
+def test_libsvm_feed_shards_batches(tmp_path, mesh):
+    uri = _write_libsvm(tmp_path, rows=64)
+    feed = libsvm_feed(uri, mesh, batch_size=2, max_nnz=4)
+    batches = list(feed)
+    assert batches, "no batches produced"
+    for b in batches:
+        # global leading dim = 8 parts * 2 per-part rows
+        assert b["value"].shape == (16, 4)
+        assert b["value"].sharding.is_equivalent_to(feed.sharding, 2)
+        # every shard sits on a distinct device
+        assert len(b["value"].sharding.device_set) == 8
+        assert set(np.unique(np.asarray(b["label"]))) <= {0.0, 1.0}
+    assert feed.bytes_fed > 0
+
+
+def test_libsvm_feed_covers_all_rows(tmp_path, mesh):
+    # labels encode row parity; check the feed covers every partition's rows
+    uri = _write_libsvm(tmp_path, rows=64)
+    feed = libsvm_feed(uri, mesh, batch_size=8, max_nnz=4)
+    values = []
+    for b in feed:
+        v = np.asarray(b["value"])
+        m = np.asarray(b["mask"])
+        values.append(v[:, 0][m[:, 0] > 0])
+    seen = np.concatenate(values)
+    # every row i carries feature value i.0 at position 0
+    assert set(seen.astype(int)) == set(range(64))
+
+
+def test_recordio_feed(tmp_path, mesh):
+    from dmlc_tpu.io.recordio import RecordIOWriter
+    from dmlc_tpu.io.stream import Stream
+
+    path = str(tmp_path / "data.rec")
+    with Stream.create(path, "w") as s:
+        w = RecordIOWriter(s)
+        for i in range(128):
+            w.write_record(bytes([i % 256]) * (10 + i % 7))
+    feed = recordio_feed(path, mesh, batch_records=4, max_bytes=32)
+    total = 0
+    for b in feed:
+        assert b["data"].shape == (32, 32)
+        assert len(b["data"].sharding.device_set) == 8
+        total += int(np.sum(np.asarray(b["length"]) > 0))
+    assert total == 128
+
+
+def test_feed_epoch_ends_cleanly(tmp_path, mesh):
+    uri = _write_libsvm(tmp_path, rows=16)
+    feed = libsvm_feed(uri, mesh, batch_size=2, max_nnz=4)
+    n1 = len(list(feed))
+    feed2 = libsvm_feed(uri, mesh, batch_size=2, max_nnz=4)
+    n2 = len(list(feed2))
+    assert n1 == n2 > 0

@@ -3,6 +3,7 @@ GangScheduler retry/blacklist semantics, command builders, CLI opts."""
 
 import os
 import subprocess
+import numpy as np
 import sys
 from types import SimpleNamespace
 
@@ -122,6 +123,30 @@ def test_command_builders():
     assert "-p" in ssh and "2222" in ssh
     remote = ssh[-1]
     assert "DMLC_ROLE" in remote and "SECRET" not in remote
+
+
+def test_train_libsvm_end_to_end(tmp_path):
+    """SURVEY §7 minimum slice: launcher + partitioned ingest + JAX grads
+    + tracker allreduce, 2 workers."""
+    rng = np.random.default_rng(0)
+    lines = []
+    for _ in range(200):
+        x = rng.normal(size=4)
+        y = int(x @ [1.0, -2.0, 0.5, 1.5] > 0)
+        feats = " ".join(f"{j}:{x[j]:.3f}" for j in range(4))
+        lines.append(f"{y} {feats}")
+    data = tmp_path / "train.libsvm"
+    data.write_text("\n".join(lines) + "\n")
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "dmlc-submit"),
+         "--cluster", "local", "--num-workers", "2", "--host-ip",
+         "127.0.0.1", "--", sys.executable,
+         os.path.join(REPO, "examples", "train_libsvm.py"), str(data), "2"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "epoch 1 loss" in r.stderr
 
 
 def test_submit_dispatch_routes_all_clusters():
