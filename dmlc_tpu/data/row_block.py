@@ -134,29 +134,33 @@ class RowBlock:
 
 
 class RowBlockContainer:
-    """Owning growable CSR container (src/data/row_block.h:26-205)."""
+    """Owning growable CSR container (src/data/row_block.h:26-205).
+
+    Storage is segment-based: each push appends numpy arrays; get_block
+    concatenates once.  This keeps the parse hot path free of
+    numpy→list→numpy round trips (the native parsers hand whole chunks
+    as arrays)."""
+
+    _FIELDS = ("label", "weight", "qid", "field", "index", "value")
 
     def __init__(self, index_dtype=index_t):
         self._idt = np.dtype(index_dtype)
         self.clear()
 
     def clear(self) -> None:
-        self.offset = [0]
-        self.label = []
-        self.weight = []
-        self.qid = []
-        self.field = []
-        self.index = []
-        self.value = []
+        self._segs = {k: [] for k in self._FIELDS}
+        self._off_segs: list = []
+        self._nrows = 0
+        self._nnz = 0
         self.max_field = 0
         self.max_index = 0
 
     @property
     def size(self) -> int:
-        return len(self.offset) - 1
+        return self._nrows
 
     def mem_cost_bytes(self) -> int:
-        return 8 * len(self.offset) + 4 * len(self.label) + 4 * len(self.index) + 4 * len(self.value)
+        return 8 * (self._nrows + 1) + 4 * self._nrows + 8 * self._nnz
 
     def push(
         self,
@@ -168,21 +172,15 @@ class RowBlockContainer:
         field: Optional[Sequence[int]] = None,
     ) -> None:
         """Push one row (row_block.h:110-140); tracks max_index/max_field."""
-        self.label.append(label)
-        if weight is not None:
-            self.weight.append(weight)
-        if qid is not None:
-            self.qid.append(qid)
-        self.index.extend(index)
-        if len(index):
-            self.max_index = max(self.max_index, int(max(index)))
-        if value is not None:
-            self.value.extend(value)
-        if field is not None:
-            self.field.extend(field)
-            if len(field):
-                self.max_field = max(self.max_field, int(max(field)))
-        self.offset.append(len(self.index))
+        self.push_arrays(
+            labels=np.asarray([label], dtype=real_t),
+            offsets=np.asarray([0, len(index)], dtype=np.uint64),
+            index=np.asarray(index, dtype=self._idt),
+            value=None if value is None else np.asarray(value, dtype=real_t),
+            weight=None if weight is None else np.asarray([weight], real_t),
+            qid=None if qid is None else np.asarray([qid], np.uint64),
+            field=None if field is None else np.asarray(field, self._idt),
+        )
 
     def push_arrays(
         self,
@@ -192,46 +190,102 @@ class RowBlockContainer:
         value: Optional[np.ndarray] = None,
         weight: Optional[np.ndarray] = None,
         field: Optional[np.ndarray] = None,
+        qid: Optional[np.ndarray] = None,
     ) -> None:
         """Bulk push of a parsed chunk (vectorized analog of
         Push(RowBlock), row_block.h:142-179)."""
-        base = self.offset[-1]
-        self.offset.extend((offsets[1:] + base).tolist())
-        self.label.extend(labels.tolist())
-        self.index.extend(index.tolist())
+        self._off_segs.append(
+            np.asarray(offsets[1:], np.uint64) + np.uint64(self._nnz))
+        self._segs["label"].append(np.asarray(labels, real_t))
+        self._segs["index"].append(np.asarray(index, self._idt))
+        self._nrows += len(labels)
+        self._nnz += len(index)
         if index.size:
             self.max_index = max(self.max_index, int(index.max()))
         if value is not None:
-            self.value.extend(value.tolist())
+            self._segs["value"].append(np.asarray(value, real_t))
         if weight is not None:
-            self.weight.extend(weight.tolist())
+            self._segs["weight"].append(np.asarray(weight, real_t))
+        if qid is not None:
+            self._segs["qid"].append(np.asarray(qid, np.uint64))
         if field is not None:
-            self.field.extend(field.tolist())
+            field = np.asarray(field, self._idt)
+            self._segs["field"].append(field)
             if field.size:
                 self.max_field = max(self.max_field, int(field.max()))
 
+    # read-only views (the reference exposes its vectors publicly,
+    # row_block.h:30-44)
+    @property
+    def offset(self):
+        out = np.empty(self._nrows + 1, np.uint64)
+        out[0] = 0
+        if self._off_segs:
+            np.concatenate(self._off_segs, out=out[1:])
+        return out.tolist()
+
+    @property
+    def label(self) -> np.ndarray:
+        return self._cat("label", real_t)
+
+    @property
+    def index(self) -> np.ndarray:
+        return self._cat("index", self._idt)
+
+    @property
+    def value(self) -> np.ndarray:
+        return self._cat("value", real_t)
+
+    @property
+    def weight(self) -> np.ndarray:
+        return self._cat("weight", real_t)
+
+    @property
+    def field(self) -> np.ndarray:
+        return self._cat("field", self._idt)
+
+    def _cat(self, name: str, dtype) -> np.ndarray:
+        segs = self._segs[name]
+        if not segs:
+            return np.empty(0, dtype)
+        if len(segs) == 1:
+            return np.asarray(segs[0], dtype)
+        return np.concatenate(segs).astype(dtype, copy=False)
+
     def get_block(self) -> RowBlock:
         """Freeze into a RowBlock view (row_block.h:87-108)."""
-        n = self.size
-        nval = len(self.index)
+        n = self._nrows
+        nval = self._nnz
+        offset = np.empty(n + 1, np.uint64)
+        offset[0] = 0
+        if self._off_segs:
+            np.concatenate(self._off_segs, out=offset[1:])
+        weight = self._cat("weight", real_t)
+        qid = self._cat("qid", np.uint64)
+        field = self._cat("field", self._idt)
+        value = self._cat("value", real_t)
         return RowBlock(
-            offset=np.asarray(self.offset, dtype=np.uint64),
-            label=np.asarray(self.label, dtype=real_t),
-            weight=np.asarray(self.weight, dtype=real_t) if len(self.weight) == n and n else None,
-            qid=np.asarray(self.qid, dtype=np.uint64) if len(self.qid) == n and n else None,
-            field=np.asarray(self.field, dtype=self._idt) if len(self.field) == nval and nval else None,
-            index=np.asarray(self.index, dtype=self._idt),
-            value=np.asarray(self.value, dtype=real_t) if len(self.value) == nval and nval else None,
+            offset=offset,
+            label=self._cat("label", real_t),
+            weight=weight if len(weight) == n and n else None,
+            qid=qid if len(qid) == n and n else None,
+            field=field if len(field) == nval and nval else None,
+            index=self._cat("index", self._idt),
+            value=value if len(value) == nval and nval else None,
         )
 
     # ---- binary round trip, reference wire format (row_block.h:183-203)
     def save(self, strm) -> None:
-        ser.write_array(strm, np.asarray(self.offset, dtype=np.uint64))
-        ser.write_array(strm, np.asarray(self.label, dtype=real_t))
-        ser.write_array(strm, np.asarray(self.weight, dtype=real_t))
-        ser.write_array(strm, np.asarray(self.field, dtype=self._idt))
-        ser.write_array(strm, np.asarray(self.index, dtype=self._idt))
-        ser.write_array(strm, np.asarray(self.value, dtype=real_t))
+        offset = np.empty(self._nrows + 1, np.uint64)
+        offset[0] = 0
+        if self._off_segs:
+            np.concatenate(self._off_segs, out=offset[1:])
+        ser.write_array(strm, offset)
+        ser.write_array(strm, self._cat("label", real_t))
+        ser.write_array(strm, self._cat("weight", real_t))
+        ser.write_array(strm, self._cat("field", self._idt))
+        ser.write_array(strm, self._cat("index", self._idt))
+        ser.write_array(strm, self._cat("value", real_t))
         strm.write(np.asarray([self.max_field, self.max_index], dtype=self._idt).tobytes())
 
     def load(self, strm) -> bool:
@@ -242,12 +296,21 @@ class RowBlockContainer:
         import struct as _struct
 
         (n,) = _struct.unpack("<Q", head)
-        self.offset = np.frombuffer(strm.read_exact(8 * n), dtype=np.uint64).tolist()
-        self.label = ser.read_array(strm, real_t).tolist()
-        self.weight = ser.read_array(strm, real_t).tolist()
-        self.field = ser.read_array(strm, self._idt).tolist()
-        self.index = ser.read_array(strm, self._idt).tolist()
-        self.value = ser.read_array(strm, real_t).tolist()
+        self.clear()
+        offset = np.frombuffer(strm.read_exact(8 * n), dtype=np.uint64)
+        label = ser.read_array(strm, real_t)
+        weight = ser.read_array(strm, real_t)
+        field = ser.read_array(strm, self._idt)
+        index = ser.read_array(strm, self._idt)
+        value = ser.read_array(strm, real_t)
+        self._off_segs = [offset[1:].copy()] if n > 1 else []
+        self._segs["label"] = [label]
+        self._segs["weight"] = [weight] if weight.size else []
+        self._segs["field"] = [field] if field.size else []
+        self._segs["index"] = [index]
+        self._segs["value"] = [value] if value.size else []
+        self._nrows = len(label)
+        self._nnz = len(index)
         tail = np.frombuffer(strm.read_exact(2 * self._idt.itemsize), dtype=self._idt)
         self.max_field, self.max_index = int(tail[0]), int(tail[1])
         return True

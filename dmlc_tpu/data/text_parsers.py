@@ -16,6 +16,7 @@ import numpy as np
 
 from ..base import DMLCError, check
 from ..param import Parameter, field
+from .. import native
 from .parser import TextParserBase, register_parser
 from .row_block import RowBlockContainer, real_t
 from ..io import input_split as isplit
@@ -28,6 +29,22 @@ class LibSVMParser(TextParserBase):
     (libsvm_parser.h:35-90)."""
 
     def parse_chunk(self, data: bytes, out: RowBlockContainer) -> None:
+        try:
+            parsed = native.parse_libsvm(data)
+        except ValueError as e:
+            raise DMLCError(str(e)) from e
+        if parsed is not None:
+            out.push_arrays(
+                labels=parsed["labels"],
+                offsets=parsed["offsets"],
+                index=parsed["index"].astype(out._idt, copy=False),
+                value=parsed["value"],
+                weight=parsed["weights"],
+            )
+            return
+        self._parse_chunk_py(data, out)
+
+    def _parse_chunk_py(self, data: bytes, out: RowBlockContainer) -> None:
         labels = []
         weights = []
         indices = []
@@ -80,6 +97,15 @@ class CSVParser(TextParserBase):
 
     def parse_chunk(self, data: bytes, out: RowBlockContainer) -> None:
         delim = self.param.delimiter.encode()
+        try:
+            arr = native.parse_csv(data, delim) if len(delim) == 1 else None
+        except ValueError as e:
+            raise DMLCError(str(e)) from e
+        if arr is not None:
+            if arr.size == 0:
+                return
+            self._push_dense(arr, out)
+            return
         lines = [ln for ln in data.split(b"\n") if ln.strip()]
         if not lines:
             return
@@ -100,18 +126,21 @@ class CSVParser(TextParserBase):
             )
         except ValueError as e:
             raise DMLCError(f"CSV: non-numeric cell: {e}") from e
-        arr = arr.reshape(len(lines), ncol)
+        self._push_dense(arr.reshape(len(lines), ncol), out)
+
+    def _push_dense(self, arr: np.ndarray, out: RowBlockContainer) -> None:
+        nrow, ncol = arr.shape
         lc = self.param.label_column
         if lc >= 0:
             check(lc < ncol, f"label_column {lc} >= num columns {ncol}")
             labels = arr[:, lc].astype(real_t)
             feats = np.delete(arr, lc, axis=1)
         else:
-            labels = np.zeros(len(lines), dtype=real_t)
+            labels = np.zeros(nrow, dtype=real_t)
             feats = arr
         nfeat = feats.shape[1]
-        index = np.tile(np.arange(nfeat, dtype=out._idt), len(lines))
-        offsets = np.arange(len(lines) + 1, dtype=np.uint64) * nfeat
+        index = np.tile(np.arange(nfeat, dtype=out._idt), nrow)
+        offsets = np.arange(nrow + 1, dtype=np.uint64) * nfeat
         out.push_arrays(
             labels=labels,
             offsets=offsets,
@@ -124,6 +153,23 @@ class LibFMParser(TextParserBase):
     """``label[:weight] field:index:value ...`` (libfm_parser.h:35-96)."""
 
     def parse_chunk(self, data: bytes, out: RowBlockContainer) -> None:
+        try:
+            parsed = native.parse_libfm(data)
+        except ValueError as e:
+            raise DMLCError(str(e)) from e
+        if parsed is not None:
+            out.push_arrays(
+                labels=parsed["labels"],
+                offsets=parsed["offsets"],
+                index=parsed["index"].astype(out._idt, copy=False),
+                value=parsed["value"],
+                weight=parsed["weights"],
+                field=parsed["fields"].astype(out._idt, copy=False),
+            )
+            return
+        self._parse_chunk_py(data, out)
+
+    def _parse_chunk_py(self, data: bytes, out: RowBlockContainer) -> None:
         labels = []
         weights = []
         fields = []

@@ -27,6 +27,13 @@ from ..base import check
 from ..parallel.mesh import AXIS_DP, AXIS_SP, mesh_config
 
 
+class _ProducerError:
+    """Wraps a producer-thread exception for re-raise on the consumer."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
 def pack_rowblock(blk, batch_size: int, max_nnz: int, num_col: int = 0):
     """RowBlock (CSR) → fixed-shape dense-index batch dict.
 
@@ -127,24 +134,27 @@ class DeviceFeed:
         import jax
 
         self._t0 = time.perf_counter()
-        while not self._stop.is_set():
-            host = self._assemble()
-            if host is None:
-                self._queue.put(None)
-                return
-            dev = {k: jax.device_put(v, self.sharding)
-                   for k, v in host.items()}
-            self._bytes += sum(v.nbytes for v in host.values())
-            if self._bytes - self._last_log >= self._log_every:
-                dt = time.perf_counter() - self._t0
-                from ..logging import info
+        try:
+            while not self._stop.is_set():
+                host = self._assemble()
+                if host is None:
+                    self._queue.put(None)
+                    return
+                dev = {k: jax.device_put(v, self.sharding)
+                       for k, v in host.items()}
+                self._bytes += sum(v.nbytes for v in host.values())
+                if self._bytes - self._last_log >= self._log_every:
+                    dt = time.perf_counter() - self._t0
+                    from ..logging import info
 
-                info(
-                    f"feed: {self._bytes / 1e6:.0f} MB to device, "
-                    f"{self._bytes / 1e6 / dt:.2f} MB/sec"
-                )
-                self._last_log = self._bytes
-            self._queue.put(dev)
+                    info(
+                        f"feed: {self._bytes / 1e6:.0f} MB to device, "
+                        f"{self._bytes / 1e6 / dt:.2f} MB/sec"
+                    )
+                    self._last_log = self._bytes
+                self._queue.put(dev)
+        except BaseException as e:  # surface on the consumer side
+            self._queue.put(_ProducerError(e))
 
     # ---- consumer ------------------------------------------------------
     def __iter__(self) -> Iterator[Dict[str, "object"]]:
@@ -159,6 +169,8 @@ class DeviceFeed:
             item = self._queue.get()
             if item is None:
                 return
+            if isinstance(item, _ProducerError):
+                raise item.exc
             yield item
 
     def close(self):

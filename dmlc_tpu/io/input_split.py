@@ -33,6 +33,7 @@ from bisect import bisect_right
 from typing import List, Optional, Tuple
 
 from ..base import DMLCError, check
+from .. import native
 from .filesys import FileInfo, FileSystem
 from .recordio import KMAGIC, decode_flag, decode_length
 from .stream import SeekStream
@@ -60,12 +61,14 @@ class ChunkCursor:
     """A loaded chunk plus an extraction cursor (Chunk + Blob walking,
     input_split_base.h:74-95)."""
 
-    __slots__ = ("data", "pos", "end")
+    __slots__ = ("data", "pos", "end", "spans", "span_i")
 
-    def __init__(self, data: bytes):
+    def __init__(self, data, end: Optional[int] = None):
         self.data = data
         self.pos = 0
-        self.end = len(data)
+        self.end = len(data) if end is None else end
+        self.spans = None   # native whole-chunk scan cache (recordio)
+        self.span_i = 0
 
 
 class InputSplit:
@@ -131,6 +134,29 @@ class InputSplitBase(InputSplit):
         self._offset_curr = 0
         self._overflow = b""
         self._pending: Optional[ChunkCursor] = None
+        self._served: Optional[ChunkCursor] = None
+        # free-list of full-size chunk buffers (the reference recycles
+        # chunks through ThreadedIter, threadediter.h Recycle); buffers are
+        # fixed-size and never resized, so stale Blob views see reused
+        # bytes (reference semantics) rather than raising
+        self._pool: List[bytearray] = []
+
+    # ---- chunk buffer pool ---------------------------------------------
+    def _take_buf(self, size: int) -> bytearray:
+        # pooled buffers must match exactly: hint_chunk_size may have
+        # changed _chunk_bytes since a buffer was pooled, and a short
+        # buffer would be misread as a partition tail
+        if self._pool and len(self._pool[-1]) == size:
+            return self._pool.pop()
+        return bytearray(size)
+
+    def recycle_chunk(self, chunk) -> None:
+        """Return a consumed chunk's buffer for reuse.  The chunk's records
+        (Blobs) become invalid, matching io.h NextRecord semantics."""
+        buf = chunk.data if isinstance(chunk, ChunkCursor) else chunk
+        if isinstance(buf, bytearray) and len(buf) == self._chunk_bytes \
+                and len(self._pool) < 4:
+            self._pool.append(buf)
 
     # ---- URI expansion (input_split_base.cc:96-175) ---------------------
     @staticmethod
@@ -202,11 +228,12 @@ class InputSplitBase(InputSplit):
         return the number of bytes skipped."""
         raise NotImplementedError
 
-    def find_last_record_begin(self, buf) -> int:
-        """Return the offset of the last record start within buf (0 if none).
+    def find_last_record_begin(self, buf, end: int) -> int:
+        """Return the offset of the last record start within buf[:end]
+        (0 if none).
 
         ``buf`` is bytes-like with find/rfind (bytes or bytearray — the hot
-        path passes the chunk bytearray to avoid a full copy)."""
+        path passes the full pooled chunk buffer; only [:end] is valid)."""
         raise NotImplementedError
 
     def extract_next_record(self, chunk: ChunkCursor) -> Optional[memoryview]:
@@ -255,7 +282,12 @@ class InputSplitBase(InputSplit):
         self._fs.seek(self._offset_begin - self._file_offset[self._file_ptr])
         self._offset_curr = self._offset_begin
         self._overflow = b""
-        self._pending = None
+        if self._pending is not None:
+            self.recycle_chunk(self._pending)
+            self._pending = None
+        if self._served is not None:
+            self.recycle_chunk(self._served)
+            self._served = None
 
     # ---- reading (input_split_base.cc:177-239) --------------------------
     def read(self, size: int) -> bytes:
@@ -295,48 +327,76 @@ class InputSplitBase(InputSplit):
                 self._fs = self._filesys.open_for_read(self._files[self._file_ptr].path)
         return done
 
-    def read_chunk(self, max_size: int):
-        """One chunk (bytes-like) with overflow carry. Returns None at EOF;
-        an empty buffer when the overflow alone exceeds ``max_size``
-        (caller must grow the buffer).
+    _GROW = "grow"  # sentinel: overflow exceeds the buffer, caller doubles
 
-        Single-allocation hot path: the chunk buffer is filled in place via
-        readinto; only the (small) carried-over tail is copied.
+    def _read_cursor(self, max_size: int):
+        """One chunk as a ChunkCursor with overflow carry.
+
+        Returns None at EOF, _GROW when the carried overflow alone exceeds
+        ``max_size``, else a cursor whose .end marks the logical chunk end.
+        Buffers come from the recycle pool and are never resized — the
+        single-allocation hot path fills them in place via readinto.
         """
         if max_size <= len(self._overflow):
-            return b""
+            return self._GROW
         olen = len(self._overflow)
-        buf = bytearray(max_size)
+        buf = self._take_buf(max_size)
         buf[:olen] = self._overflow
         total = olen + self._read_into(memoryview(buf), olen)
-        if total == 0:
-            self._overflow = b""
-            return None
         self._overflow = b""
-        if total != max_size:
-            del buf[total:]
-            return buf
-        cut = self.find_last_record_begin(buf)
-        self._overflow = bytes(memoryview(buf)[cut:])
-        del buf[cut:]
-        return buf
+        if total == 0:
+            self.recycle_chunk(buf)
+            return None
+        if total != max_size:  # partition tail: everything is one chunk
+            return ChunkCursor(buf, end=total)
+        cut = self.find_last_record_begin(buf, total)
+        self._overflow = bytes(memoryview(buf)[cut:total])
+        if cut == 0:  # no record head in the whole buffer
+            self.recycle_chunk(buf)
+            return self._GROW
+        return ChunkCursor(buf, end=cut)
 
-    def _load_chunk(self):  # -> Optional[bytes-like]
+    def _load_cursor(self) -> Optional[ChunkCursor]:
         """Chunk::Load with geometric growth (input_split_base.cc:241-258)."""
         size = self._chunk_bytes
         while True:
-            data = self.read_chunk(size)
-            if data is None:
+            cur = self._read_cursor(size)
+            if cur is None:
                 return None
-            if len(data) == 0:
+            if cur is self._GROW:
                 size *= 2
                 continue
-            return data
+            return cur
+
+    # back-compat bytes API (copies; the cursor path is the hot one)
+    def read_chunk(self, max_size: int):
+        cur = self._read_cursor(max_size)
+        if cur is None:
+            return None
+        if cur is self._GROW:
+            return b""
+        data = bytes(memoryview(cur.data)[: cur.end])
+        self.recycle_chunk(cur)
+        return data
+
+    def _load_chunk(self):  # -> Optional[bytes]
+        cur = self._load_cursor()
+        if cur is None:
+            return None
+        data = bytes(memoryview(cur.data)[: cur.end])
+        self.recycle_chunk(cur)
+        return data
 
     # ---- public interface ----------------------------------------------
     def next_chunk(self) -> Optional[memoryview]:
-        data = self._load_chunk()
-        return None if data is None else memoryview(data)
+        if self._served is not None:  # previous chunk's Blobs expire now
+            self.recycle_chunk(self._served)
+            self._served = None
+        cur = self._load_cursor()
+        if cur is None:
+            return None
+        self._served = cur
+        return memoryview(cur.data)[: cur.end]
 
     def next_record(self) -> Optional[memoryview]:
         while True:
@@ -344,11 +404,12 @@ class InputSplitBase(InputSplit):
                 rec = self.extract_next_record(self._pending)
                 if rec is not None:
                     return rec
+                self.recycle_chunk(self._pending)
                 self._pending = None
-            data = self._load_chunk()
-            if data is None:
+            cur = self._load_cursor()
+            if cur is None:
                 return None
-            self._pending = ChunkCursor(data)
+            self._pending = cur
 
     def hint_chunk_size(self, chunk_size: int) -> None:
         # grow-only, like the reference (input_split_base.h:45-47); shrinking
@@ -390,11 +451,11 @@ class LineSplitter(InputSplitBase):
             nstep += 1
         return nstep
 
-    def find_last_record_begin(self, buf) -> int:
+    def find_last_record_begin(self, buf, end: int) -> int:
         # last EOL + 1, or 0 (line_split.cc:27-34); buf is bytes-like
         # (bytearray in the hot path — no copy)
-        n = buf.rfind(b"\n")
-        r = buf.rfind(b"\r")
+        n = buf.rfind(b"\n", 0, end)
+        r = buf.rfind(b"\r", 0, end)
         last = max(n, r)
         return last + 1 if last >= 0 else 0
 
@@ -446,12 +507,15 @@ class RecordIOSplitter(InputSplitBase):
                     break
         return nstep - 8
 
-    def find_last_record_begin(self, buf) -> int:
+    def find_last_record_begin(self, buf, end: int) -> int:
         # backward u32 scan from end-2 words (recordio_split.cc:26-42);
         # buf is bytes-like (bytearray in the hot path — no copy)
-        check(len(buf) % 4 == 0, "unaligned recordio chunk")
-        check(len(buf) >= 8, "recordio chunk too small")
-        hi = len(buf) - 4  # a head needs magic at idx plus lrec at idx+4
+        check(end % 4 == 0, "unaligned recordio chunk")
+        check(end >= 8, "recordio chunk too small")
+        idx = native.recordio_find_last(memoryview(buf)[:end], KMAGIC)
+        if idx is not None:
+            return idx
+        hi = end - 4  # a head needs magic at idx plus lrec at idx+4
         while True:
             idx = buf.rfind(_MAGIC_BYTES, 0, hi)
             if idx <= 0:
@@ -463,6 +527,34 @@ class RecordIOSplitter(InputSplitBase):
             hi = idx + 3  # next candidate strictly below idx
 
     def extract_next_record(self, chunk: ChunkCursor) -> Optional[memoryview]:
+        if chunk.pos >= chunk.end:
+            return None
+        # native fast path: scan the whole chunk once, then serve spans
+        if chunk.spans is None and chunk.pos == 0:
+            try:
+                chunk.spans = native.recordio_spans(
+                    memoryview(chunk.data)[: chunk.end], KMAGIC)
+            except ValueError as e:
+                raise DMLCError(str(e)) from e
+        if chunk.spans is not None:
+            if chunk.span_i >= len(chunk.spans):
+                chunk.pos = chunk.end
+                return None
+            off, length, flag = (int(v) for v in chunk.spans[chunk.span_i])
+            chunk.span_i += 1
+            if flag == 0:
+                chunk.pos = off + ((length + 3) & ~3)
+                return memoryview(chunk.data)[off : off + length]
+            # rare multi-segment record: reassemble via the Python walk
+            sub = ChunkCursor(chunk.data)
+            sub.spans = ()  # force the Python path below
+            sub.pos = off
+            sub.end = off + length
+            chunk.pos = sub.end
+            return self._extract_py(sub)
+        return self._extract_py(chunk)
+
+    def _extract_py(self, chunk: ChunkCursor) -> Optional[memoryview]:
         if chunk.pos >= chunk.end:
             return None
         check(chunk.pos + 8 <= chunk.end, "invalid RecordIO format")

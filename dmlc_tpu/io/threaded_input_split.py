@@ -26,8 +26,11 @@ class ThreadedInputSplit(InputSplit):
         )
 
     def _produce(self, recycled):
-        data = self._base._load_chunk()  # runs on the producer thread
-        return None if data is None else data
+        # runs on the producer thread; recycled cursors return their
+        # buffers to the base pool here, so pool access stays single-thread
+        if recycled is not None:
+            self._base.recycle_chunk(recycled)
+        return self._base._load_cursor()
 
     def _rewind(self) -> None:
         self._base.before_first()
@@ -39,16 +42,22 @@ class ThreadedInputSplit(InputSplit):
                 rec = self._base.extract_next_record(self._chunk)
                 if rec is not None:
                     return rec
+                self._iter.recycle(self._chunk)
                 self._chunk = None
-            ok, data = self._iter.next()
+            ok, cur = self._iter.next()
             if not ok:
                 return None
-            self._chunk = ChunkCursor(data)
+            self._chunk = cur
 
     def next_chunk(self) -> Optional[memoryview]:
-        self._chunk = None
-        ok, data = self._iter.next()
-        return memoryview(data) if ok else None
+        if self._chunk is not None:
+            self._iter.recycle(self._chunk)
+            self._chunk = None
+        ok, cur = self._iter.next()
+        if not ok:
+            return None
+        self._chunk = cur
+        return memoryview(cur.data)[: cur.end]
 
     def before_first(self) -> None:
         self._iter.before_first()

@@ -1,0 +1,231 @@
+"""ctypes bindings for the native C++ core (cpp/dmlc_native.cc).
+
+The shared library is compiled on demand with g++ (one-time, cached next
+to this package) — no pybind/pip dependency.  Every entry point has a
+pure-Python fallback in its caller; set DMLC_TPU_DISABLE_NATIVE=1 to
+force the fallbacks (tests exercise both paths).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "cpp",
+                    "dmlc_native.cc")
+_SO = os.path.join(_HERE, "libdmlc_native.so")
+_ABI = 1
+
+_lib = None
+_lib_lock = threading.Lock()
+_tried = False
+
+
+def _build() -> Optional[str]:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO]
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if r.returncode != 0:
+        from ..logging import warning
+
+        warning(f"native build failed, using Python fallbacks: "
+                f"{r.stderr[:500]}")
+        return None
+    return _SO
+
+
+def _load():
+    global _lib, _tried
+    with _lib_lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("DMLC_TPU_DISABLE_NATIVE"):
+            return None
+        so = _build()
+        if so is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        if lib.dmlc_native_abi_version() != _ABI:
+            return None
+        c = ctypes
+        lib.dmlc_parse_libsvm.restype = c.c_long
+        lib.dmlc_parse_libsvm.argtypes = [
+            c.c_void_p, c.c_long, c.c_void_p, c.c_void_p, c.c_void_p,
+            c.c_void_p, c.c_void_p, c.c_long, c.c_long,
+            c.POINTER(c.c_long), c.POINTER(c.c_long), c.POINTER(c.c_int)]
+        lib.dmlc_parse_libfm.restype = c.c_long
+        lib.dmlc_parse_libfm.argtypes = [
+            c.c_void_p, c.c_long, c.c_void_p, c.c_void_p, c.c_void_p,
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_long, c.c_long,
+            c.POINTER(c.c_long), c.POINTER(c.c_long), c.POINTER(c.c_int)]
+        lib.dmlc_parse_csv.restype = c.c_long
+        lib.dmlc_parse_csv.argtypes = [
+            c.c_void_p, c.c_long, c.c_char, c.c_void_p, c.c_long,
+            c.POINTER(c.c_long), c.POINTER(c.c_long)]
+        lib.dmlc_recordio_spans.restype = c.c_long
+        lib.dmlc_recordio_spans.argtypes = [
+            c.c_void_p, c.c_long, c.c_uint32, c.c_void_p, c.c_long,
+            c.POINTER(c.c_long)]
+        lib.dmlc_recordio_find_last.restype = c.c_long
+        lib.dmlc_recordio_find_last.argtypes = [
+            c.c_void_p, c.c_long, c.c_uint32]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _as_carray(data):
+    """(ptr, len) for bytes/bytearray/memoryview without copy."""
+    mv = memoryview(data)
+    if mv.ndim != 1 or mv.itemsize != 1:
+        mv = mv.cast("B")
+    arr = np.frombuffer(mv, np.uint8)
+    return arr.ctypes.data, arr.size
+
+
+def parse_libsvm(data) -> Optional[dict]:
+    """Parse a LibSVM chunk.  Returns dict of arrays or None if native
+    unavailable.  Raises ValueError on malformed input."""
+    lib = _load()
+    if lib is None:
+        return None
+    if isinstance(data, memoryview):
+        data = bytes(data)
+    ptr, n = _as_carray(data)
+    max_rows = data.count(b"\n") + 2
+    # nnz bound: one feature per separator-delimited token
+    max_nnz = data.count(b" ") + data.count(b"\t") + max_rows + 1
+    while True:
+        labels = np.empty(max_rows, np.float32)
+        weights = np.empty(max_rows, np.float32)
+        offsets = np.empty(max_rows + 1, np.uint64)
+        index = np.empty(max_nnz, np.uint32)
+        value = np.empty(max_nnz, np.float32)
+        n_rows = ctypes.c_long()
+        n_nnz = ctypes.c_long()
+        has_w = ctypes.c_int()
+        ret = lib.dmlc_parse_libsvm(
+            ptr, n, labels.ctypes.data, weights.ctypes.data,
+            offsets.ctypes.data, index.ctypes.data, value.ctypes.data,
+            max_rows, max_nnz, ctypes.byref(n_rows), ctypes.byref(n_nnz),
+            ctypes.byref(has_w))
+        if ret == -1:
+            max_rows *= 2
+            max_nnz *= 2
+            continue
+        if ret != 0:
+            raise ValueError(f"malformed LibSVM input (code {ret})")
+        r, z = n_rows.value, n_nnz.value
+        return {
+            "labels": labels[:r], "weights": weights[:r] if has_w.value else None,
+            "offsets": offsets[:r + 1], "index": index[:z], "value": value[:z],
+        }
+
+
+def parse_libfm(data) -> Optional[dict]:
+    lib = _load()
+    if lib is None:
+        return None
+    if isinstance(data, memoryview):
+        data = bytes(data)
+    ptr, n = _as_carray(data)
+    max_rows = data.count(b"\n") + 2
+    max_nnz = data.count(b" ") + data.count(b"\t") + max_rows + 1
+    while True:
+        labels = np.empty(max_rows, np.float32)
+        weights = np.empty(max_rows, np.float32)
+        offsets = np.empty(max_rows + 1, np.uint64)
+        fields = np.empty(max_nnz, np.uint32)
+        index = np.empty(max_nnz, np.uint32)
+        value = np.empty(max_nnz, np.float32)
+        n_rows = ctypes.c_long()
+        n_nnz = ctypes.c_long()
+        has_w = ctypes.c_int()
+        ret = lib.dmlc_parse_libfm(
+            ptr, n, labels.ctypes.data, weights.ctypes.data,
+            offsets.ctypes.data, fields.ctypes.data, index.ctypes.data,
+            value.ctypes.data, max_rows, max_nnz,
+            ctypes.byref(n_rows), ctypes.byref(n_nnz), ctypes.byref(has_w))
+        if ret == -1:
+            max_rows *= 2
+            max_nnz *= 2
+            continue
+        if ret != 0:
+            raise ValueError(f"malformed LibFM input (code {ret})")
+        r, z = n_rows.value, n_nnz.value
+        return {
+            "labels": labels[:r], "weights": weights[:r] if has_w.value else None,
+            "offsets": offsets[:r + 1], "fields": fields[:z],
+            "index": index[:z], "value": value[:z],
+        }
+
+
+def parse_csv(data, delim: bytes = b",") -> Optional[tuple]:
+    """Returns (values [rows, cols] f32) or None; raises on bad input.
+
+    Whitespace delimiters are not supported natively (the number scanner
+    skips blanks), so those fall back to the Python path."""
+    lib = _load()
+    if lib is None or delim in (b" ", b"\t", b"\r"):
+        return None
+    ptr, n = _as_carray(data)
+    max_vals = n // 2 + 16
+    out = np.empty(max_vals, np.float32)
+    n_rows = ctypes.c_long()
+    n_cols = ctypes.c_long()
+    ret = lib.dmlc_parse_csv(ptr, n, delim, out.ctypes.data, max_vals,
+                             ctypes.byref(n_rows), ctypes.byref(n_cols))
+    if ret == -2:
+        raise ValueError("CSV: non-numeric cell")
+    if ret == -3:
+        raise ValueError("CSV has inconsistent column counts")
+    if ret != 0:
+        raise ValueError(f"CSV parse failed (code {ret})")
+    r, ncol = n_rows.value, n_cols.value
+    return out[: r * ncol].reshape(r, ncol)
+
+
+def recordio_spans(data, magic: int):
+    """(spans [n,3] uint64: offset, len, flag) or None.  flag 0 = zero-copy
+    payload span; flag 1 = multi-segment region needing reassembly."""
+    lib = _load()
+    if lib is None:
+        return None
+    ptr, n = _as_carray(data)
+    max_spans = max(n // 12 + 2, 16)
+    while True:
+        out = np.empty((max_spans, 3), np.uint64)
+        n_spans = ctypes.c_long()
+        ret = lib.dmlc_recordio_spans(ptr, n, magic, out.ctypes.data,
+                                      max_spans, ctypes.byref(n_spans))
+        if ret == -1:  # capacity: legal with many zero-length records
+            max_spans *= 2
+            continue
+        if ret != 0:
+            raise ValueError(f"invalid RecordIO chunk (code {ret})")
+        return out[: n_spans.value]
+
+
+def recordio_find_last(data, magic: int) -> Optional[int]:
+    lib = _load()
+    if lib is None:
+        return None
+    ptr, n = _as_carray(data)
+    return int(lib.dmlc_recordio_find_last(ptr, n, magic))

@@ -228,3 +228,19 @@ def test_disk_row_iter_reuses_existing_cache(tmp_path):
     n2 = sum(blk.size for blk in it2)
     assert n1 == n2 == 20
     it2.close()
+
+
+def test_csv_tab_delimiter_falls_back(tmp_path):
+    # whitespace delimiters must keep working (native gate falls back to
+    # the Python path, which handles any single-byte delimiter)
+    from dmlc_tpu.data.text_parsers import CSVParser
+    from dmlc_tpu.io import input_split
+
+    uri = write(tmp_path, "t.tsv", b"1\t2.5\n3\t4.5\n")
+    split = input_split.create(uri, 0, 1, "text")
+    parser = CSVParser(split, {"delimiter": "\t"})
+    containers = parser.parse_next()
+    blk = containers[0].get_block()
+    np.testing.assert_allclose(blk[0].value, [1, 2.5])
+    np.testing.assert_allclose(blk[1].value, [3, 4.5])
+    parser.close()

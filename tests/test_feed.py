@@ -98,3 +98,12 @@ def test_feed_epoch_ends_cleanly(tmp_path, mesh):
     feed2 = libsvm_feed(uri, mesh, batch_size=2, max_nnz=4)
     n2 = len(list(feed2))
     assert n1 == n2 > 0
+
+
+def test_feed_producer_error_propagates(tmp_path, mesh):
+    # malformed libsvm: producer must surface the error, not hang
+    p = tmp_path / "bad.libsvm"
+    p.write_text("1 abc:def\n" * 20)
+    feed = libsvm_feed(str(p), mesh, batch_size=2, max_nnz=4)
+    with pytest.raises(Exception):
+        list(feed)

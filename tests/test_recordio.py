@@ -131,3 +131,23 @@ def test_numpy_payload_roundtrip():
     data, _ = write_all([arr.tobytes()])
     (out,) = list(RecordIOReader(MemoryBytesStream(data)))
     np.testing.assert_array_equal(np.frombuffer(out, np.float32).reshape(32, 16), arr)
+
+
+def test_many_zero_length_records(tmp_path):
+    # >16 empty records per chunk exercises the native span-capacity retry
+    from dmlc_tpu.io.recordio import RecordIOWriter, RecordIOReader
+    from dmlc_tpu.io.stream import Stream
+    from dmlc_tpu.io import input_split
+
+    path = str(tmp_path / "zeros.rec")
+    with Stream.create(path, "w") as s:
+        w = RecordIOWriter(s)
+        for _ in range(100):
+            w.write_record(b"")
+        w.write_record(b"tail")
+    split = input_split.create(path, 0, 1, "recordio")
+    recs = [bytes(r) for r in split]
+    assert len(recs) == 101
+    assert recs[-1] == b"tail"
+    assert all(r == b"" for r in recs[:-1])
+    split.close()
