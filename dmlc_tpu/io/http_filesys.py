@@ -1,0 +1,145 @@
+"""HTTP(S) read-only filesystem: ranged-GET streaming with retry.
+
+Rebuild of the reference's plain-HTTP read path (HttpReadStream inside
+src/io/s3_filesys.cc:533 and the CURLReadStreamBase ranged-GET /
+retry-on-disconnect structure, s3_filesys.cc:295-446) on urllib instead
+of libcurl.  Read-only: GetPathInfo via HEAD, no listing, no writes —
+matching the reference's http support surface.
+"""
+
+from __future__ import annotations
+
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+from ..base import DMLCError, check
+from .filesys import FileInfo, FileSystem
+from .stream import SeekStream, Stream
+from .uri import URI
+
+__all__ = ["HTTPFileSystem", "HttpReadStream"]
+
+_RETRIES = 3
+
+
+class HttpReadStream(SeekStream):
+    """SeekStream over ranged HTTP GETs with buffered fills + retry.
+
+    ``headers`` may be a dict or a zero-arg callable returning one — a
+    callable is re-resolved on every request so auth tokens can refresh
+    mid-stream (GCS tokens expire ~hourly; one InputSplit epoch can
+    outlive them).
+    """
+
+    def __init__(self, url: str, size: Optional[int] = None,
+                 headers=None, buffer_bytes: int = 1 << 20):
+        self._url = url
+        self._headers = headers if callable(headers) else dict(headers or {})
+        self._size = self._head_size() if size is None else size
+        self._pos = 0
+        self._buf = b""
+        self._buf_start = 0
+        self._buffer_bytes = buffer_bytes
+
+    def _resolve_headers(self) -> dict:
+        return dict(self._headers()) if callable(self._headers) \
+            else dict(self._headers)
+
+    def _head_size(self) -> int:
+        req = urllib.request.Request(self._url, method="HEAD",
+                                     headers=self._resolve_headers())
+        with urllib.request.urlopen(req, timeout=60) as r:
+            length = r.headers.get("Content-Length")
+            check(length is not None, f"no Content-Length from {self._url}")
+            return int(length)
+
+    def _fill(self, start: int, size: int) -> bytes:
+        """Ranged GET [start, start+size) with retry (s3_filesys.cc retry
+        structure).  Permanent 4xx failures are not retried."""
+        end = min(start + size, self._size) - 1
+        if end < start:
+            return b""
+        last_err: Optional[Exception] = None
+        for _ in range(_RETRIES):
+            try:
+                headers = self._resolve_headers()
+                headers["Range"] = f"bytes={start}-{end}"
+                req = urllib.request.Request(self._url, headers=headers)
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    body = r.read()
+                    if r.status == 206:
+                        return body
+                    # a server ignoring Range returns 200 + full body:
+                    # only acceptable when that IS the requested span
+                    if r.status == 200 and start == 0 \
+                            and len(body) == end - start + 1:
+                        return body
+                    raise DMLCError(
+                        f"server ignored Range request (HTTP {r.status}, "
+                        f"{len(body)} bytes for span {start}-{end})")
+            except urllib.error.HTTPError as e:
+                if 400 <= e.code < 500:
+                    raise DMLCError(
+                        f"HTTP {e.code} reading {self._url.split('?')[0]}"
+                    ) from e
+                last_err = e
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                last_err = e
+        raise DMLCError(
+            f"HTTP read failed after {_RETRIES} retries: {last_err}")
+
+    def read(self, size: int) -> bytes:
+        if self._pos >= self._size:
+            return b""
+        size = min(size, self._size - self._pos)
+        # serve from buffer when possible, else refill
+        off = self._pos - self._buf_start
+        if not (0 <= off < len(self._buf)):
+            self._buf_start = self._pos
+            self._buf = self._fill(self._pos, max(size, self._buffer_bytes))
+            off = 0
+        out = self._buf[off : off + size]
+        if len(out) < size:  # request spans past the buffered window
+            rest = self._fill(self._pos + len(out), size - len(out))
+            out += rest
+        self._pos += len(out)
+        return out
+
+    def write(self, data: bytes) -> int:
+        raise DMLCError("HttpReadStream is read-only")
+
+    def seek(self, pos: int) -> None:
+        check(0 <= pos <= self._size, "seek out of range")
+        self._pos = pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def at_end(self) -> bool:
+        return self._pos >= self._size
+
+
+class HTTPFileSystem(FileSystem):
+    """Read-only http(s):// backend."""
+
+    def get_path_info(self, path: URI) -> FileInfo:
+        strm = HttpReadStream(path.str_uri())
+        return FileInfo(path=path, size=strm._size, type="file")
+
+    def list_directory(self, path: URI) -> List[FileInfo]:
+        raise DMLCError("HTTP filesystem does not support listing")
+
+    def open(self, path: URI, mode: str, allow_null: bool = False
+             ) -> Optional[Stream]:
+        check(mode in ("r", "rb"), "HTTP filesystem is read-only")
+        return self.open_for_read(path, allow_null)
+
+    def open_for_read(self, path: URI, allow_null: bool = False
+                      ) -> Optional[SeekStream]:
+        try:
+            return HttpReadStream(path.str_uri())
+        except Exception:
+            if allow_null:
+                return None
+            raise
