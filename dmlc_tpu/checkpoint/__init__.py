@@ -1,0 +1,16 @@
+"""Checkpoint/resume: sharded jax.Array pytrees over the Stream/URI layer.
+
+The reference provides the *mechanism* — Serializable + typed
+Stream::Write over any URI so models checkpoint straight to object
+storage (SURVEY.md §5; S3 multipart writer s3_filesys.cc:551-680).  The
+TPU rebuild keeps that split: this module lays orbax-style sharded-array
+checkpoints (per-shard files + JSON manifest) on top of Stream.create,
+so the same code persists to file:// and gs:// (resumable upload), and
+each host writes only its addressable shards.
+"""
+
+from .sharded import (  # noqa: F401
+    CheckpointManager,
+    restore_pytree,
+    save_pytree,
+)

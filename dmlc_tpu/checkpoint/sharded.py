@@ -1,0 +1,251 @@
+"""Sharded pytree checkpoints: per-shard blobs + a JSON manifest.
+
+Layout under a checkpoint directory URI:
+    manifest.json                       tree/shape/dtype/sharding metadata
+    <leaf-key>.<shard-id>               raw little-endian shard bytes
+
+Shard identity is the global index (slice extents) the shard covers, so
+restore works on any mesh with the same axis names/sizes via
+jax.make_array_from_callback; replicated shards are written once
+(replica_id == 0).  All IO goes through Stream.create — local paths and
+gs:// behave identically (GCS writes use the resumable-upload stream).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..base import DMLCError, check
+from ..io.stream import Stream
+
+MANIFEST = "manifest.json"
+
+
+def _leaf_key(path) -> str:
+    import jax
+
+    key = jax.tree_util.keystr(path)
+    safe = "".join(c if (c.isalnum() or c in "._-") else "_" for c in key)
+    return safe.strip("_") or "leaf"
+
+
+def _index_key(index, shape) -> str:
+    """Stable string for a global shard index (tuple of slices)."""
+    parts = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        parts.append(f"{start}-{stop}")
+    return "_".join(parts) if parts else "scalar"
+
+
+def _spec_to_json(arr) -> Optional[list]:
+    sharding = getattr(arr, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            out.append(list(entry))
+        else:
+            out.append(entry)
+    return out
+
+
+def _spec_from_json(raw):
+    from jax.sharding import PartitionSpec as P
+
+    if raw is None:
+        return P()
+    return P(*[tuple(e) if isinstance(e, list) else e for e in raw])
+
+
+def _join(base: str, name: str) -> str:
+    return base.rstrip("/") + "/" + name
+
+
+def _read_all(s: Stream, chunk: int = 8 << 20) -> bytes:
+    parts = []
+    while True:
+        d = s.read(chunk)
+        if not d:
+            return b"".join(parts)
+        parts.append(d)
+
+
+def _ensure_dir(uri: str) -> None:
+    """Create the directory for local checkpoint paths (object stores
+    have no directories to create)."""
+    if "://" in uri and not uri.startswith("file://"):
+        return
+    import os
+
+    os.makedirs(uri[len("file://"):] if uri.startswith("file://") else uri,
+                exist_ok=True)
+
+
+def save_pytree(uri: str, tree: Any, *, process_index: int = 0) -> None:
+    """Write a pytree of jax.Arrays / numpy arrays under ``uri``.
+
+    Multi-host: every process writes its addressable shards; only
+    process 0 writes the manifest (call with process_index=jax.process_index()).
+    """
+    import jax
+
+    _ensure_dir(uri)
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    manifest: Dict[str, Any] = {"format": 1, "leaves": {}}
+    for path, leaf in leaves:
+        key = _leaf_key(path)
+        check(key not in manifest["leaves"], f"duplicate leaf key {key}")
+        arr = leaf
+        entry: Dict[str, Any] = {
+            "path": jax.tree_util.keystr(path),
+            "shape": list(np.shape(arr)),
+            "dtype": str(arr.dtype) if hasattr(arr, "dtype")
+            else str(np.asarray(arr).dtype),
+            "spec": _spec_to_json(arr),
+            "shards": {},
+        }
+        if hasattr(arr, "addressable_shards"):
+            for shard in arr.addressable_shards:
+                if shard.replica_id != 0:
+                    continue
+                ikey = _index_key(shard.index, arr.shape)
+                fname = f"{key}.{ikey}"
+                entry["shards"][ikey] = fname
+                with Stream.create(_join(uri, fname), "w") as s:
+                    s.write(np.ascontiguousarray(shard.data).tobytes())
+        else:
+            npa = np.asarray(arr)
+            ikey = _index_key(tuple(slice(0, d) for d in npa.shape),
+                              npa.shape)
+            entry["shards"][ikey] = f"{key}.{ikey}"
+            with Stream.create(_join(uri, f"{key}.{ikey}"), "w") as s:
+                s.write(np.ascontiguousarray(npa).tobytes())
+        manifest["leaves"][key] = entry
+    if process_index == 0:
+        with Stream.create(_join(uri, MANIFEST), "w") as s:
+            s.write(json.dumps(manifest, indent=1).encode())
+
+
+def _parse_index(ikey: str, shape) -> tuple:
+    if ikey == "scalar":
+        return ()
+    return tuple(
+        slice(int(a), int(b))
+        for a, b in (p.split("-") for p in ikey.split("_"))
+    )
+
+
+def restore_pytree(uri: str, template: Any, *, mesh=None) -> Any:
+    """Restore a pytree saved by save_pytree.
+
+    ``template`` supplies the tree structure (values ignored).  With
+    ``mesh``, leaves come back as sharded jax.Arrays per the recorded
+    PartitionSpec; without, as host numpy arrays.
+    """
+    import jax
+
+    with Stream.create(_join(uri, MANIFEST), "r") as s:
+        manifest = json.loads(_read_all(s))
+    check(manifest.get("format") == 1, "unknown checkpoint format")
+    leaves_meta = manifest["leaves"]
+
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+
+    def load_shard_bytes(key: str, ikey: str, dtype, shape) -> np.ndarray:
+        fname = leaves_meta[key]["shards"][ikey]
+        with Stream.create(_join(uri, fname), "r") as s:
+            raw = _read_all(s)
+        return np.frombuffer(raw, dtype=dtype).reshape(shape)
+
+    out_leaves = []
+    for path, _ in paths:
+        key = _leaf_key(path)
+        meta = leaves_meta.get(key)
+        if meta is None:
+            raise DMLCError(f"checkpoint missing leaf {key}")
+        shape = tuple(meta["shape"])
+        dtype = np.dtype(meta["dtype"])
+        if mesh is not None:
+            spec = _spec_from_json(meta["spec"])
+            sharding = jax.sharding.NamedSharding(mesh, spec)
+
+            def cb(index, key=key, shape=shape, dtype=dtype):
+                ikey = _index_key(index, shape)
+                extent = tuple(
+                    (0 if sl.start is None else sl.start,
+                     dim if sl.stop is None else sl.stop)
+                    for sl, dim in zip(index, shape))
+                sub_shape = tuple(b - a for a, b in extent)
+                return load_shard_bytes(key, ikey, dtype, sub_shape)
+
+            out_leaves.append(
+                jax.make_array_from_callback(shape, sharding, cb))
+        else:
+            full = np.zeros(shape, dtype)
+            for ikey in meta["shards"]:
+                idx = _parse_index(ikey, shape)
+                sub_shape = tuple(sl.stop - sl.start for sl in idx)
+                full[idx] = load_shard_bytes(key, ikey, dtype, sub_shape)
+            out_leaves.append(full)
+    return jax.tree_util.tree_unflatten(treedef, out_leaves)
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints with latest-pointer and retention.
+
+    The policy layer the reference leaves to users (SURVEY.md §5),
+    matching common trainer needs: save(step, tree), restore latest,
+    keep the newest ``max_to_keep`` (local paths only for deletion).
+    """
+
+    def __init__(self, base_uri: str, *, max_to_keep: int = 3):
+        self.base = base_uri.rstrip("/")
+        self.max_to_keep = max_to_keep
+
+    def _step_dir(self, step: int) -> str:
+        return f"{self.base}/step_{step:08d}"
+
+    def save(self, step: int, tree: Any, *, process_index: int = 0) -> None:
+        save_pytree(self._step_dir(step), tree, process_index=process_index)
+        if process_index == 0:
+            with Stream.create(_join(self.base, "LATEST"), "w") as s:
+                s.write(str(step).encode())
+            self._retain()
+
+    def latest_step(self) -> Optional[int]:
+        s = Stream.create(_join(self.base, "LATEST"), "r", allow_null=True)
+        if s is None:
+            return None
+        with s:
+            raw = s.read(64).strip()
+        return int(raw) if raw else None
+
+    def restore_latest(self, template: Any, *, mesh=None):
+        step = self.latest_step()
+        if step is None:
+            return None, None
+        return step, restore_pytree(self._step_dir(step), template, mesh=mesh)
+
+    def _retain(self) -> None:
+        import os
+        import re
+        import shutil
+
+        if not os.path.isdir(self.base):
+            return  # retention is local-only; object stores keep all
+        steps = []
+        for name in os.listdir(self.base):
+            m = re.match(r"^step_(\d+)$", name)
+            if m:
+                steps.append(int(m.group(1)))
+        for old in sorted(steps)[: -self.max_to_keep or None]:
+            shutil.rmtree(self._step_dir(old), ignore_errors=True)

@@ -1,0 +1,161 @@
+"""Pallas TPU kernel for the flash-attention block attend.
+
+This is the MXU hot loop of ring attention (parallel/ring_attention.py):
+one Q block against one KV shard with an online softmax, returning the
+partial (pv, m, l) triple the ring combiner folds across ranks.  The
+kernel keeps Q/K/V tiles in VMEM, loops KV in block_k tiles with a
+fori_loop carry (running max / denominator in f32), and takes the global
+position offsets as scalar-prefetch arguments so the SAME compiled
+kernel serves every ring step (offsets are traced values there).
+
+Falls back to the pure-lax path (ring_attention._block_attend) off-TPU
+or for unaligned shapes; interpret=True runs the kernel on CPU for
+tests.  Layout/tiling per /opt/skills/guides/pallas_guide.md.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_BIG = -1e30
+
+
+def _kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref,
+            pv_ref, m_ref, l_ref, *, block_k: int, causal: bool,
+            scale: float):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0]                      # [block_q, D]
+    block_q, d = q.shape
+    tk = k_ref.shape[1]
+    nk = tk // block_k
+    qi = pl.program_id(1)
+    q_pos = qoff_ref[0] + qi * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+
+    def body(j, carry):
+        acc, m, l = carry
+        kb = k_ref[0, pl.ds(j * block_k, block_k)]      # [block_k, D]
+        vb = v_ref[0, pl.ds(j * block_k, block_k)]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [block_q, block_k]
+        if causal:
+            k_pos = kvoff_ref[0] + j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            keep = q_pos >= k_pos
+            s = jnp.where(keep, s, _NEG_BIG)
+        bm = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m, bm)
+        p = jnp.exp(s - m_new[:, None])
+        if causal:
+            p = jnp.where(keep, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=1)
+        pv = jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_new = acc * corr[:, None] + pv
+        return acc_new, m_new, l_new
+
+    acc, m, l = lax.fori_loop(0, nk, body, (acc0, m0, l0))
+    pv_ref[0] = acc
+    m_ref[0] = m
+    l_ref[0] = l
+
+
+def supports(q_shape: Tuple[int, ...], k_shape: Tuple[int, ...],
+             block_q: int, block_k: int) -> bool:
+    """Alignment gate: lane dim multiple of 128, seq dims tile evenly."""
+    _, tq, _, d = q_shape
+    tk = k_shape[1]
+    return (d % 128 == 0 and tq % min(block_q, tq) == 0
+            and tk % min(block_k, tk) == 0
+            and tq >= 8 and tk >= 8)
+
+
+def block_attend_flash(q, k, v, *, scale: float, causal: bool,
+                       q_offset, kv_offset,
+                       block_q: int = 128, block_k: int = 128,
+                       interpret: bool = False):
+    """Partial attention of q against one KV shard.
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; q_offset/kv_offset: traced
+    int32 global positions of element 0.  Returns (pv [B,Tq,H,D] f32,
+    m [B,H,Tq] f32, l [B,H,Tq] f32) — same contract as the lax
+    _block_attend in ring_attention.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    bh = b * h
+
+    qt = q.transpose(0, 2, 1, 3).reshape(bh, tq, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(bh, tk, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(bh, tk, d)
+    qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    kvoff = jnp.asarray(kv_offset, jnp.int32).reshape(1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(bh, tq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bi, qi, *_: (bi, qi, 0)),
+            pl.BlockSpec((1, tk, d), lambda bi, qi, *_: (bi, 0, 0)),
+            pl.BlockSpec((1, tk, d), lambda bi, qi, *_: (bi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda bi, qi, *_: (bi, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bi, qi, *_: (bi, qi)),
+            pl.BlockSpec((1, block_q), lambda bi, qi, *_: (bi, qi)),
+        ],
+    )
+    pv, m, l = pl.pallas_call(
+        functools.partial(_kernel, block_k=block_k, causal=causal,
+                          scale=scale),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qoff, kvoff, qt, kt, vt)
+
+    pv = pv.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
+    m = m.reshape(b, h, tq)
+    l = l.reshape(b, h, tq)
+    return pv, m, l
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    interpret: bool = False):
+    """Standalone exact attention via the flash kernel (single device).
+
+    q/k/v: [B, T, H, D].  The oracle-equivalent of
+    ring_attention_reference with O(T) memory per block row.
+    """
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    pv, m, l = block_attend_flash(
+        q, k, v, scale=scale, causal=causal, q_offset=0, kv_offset=0,
+        block_q=block_q, block_k=block_k, interpret=interpret)
+    denom = jnp.maximum(l, 1e-20)
+    out = pv / jnp.transpose(denom, (0, 2, 1))[..., None]
+    return out.astype(q.dtype)
