@@ -1,18 +1,22 @@
-// Native hot paths for dmlc_tpu: allocation-free text parsing and
-// RecordIO chunk scanning, exposed through a minimal C ABI consumed via
-// ctypes (no pybind dependency).
+// Native hot paths for dmlc_tpu: allocation-free text parsing (optionally
+// multi-threaded), and RecordIO chunk scanning, exposed through a minimal
+// C ABI consumed via ctypes (no pybind dependency).
 //
 // Behavioral rebuild of the reference's hot loops — strtonum-style
 // number parsing (/root/reference/include/dmlc/strtonum.h behavior),
-// LibSVM/CSV/LibFM line scanning (src/data/*_parser.h), and the RecordIO
+// LibSVM/CSV/LibFM line scanning (src/data/*_parser.h) including the
+// OpenMP-style parallel chunk fanout with backward line re-alignment
+// (src/data/text_parser.h:89-118, here std::thread), and the RecordIO
 // magic/cflag chunk walk (src/recordio.cc, src/io/recordio_split.cc) —
 // written fresh for a span-oriented API: one call scans a whole chunk
 // and fills caller-provided arrays, so Python touches each record once.
 //
-// Build: g++ -O3 -std=c++17 -shared -fPIC dmlc_native.cc -o libdmlc_native.so
+// Build: g++ -O3 -std=c++17 -shared -fPIC dmlc_native.cc -o libdmlc_native.so -pthread
 
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 namespace {
 
@@ -69,6 +73,153 @@ inline const char* parse_uint(const char* p, const char* end, uint64_t* out) {
   return p;
 }
 
+// Per-thread sparse-parse accumulator (libsvm/libfm share it; libfm also
+// fills fields).
+struct SparseRows {
+  std::vector<float> labels, weights, value;
+  std::vector<uint64_t> rowlen;  // nnz per row (rebased to offsets on merge)
+  std::vector<uint32_t> fields, index;
+  int has_weight = 0;
+  int rc = 0;  // 0 ok, -2 malformed
+};
+
+// Parse [p, end) as libsvm (with_fields=false) or libfm (true) rows into
+// out.  The range must start/end at line boundaries.
+void parse_sparse_range(const char* p, const char* end, bool with_fields,
+                        SparseRows* out) {
+  while (p != end) {
+    const char* line_end = static_cast<const char*>(memchr(p, '\n', end - p));
+    if (line_end == nullptr) line_end = end;
+    const char* q = skip_blank(p, line_end);
+    if (q != line_end) {
+      double label;
+      q = parse_float(q, line_end, &label);
+      if (q == nullptr) { out->rc = -2; return; }
+      double weight = 1.0;
+      if (q != line_end && *q == ':') {
+        q = parse_float(q + 1, line_end, &weight);
+        if (q == nullptr) { out->rc = -2; return; }
+        out->has_weight = 1;
+      }
+      out->labels.push_back(static_cast<float>(label));
+      out->weights.push_back(static_cast<float>(weight));
+      uint64_t nnz = 0;
+      while (true) {
+        q = skip_blank(q, line_end);
+        if (q == line_end) break;
+        uint64_t a;
+        q = parse_uint(q, line_end, &a);
+        if (q == nullptr) { out->rc = -2; return; }
+        if (with_fields) {
+          // strict field:idx:val triple (libfm_parser.h ParseTriple behavior)
+          uint64_t idx; double val;
+          if (q == line_end || *q != ':') { out->rc = -2; return; }
+          q = parse_uint(q + 1, line_end, &idx);
+          if (q == nullptr || q == line_end || *q != ':') { out->rc = -2; return; }
+          q = parse_float(q + 1, line_end, &val);
+          if (q == nullptr) { out->rc = -2; return; }
+          out->fields.push_back(static_cast<uint32_t>(a));
+          out->index.push_back(static_cast<uint32_t>(idx));
+          out->value.push_back(static_cast<float>(val));
+        } else {
+          double val = 1.0;  // omitted value => implicit 1.0
+          if (q != line_end && *q == ':') {
+            q = parse_float(q + 1, line_end, &val);
+            if (q == nullptr) { out->rc = -2; return; }
+          }
+          out->index.push_back(static_cast<uint32_t>(a));
+          out->value.push_back(static_cast<float>(val));
+        }
+        ++nnz;
+      }
+      out->rowlen.push_back(nnz);
+    }
+    p = (line_end == end) ? end : line_end + 1;
+  }
+}
+
+// Split [buf, buf+n) into up to nthread ranges at line boundaries, the
+// text_parser.h:89-118 backward re-alignment: range k starts at the byte
+// after the last '\n' strictly before the naive split point.
+std::vector<std::pair<const char*, const char*>> line_ranges(
+    const char* buf, long n, int nthread) {
+  std::vector<std::pair<const char*, const char*>> out;
+  if (nthread < 1) nthread = 1;
+  long step = (n + nthread - 1) / nthread;
+  long begin = 0;
+  for (int k = 0; k < nthread && begin < n; ++k) {
+    long end = (k + 1 == nthread) ? n : (k + 1) * step;
+    if (end > n) end = n;
+    if (end < n) {
+      // advance end to the next line boundary so ranges cover whole lines
+      const void* nl = memchr(buf + end, '\n', n - end);
+      end = (nl == nullptr) ? n
+                            : (static_cast<const char*>(nl) - buf) + 1;
+    }
+    if (end > begin) out.emplace_back(buf + begin, buf + end);
+    begin = end;
+  }
+  return out;
+}
+
+long merge_sparse(const std::vector<SparseRows>& parts, bool with_fields,
+                  float* labels, float* weights, uint64_t* offsets,
+                  uint32_t* fields, uint32_t* index, float* value,
+                  long max_rows, long max_nnz,
+                  long* n_rows, long* n_nnz, int* has_weight) {
+  long rows = 0, nnz = 0;
+  int hw = 0;
+  for (const auto& p : parts) {
+    if (p.rc != 0) return p.rc;
+    rows += static_cast<long>(p.rowlen.size());
+    nnz += static_cast<long>(p.index.size());
+    hw |= p.has_weight;
+  }
+  if (rows > max_rows || nnz > max_nnz) return -1;
+  long r = 0, z = 0;
+  offsets[0] = 0;
+  for (const auto& p : parts) {
+    std::memcpy(labels + r, p.labels.data(), p.labels.size() * 4);
+    std::memcpy(weights + r, p.weights.data(), p.weights.size() * 4);
+    std::memcpy(index + z, p.index.data(), p.index.size() * 4);
+    std::memcpy(value + z, p.value.data(), p.value.size() * 4);
+    if (with_fields)
+      std::memcpy(fields + z, p.fields.data(), p.fields.size() * 4);
+    for (size_t i = 0; i < p.rowlen.size(); ++i) {
+      z += static_cast<long>(p.rowlen[i]);
+      offsets[++r] = static_cast<uint64_t>(z);
+    }
+  }
+  *n_rows = rows;
+  *n_nnz = nnz;
+  *has_weight = hw;
+  return 0;
+}
+
+long parse_sparse_mt(const char* buf, long n, bool with_fields, int nthread,
+                     float* labels, float* weights, uint64_t* offsets,
+                     uint32_t* fields, uint32_t* index, float* value,
+                     long max_rows, long max_nnz,
+                     long* n_rows, long* n_nnz, int* has_weight) {
+  auto ranges = line_ranges(buf, n, nthread);
+  std::vector<SparseRows> parts(ranges.size());
+  if (ranges.size() <= 1) {
+    if (!ranges.empty())
+      parse_sparse_range(ranges[0].first, ranges[0].second, with_fields,
+                         &parts[0]);
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(ranges.size());
+    for (size_t k = 0; k < ranges.size(); ++k)
+      threads.emplace_back(parse_sparse_range, ranges[k].first,
+                           ranges[k].second, with_fields, &parts[k]);
+    for (auto& t : threads) t.join();
+  }
+  return merge_sparse(parts, with_fields, labels, weights, offsets, fields,
+                      index, value, max_rows, max_nnz, n_rows, n_nnz,
+                      has_weight);
+}
+
 }  // namespace
 
 extern "C" {
@@ -77,59 +228,16 @@ extern "C" {
 // LibSVM: "label[:weight] idx[:val] ..." per line.  Fills labels/weights
 // [max_rows], offsets [max_rows+1], index/value [max_nnz].
 // Returns 0 ok, -1 capacity exceeded, -2 malformed input.
-// *has_weight set if any label carried ":weight".
+// *has_weight set if any label carried ":weight".  nthread > 1 fans the
+// chunk out over std::threads at line boundaries.
 long dmlc_parse_libsvm(const char* buf, long n,
                        float* labels, float* weights, uint64_t* offsets,
                        uint32_t* index, float* value,
-                       long max_rows, long max_nnz,
+                       long max_rows, long max_nnz, int nthread,
                        long* n_rows, long* n_nnz, int* has_weight) {
-  const char* p = buf;
-  const char* end = buf + n;
-  long rows = 0, nnz = 0;
-  *has_weight = 0;
-  offsets[0] = 0;
-  while (p != end) {
-    const char* line_end = static_cast<const char*>(
-        memchr(p, '\n', end - p));
-    if (line_end == nullptr) line_end = end;
-    const char* q = skip_blank(p, line_end);
-    if (q != line_end) {
-      if (rows >= max_rows) return -1;
-      double label;
-      q = parse_float(q, line_end, &label);
-      if (q == nullptr) return -2;
-      double weight = 1.0;
-      if (q != line_end && *q == ':') {
-        q = parse_float(q + 1, line_end, &weight);
-        if (q == nullptr) return -2;
-        *has_weight = 1;
-      }
-      labels[rows] = static_cast<float>(label);
-      weights[rows] = static_cast<float>(weight);
-      while (true) {
-        q = skip_blank(q, line_end);
-        if (q == line_end) break;
-        uint64_t idx;
-        q = parse_uint(q, line_end, &idx);
-        if (q == nullptr) return -2;
-        double val = 1.0;  // omitted value => implicit 1.0
-        if (q != line_end && *q == ':') {
-          q = parse_float(q + 1, line_end, &val);
-          if (q == nullptr) return -2;
-        }
-        if (nnz >= max_nnz) return -1;
-        index[nnz] = static_cast<uint32_t>(idx);
-        value[nnz] = static_cast<float>(val);
-        ++nnz;
-      }
-      ++rows;
-      offsets[rows] = static_cast<uint64_t>(nnz);
-    }
-    p = (line_end == end) ? end : line_end + 1;
-  }
-  *n_rows = rows;
-  *n_nnz = nnz;
-  return 0;
+  return parse_sparse_mt(buf, n, false, nthread, labels, weights, offsets,
+                         nullptr, index, value, max_rows, max_nnz, n_rows,
+                         n_nnz, has_weight);
 }
 
 // ---------------------------------------------------------------------
@@ -137,72 +245,28 @@ long dmlc_parse_libsvm(const char* buf, long n,
 long dmlc_parse_libfm(const char* buf, long n,
                       float* labels, float* weights, uint64_t* offsets,
                       uint32_t* fields, uint32_t* index, float* value,
-                      long max_rows, long max_nnz,
+                      long max_rows, long max_nnz, int nthread,
                       long* n_rows, long* n_nnz, int* has_weight) {
-  const char* p = buf;
-  const char* end = buf + n;
-  long rows = 0, nnz = 0;
-  *has_weight = 0;
-  offsets[0] = 0;
-  while (p != end) {
-    const char* line_end = static_cast<const char*>(
-        memchr(p, '\n', end - p));
-    if (line_end == nullptr) line_end = end;
-    const char* q = skip_blank(p, line_end);
-    if (q != line_end) {
-      if (rows >= max_rows) return -1;
-      double label;
-      q = parse_float(q, line_end, &label);
-      if (q == nullptr) return -2;
-      double weight = 1.0;
-      if (q != line_end && *q == ':') {
-        q = parse_float(q + 1, line_end, &weight);
-        if (q == nullptr) return -2;
-        *has_weight = 1;
-      }
-      labels[rows] = static_cast<float>(label);
-      weights[rows] = static_cast<float>(weight);
-      while (true) {
-        q = skip_blank(q, line_end);
-        if (q == line_end) break;
-        // strict field:idx:val triple (libfm_parser.h ParseTriple behavior)
-        uint64_t field, idx;
-        double val;
-        q = parse_uint(q, line_end, &field);
-        if (q == nullptr || q == line_end || *q != ':') return -2;
-        q = parse_uint(q + 1, line_end, &idx);
-        if (q == nullptr || q == line_end || *q != ':') return -2;
-        q = parse_float(q + 1, line_end, &val);
-        if (q == nullptr) return -2;
-        if (nnz >= max_nnz) return -1;
-        fields[nnz] = static_cast<uint32_t>(field);
-        index[nnz] = static_cast<uint32_t>(idx);
-        value[nnz] = static_cast<float>(val);
-        ++nnz;
-      }
-      ++rows;
-      offsets[rows] = static_cast<uint64_t>(nnz);
-    }
-    p = (line_end == end) ? end : line_end + 1;
-  }
-  *n_rows = rows;
-  *n_nnz = nnz;
-  return 0;
+  return parse_sparse_mt(buf, n, true, nthread, labels, weights, offsets,
+                         fields, index, value, max_rows, max_nnz, n_rows,
+                         n_nnz, has_weight);
 }
 
 // ---------------------------------------------------------------------
 // CSV (numeric): fills values row-major; all rows must share the first
 // row's column count.  Returns 0 ok, -1 capacity, -2 non-numeric,
-// -3 ragged rows.
-long dmlc_parse_csv(const char* buf, long n, char delim,
-                    float* out, long max_vals,
-                    long* n_rows, long* n_cols) {
-  const char* p = buf;
-  const char* end = buf + n;
-  long rows = 0, vals = 0, ncol = -1;
+// -3 ragged rows.  nthread > 1 parses line ranges concurrently (two-pass:
+// count then fill, so output stays row-major with no post-merge copy).
+namespace {
+struct CsvPart {
+  std::vector<float> vals;
+  long ncol = -1;
+  int rc = 0;
+};
+void parse_csv_range(const char* p, const char* end, char delim,
+                     CsvPart* out) {
   while (p != end) {
-    const char* line_end = static_cast<const char*>(
-        memchr(p, '\n', end - p));
+    const char* line_end = static_cast<const char*>(memchr(p, '\n', end - p));
     if (line_end == nullptr) line_end = end;
     const char* q = skip_blank(p, line_end);
     if (q != line_end) {
@@ -210,22 +274,54 @@ long dmlc_parse_csv(const char* buf, long n, char delim,
       while (true) {
         double v;
         q = parse_float(q, line_end, &v);
-        if (q == nullptr) return -2;
-        if (vals >= max_vals) return -1;
-        out[vals++] = static_cast<float>(v);
+        if (q == nullptr) { out->rc = -2; return; }
+        out->vals.push_back(static_cast<float>(v));
         ++row_vals;
         q = skip_blank(q, line_end);
         if (q == line_end) break;
-        if (*q != delim) return -2;
+        if (*q != delim) { out->rc = -2; return; }
         ++q;
       }
-      if (ncol < 0) ncol = row_vals;
-      else if (row_vals != ncol) return -3;
-      ++rows;
+      if (out->ncol < 0) out->ncol = row_vals;
+      else if (row_vals != out->ncol) { out->rc = -3; return; }
     }
     p = (line_end == end) ? end : line_end + 1;
   }
-  *n_rows = rows;
+}
+}  // namespace
+
+long dmlc_parse_csv(const char* buf, long n, char delim, int nthread,
+                    float* out, long max_vals,
+                    long* n_rows, long* n_cols) {
+  auto ranges = line_ranges(buf, n, nthread);
+  std::vector<CsvPart> parts(ranges.size());
+  if (ranges.size() <= 1) {
+    if (!ranges.empty())
+      parse_csv_range(ranges[0].first, ranges[0].second, delim, &parts[0]);
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(ranges.size());
+    for (size_t k = 0; k < ranges.size(); ++k)
+      threads.emplace_back(parse_csv_range, ranges[k].first,
+                           ranges[k].second, delim, &parts[k]);
+    for (auto& t : threads) t.join();
+  }
+  long ncol = -1, vals = 0;
+  for (const auto& p : parts) {
+    if (p.rc != 0) return p.rc;
+    if (p.ncol >= 0) {
+      if (ncol < 0) ncol = p.ncol;
+      else if (p.ncol != ncol) return -3;
+    }
+    vals += static_cast<long>(p.vals.size());
+  }
+  if (vals > max_vals) return -1;
+  long at = 0;
+  for (const auto& p : parts) {
+    std::memcpy(out + at, p.vals.data(), p.vals.size() * 4);
+    at += static_cast<long>(p.vals.size());
+  }
+  *n_rows = (ncol > 0) ? vals / ncol : 0;
   *n_cols = (ncol < 0) ? 0 : ncol;
   return 0;
 }
@@ -304,6 +400,6 @@ long dmlc_recordio_find_last(const uint8_t* buf, long n, uint32_t magic) {
   return 0;
 }
 
-int dmlc_native_abi_version() { return 1; }
+int dmlc_native_abi_version() { return 2; }
 
 }  // extern "C"

@@ -29,6 +29,20 @@ __all__ = [
 ]
 
 
+def effective_nthread(requested: Optional[int]) -> int:
+    """Parse-thread policy (text_parser.h:30-35 behavior: bounded by half
+    the cores); DMLC_TPU_PARSE_NTHREAD overrides, requested caps."""
+    import os
+
+    env = os.environ.get("DMLC_TPU_PARSE_NTHREAD")
+    if env:
+        return max(1, int(env))
+    cap = max(1, (os.cpu_count() or 2) // 2)
+    if requested is None:
+        return min(4, cap)
+    return max(1, min(requested, cap))
+
+
 class Parser:
     """One-pass streaming iterator of RowBlocks (parser.h:23-50)."""
 
@@ -55,24 +69,27 @@ class Parser:
 
 class TextParserBase(Parser):
     """Chunk-pull + parse loop (text_parser.h:30-118). Subclasses implement
-    ``parse_chunk(data: bytes, out: RowBlockContainer)``."""
+    ``parse_chunk(data, out: RowBlockContainer)`` where ``data`` is any
+    bytes-like (the hot path hands the chunk memoryview straight to the
+    native parser, which fans it out over C++ threads at line boundaries —
+    the reference's OpenMP parallel parse, text_parser.h:89-118).
+    """
 
-    def __init__(self, source: isplit.InputSplit, nthread: int = 2):
+    def __init__(self, source: isplit.InputSplit, nthread: Optional[int] = None):
         self._source = source
         self._bytes_read = 0
-        self._nthread = nthread
+        self._nthread = effective_nthread(nthread)
 
-    def parse_chunk(self, data: bytes, out: RowBlockContainer) -> None:
+    def parse_chunk(self, data, out: RowBlockContainer) -> None:
         raise NotImplementedError
 
     def parse_next(self) -> Optional[List[RowBlockContainer]]:
         chunk = self._source.next_chunk()
         if chunk is None:
             return None
-        data = bytes(chunk)
-        self._bytes_read += len(data)
+        self._bytes_read += len(chunk)
         out = RowBlockContainer()
-        self.parse_chunk(data, out)
+        self.parse_chunk(chunk, out)
         return [out]
 
     def before_first(self) -> None:
@@ -131,6 +148,7 @@ def create_parser(
     num_parts: int = 1,
     type: str = "auto",
     threaded: bool = True,
+    nthread: Optional[int] = None,
     **extra_args,
 ) -> Parser:
     """Parser factory (data.cc:62-84): URI query args are parser params;
@@ -138,6 +156,8 @@ def create_parser(
     spec = URISpec(uri, part_index, num_parts)
     args = dict(spec.args)
     args.update({k: str(v) for k, v in extra_args.items()})
+    if nthread is not None:
+        args["nthread"] = str(nthread)
     if type == "auto":
         type = args.get("format", "libsvm")
     entry = PARSER_REGISTRY.find(type)

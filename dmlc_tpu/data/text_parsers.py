@@ -28,9 +28,9 @@ class LibSVMParser(TextParserBase):
     """``label[:weight] index[:value] ...``; omitted value => implicit 1.0
     (libsvm_parser.h:35-90)."""
 
-    def parse_chunk(self, data: bytes, out: RowBlockContainer) -> None:
+    def parse_chunk(self, data, out: RowBlockContainer) -> None:
         try:
-            parsed = native.parse_libsvm(data)
+            parsed = native.parse_libsvm(data, nthread=self._nthread)
         except ValueError as e:
             raise DMLCError(str(e)) from e
         if parsed is not None:
@@ -42,7 +42,7 @@ class LibSVMParser(TextParserBase):
                 weight=parsed["weights"],
             )
             return
-        self._parse_chunk_py(data, out)
+        self._parse_chunk_py(bytes(data), out)
 
     def _parse_chunk_py(self, data: bytes, out: RowBlockContainer) -> None:
         labels = []
@@ -90,15 +90,17 @@ class CSVParserParam(Parameter):
 class CSVParser(TextParserBase):
     """Dense CSV -> CSR with column indices (csv_parser.h:43-102)."""
 
-    def __init__(self, source: isplit.InputSplit, args: Dict[str, str]):
-        super().__init__(source)
+    def __init__(self, source: isplit.InputSplit, args: Dict[str, str],
+                 nthread=None):
+        super().__init__(source, nthread=nthread)
         self.param = CSVParserParam()
         self.param.init(args)
 
-    def parse_chunk(self, data: bytes, out: RowBlockContainer) -> None:
+    def parse_chunk(self, data, out: RowBlockContainer) -> None:
         delim = self.param.delimiter.encode()
         try:
-            arr = native.parse_csv(data, delim) if len(delim) == 1 else None
+            arr = (native.parse_csv(data, delim, nthread=self._nthread)
+                   if len(delim) == 1 else None)
         except ValueError as e:
             raise DMLCError(str(e)) from e
         if arr is not None:
@@ -106,7 +108,7 @@ class CSVParser(TextParserBase):
                 return
             self._push_dense(arr, out)
             return
-        lines = [ln for ln in data.split(b"\n") if ln.strip()]
+        lines = [ln for ln in bytes(data).split(b"\n") if ln.strip()]
         if not lines:
             return
         ncol = lines[0].count(delim) + 1
@@ -152,9 +154,9 @@ class CSVParser(TextParserBase):
 class LibFMParser(TextParserBase):
     """``label[:weight] field:index:value ...`` (libfm_parser.h:35-96)."""
 
-    def parse_chunk(self, data: bytes, out: RowBlockContainer) -> None:
+    def parse_chunk(self, data, out: RowBlockContainer) -> None:
         try:
-            parsed = native.parse_libfm(data)
+            parsed = native.parse_libfm(data, nthread=self._nthread)
         except ValueError as e:
             raise DMLCError(str(e)) from e
         if parsed is not None:
@@ -167,7 +169,7 @@ class LibFMParser(TextParserBase):
                 field=parsed["fields"].astype(out._idt, copy=False),
             )
             return
-        self._parse_chunk_py(data, out)
+        self._parse_chunk_py(bytes(data), out)
 
     def _parse_chunk_py(self, data: bytes, out: RowBlockContainer) -> None:
         labels = []
@@ -206,19 +208,24 @@ class LibFMParser(TextParserBase):
 
 # ---- registrations (data.cc:150-158) -----------------------------------
 
+def _nthread_arg(args):
+    v = args.get("nthread")
+    return int(v) if v else None
+
+
 @register_parser("libsvm")
 def _make_libsvm(uri, args, part_index, num_parts):
     src = isplit.create(uri, part_index, num_parts, "text")
-    return LibSVMParser(src)
+    return LibSVMParser(src, nthread=_nthread_arg(args))
 
 
 @register_parser("csv")
 def _make_csv(uri, args, part_index, num_parts):
     src = isplit.create(uri, part_index, num_parts, "text")
-    return CSVParser(src, args)
+    return CSVParser(src, args, nthread=_nthread_arg(args))
 
 
 @register_parser("libfm")
 def _make_libfm(uri, args, part_index, num_parts):
     src = isplit.create(uri, part_index, num_parts, "text")
-    return LibFMParser(src)
+    return LibFMParser(src, nthread=_nthread_arg(args))

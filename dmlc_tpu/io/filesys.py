@@ -60,6 +60,12 @@ class FileSystem(abc.ABC):
     @abc.abstractmethod
     def open_for_read(self, path: URI, allow_null: bool = False) -> Optional[SeekStream]: ...
 
+    def local_path(self, path: URI) -> Optional[str]:
+        """OS path for mmap-capable backends (LocalFileSystem), else None.
+        InputSplit uses this to serve zero-copy chunks straight out of the
+        page cache instead of memcpying through read buffers."""
+        return None
+
     # ---- dispatch (io.cc:31-60) ----------------------------------------
     _registry: Dict[str, Callable[[URI], "FileSystem"]] = {}
     _instances: Dict[str, "FileSystem"] = {}

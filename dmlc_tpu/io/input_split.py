@@ -27,6 +27,8 @@ in-place ``\\0`` termination — Python slices replace C-string hacks.
 
 from __future__ import annotations
 
+import mmap
+import os
 import re
 import struct
 from bisect import bisect_right
@@ -59,14 +61,22 @@ _U32 = struct.Struct("<I")
 
 class ChunkCursor:
     """A loaded chunk plus an extraction cursor (Chunk + Blob walking,
-    input_split_base.h:74-95)."""
+    input_split_base.h:74-95).
 
-    __slots__ = ("data", "pos", "end", "spans", "span_i")
+    ``data`` is any bytes-like with find/rfind (bytearray from the copy
+    path, bytes from the seam-stitch path, or an ``mmap`` for the
+    zero-copy local fast path); the chunk occupies ``[start, end)`` in
+    data coordinates — for mmap cursors that window is a view straight
+    into the page cache, never copied."""
 
-    def __init__(self, data, end: Optional[int] = None):
+    __slots__ = ("data", "start", "pos", "end", "mv", "spans", "span_i")
+
+    def __init__(self, data, end: Optional[int] = None, start: int = 0):
         self.data = data
-        self.pos = 0
+        self.start = start
+        self.pos = start
         self.end = len(data) if end is None else end
+        self.mv: Optional[memoryview] = None  # cached memoryview(data)
         self.spans = None   # native whole-chunk scan cache (recordio)
         self.span_i = 0
 
@@ -127,6 +137,17 @@ class InputSplitBase(InputSplit):
         # grow-only HintChunkSize, shrinking is allowed down to this floor
         # so tests can exercise the overflow-carry path
         self._chunk_bytes_min = max(self._align * 2, 8)
+        # zero-copy local fast path: when every file has an OS path, chunks
+        # are served as mmap views into the page cache — no read buffers,
+        # no overflow copies (a TPU-first deviation from the reference's
+        # fread+memcpy chunk pipeline; remote filesystems use the generic
+        # copy path below).  DMLC_TPU_DISABLE_MMAP=1 forces the copy path.
+        self._local_paths = [filesys.local_path(f.path) for f in self._files]
+        self._mmap_ok = (
+            not os.environ.get("DMLC_TPU_DISABLE_MMAP")
+            and all(p is not None for p in self._local_paths)
+        )
+        self._maps: List[Optional[mmap.mmap]] = [None] * len(self._files)
         self._fs: Optional[SeekStream] = None
         self._file_ptr = 0
         self._offset_begin = 0
@@ -152,11 +173,101 @@ class InputSplitBase(InputSplit):
 
     def recycle_chunk(self, chunk) -> None:
         """Return a consumed chunk's buffer for reuse.  The chunk's records
-        (Blobs) become invalid, matching io.h NextRecord semantics."""
+        (Blobs) become invalid, matching io.h NextRecord semantics.
+        mmap-view chunks have no buffer to recycle (their Blobs stay valid
+        for the life of the split — a superset of the reference contract)."""
         buf = chunk.data if isinstance(chunk, ChunkCursor) else chunk
         if isinstance(buf, bytearray) and len(buf) == self._chunk_bytes \
                 and len(self._pool) < 4:
             self._pool.append(buf)
+
+    # ---- zero-copy local fast path (mmap) -------------------------------
+    def _get_map(self, i: int) -> mmap.mmap:
+        mm = self._maps[i]
+        if mm is None:
+            fd = os.open(self._local_paths[i], os.O_RDONLY)
+            try:
+                mm = mmap.mmap(fd, 0, access=mmap.ACCESS_READ)
+            finally:
+                os.close(fd)  # the mapping outlives the descriptor
+            self._maps[i] = mm
+        return mm
+
+    _STITCH = "stitch"  # sentinel: record crosses a file seam
+
+    def _mmap_try_window(self, curr: int, size: int):
+        """One window attempt at the zero-copy path: a ChunkCursor view
+        into the file's mapping, _GROW (no record head fits in ``size``),
+        or _STITCH (the pending record crosses a file seam)."""
+        end_part = self._offset_end
+        fi = bisect_right(self._file_offset, curr) - 1
+        fbase = self._file_offset[fi]
+        in_file_end = min(self._file_offset[fi + 1], end_part)
+        mm = self._get_map(fi)
+        window_end = min(curr + size, in_file_end)
+        lo, hi = curr - fbase, window_end - fbase
+        if window_end == end_part:
+            cut = hi  # partition end is record-aligned by reset_partition
+        else:
+            cut = self.find_last_record_begin(mm, lo, hi)
+        if cut > lo:
+            self._offset_curr = fbase + cut
+            return ChunkCursor(mm, start=lo, end=cut)
+        return self._GROW if window_end < in_file_end else self._STITCH
+
+    def _load_cursor_mmap(self) -> Optional[ChunkCursor]:
+        """One chunk as a view into the current file's mapping.
+
+        The chunk window is capped at ``_chunk_bytes`` (API granularity
+        parity with the reference) and cut back to the last record head;
+        nothing is copied and there is no overflow carry — the next window
+        simply starts at the cut.  A record crossing a file seam falls back
+        to :meth:`_load_cursor_stitch` for that one chunk.
+        """
+        curr = self._offset_curr
+        if self._offset_begin >= self._offset_end or curr >= self._offset_end:
+            return None
+        size = self._chunk_bytes
+        while True:
+            cur = self._mmap_try_window(curr, size)
+            if cur is self._GROW:
+                size *= 2  # record larger than the window: grow in place
+                continue
+            if cur is self._STITCH:
+                return self._load_cursor_stitch(curr)
+            return cur
+
+    def _gather(self, begin: int, end: int) -> bytearray:
+        """Copy [begin, end) of the logical byte space out of the maps."""
+        out = bytearray(end - begin)
+        pos, at = begin, 0
+        while pos < end:
+            fj = bisect_right(self._file_offset, pos) - 1
+            base = self._file_offset[fj]
+            take = min(self._file_offset[fj + 1], end) - pos
+            mm = self._get_map(fj)
+            out[at : at + take] = mm[pos - base : pos - base + take]
+            pos += take
+            at += take
+        return out
+
+    def _load_cursor_stitch(self, curr: int) -> Optional[ChunkCursor]:
+        """Seam-crossing chunk: assemble bytes across files, cut at the
+        last record head (the rare copy on the otherwise zero-copy path)."""
+        end_part = self._offset_end
+        size = max(self._chunk_bytes, self._chunk_bytes_min)
+        while True:
+            take_end = min(curr + size, end_part)
+            buf = self._gather(curr, take_end)
+            total = len(buf)
+            cut = total if take_end == end_part \
+                else self.find_last_record_begin(buf, 0, total)
+            if cut > 0:
+                self._offset_curr = curr + cut
+                return ChunkCursor(buf, end=cut)
+            if take_end == end_part:
+                return None  # curr == end_part: nothing left
+            size *= 2
 
     # ---- URI expansion (input_split_base.cc:96-175) ---------------------
     @staticmethod
@@ -228,12 +339,17 @@ class InputSplitBase(InputSplit):
         return the number of bytes skipped."""
         raise NotImplementedError
 
-    def find_last_record_begin(self, buf, end: int) -> int:
-        """Return the offset of the last record start within buf[:end]
-        (0 if none).
+    def find_last_record_begin(self, buf, begin: int, end: int) -> int:
+        """Return the offset of the last record start within buf[begin:end]
+        in ``buf`` coordinates (``begin`` if none — no complete record).
 
-        ``buf`` is bytes-like with find/rfind (bytes or bytearray — the hot
-        path passes the full pooled chunk buffer; only [:end] is valid)."""
+        ``buf`` is bytes-like with find/rfind (bytearray on the copy path,
+        mmap on the zero-copy path; only [begin:end] is valid)."""
+        raise NotImplementedError
+
+    def seek_record_begin_mm(self, mm, off: int, end: int) -> int:
+        """mmap analog of seek_record_begin: bytes to skip from ``off`` to
+        the next record start within mm[:end]."""
         raise NotImplementedError
 
     def extract_next_record(self, chunk: ChunkCursor) -> Optional[memoryview]:
@@ -258,28 +374,43 @@ class InputSplitBase(InputSplit):
         if self._offset_end != self._file_offset[file_ptr_end]:
             check(self._offset_end > self._file_offset[file_ptr_end], "bad end offset")
             check(file_ptr_end < len(self._files), "bad end file")
-            fs = self._filesys.open_for_read(self._files[file_ptr_end].path)
-            fs.seek(self._offset_end - self._file_offset[file_ptr_end])
-            self._offset_end += self.seek_record_begin(fs)
-            fs.close()
+            local = self._offset_end - self._file_offset[file_ptr_end]
+            if self._mmap_ok:
+                self._offset_end += self.seek_record_begin_mm(
+                    self._get_map(file_ptr_end), local,
+                    self._files[file_ptr_end].size)
+            else:
+                fs = self._filesys.open_for_read(self._files[file_ptr_end].path)
+                fs.seek(local)
+                self._offset_end += self.seek_record_begin(fs)
+                fs.close()
         # advance the BEGIN boundary likewise (input_split_base.cc:58-62)
         self._file_ptr = bisect_right(self._file_offset, self._offset_begin) - 1
-        self._fs = self._filesys.open_for_read(self._files[self._file_ptr].path)
         if self._offset_begin != self._file_offset[self._file_ptr]:
-            self._fs.seek(self._offset_begin - self._file_offset[self._file_ptr])
-            self._offset_begin += self.seek_record_begin(self._fs)
+            local = self._offset_begin - self._file_offset[self._file_ptr]
+            if self._mmap_ok:
+                self._offset_begin += self.seek_record_begin_mm(
+                    self._get_map(self._file_ptr), local,
+                    self._files[self._file_ptr].size)
+            else:
+                self._fs = self._filesys.open_for_read(
+                    self._files[self._file_ptr].path)
+                self._fs.seek(local)
+                self._offset_begin += self.seek_record_begin(self._fs)
         self.before_first()
 
     def before_first(self) -> None:
         if self._offset_begin >= self._offset_end:
             return
-        fp = bisect_right(self._file_offset, self._offset_begin) - 1
-        if self._file_ptr != fp or self._fs is None:
-            if self._fs is not None:
-                self._fs.close()
-            self._file_ptr = fp
-            self._fs = self._filesys.open_for_read(self._files[self._file_ptr].path)
-        self._fs.seek(self._offset_begin - self._file_offset[self._file_ptr])
+        if not self._mmap_ok:
+            fp = bisect_right(self._file_offset, self._offset_begin) - 1
+            if self._file_ptr != fp or self._fs is None:
+                if self._fs is not None:
+                    self._fs.close()
+                self._file_ptr = fp
+                self._fs = self._filesys.open_for_read(
+                    self._files[self._file_ptr].path)
+            self._fs.seek(self._offset_begin - self._file_offset[self._file_ptr])
         self._offset_curr = self._offset_begin
         self._overflow = b""
         if self._pending is not None:
@@ -298,6 +429,10 @@ class InputSplitBase(InputSplit):
             size = self._offset_end - self._offset_curr
         if size == 0:
             return b""
+        if self._mmap_ok:
+            out = bytes(self._gather(self._offset_curr, self._offset_curr + size))
+            self._offset_curr += size
+            return out
         out = bytearray(size)
         n = self._read_into(memoryview(out), 0)
         return bytes(out[:n])
@@ -349,7 +484,7 @@ class InputSplitBase(InputSplit):
             return None
         if total != max_size:  # partition tail: everything is one chunk
             return ChunkCursor(buf, end=total)
-        cut = self.find_last_record_begin(buf, total)
+        cut = self.find_last_record_begin(buf, 0, total)
         self._overflow = bytes(memoryview(buf)[cut:total])
         if cut == 0:  # no record head in the whole buffer
             self.recycle_chunk(buf)
@@ -358,6 +493,8 @@ class InputSplitBase(InputSplit):
 
     def _load_cursor(self) -> Optional[ChunkCursor]:
         """Chunk::Load with geometric growth (input_split_base.cc:241-258)."""
+        if self._mmap_ok:
+            return self._load_cursor_mmap()
         size = self._chunk_bytes
         while True:
             cur = self._read_cursor(size)
@@ -370,12 +507,24 @@ class InputSplitBase(InputSplit):
 
     # back-compat bytes API (copies; the cursor path is the hot one)
     def read_chunk(self, max_size: int):
-        cur = self._read_cursor(max_size)
-        if cur is None:
-            return None
-        if cur is self._GROW:
-            return b""
-        data = bytes(memoryview(cur.data)[: cur.end])
+        if self._mmap_ok:
+            curr = self._offset_curr
+            if self._offset_begin >= self._offset_end or curr >= self._offset_end:
+                return None
+            cur = self._mmap_try_window(curr, max_size)
+            if cur is self._GROW:
+                return b""  # caller grows, reference Chunk::Load contract
+            if cur is self._STITCH:
+                cur = self._load_cursor_stitch(curr)
+                if cur is None:
+                    return None
+        else:
+            cur = self._read_cursor(max_size)
+            if cur is None:
+                return None
+            if cur is self._GROW:
+                return b""
+        data = bytes(memoryview(cur.data)[cur.start : cur.end])
         self.recycle_chunk(cur)
         return data
 
@@ -383,7 +532,7 @@ class InputSplitBase(InputSplit):
         cur = self._load_cursor()
         if cur is None:
             return None
-        data = bytes(memoryview(cur.data)[: cur.end])
+        data = bytes(memoryview(cur.data)[cur.start : cur.end])
         self.recycle_chunk(cur)
         return data
 
@@ -396,7 +545,7 @@ class InputSplitBase(InputSplit):
         if cur is None:
             return None
         self._served = cur
-        return memoryview(cur.data)[: cur.end]
+        return memoryview(cur.data)[cur.start : cur.end]
 
     def next_record(self) -> Optional[memoryview]:
         while True:
@@ -412,8 +561,9 @@ class InputSplitBase(InputSplit):
             self._pending = cur
 
     def hint_chunk_size(self, chunk_size: int) -> None:
-        # grow-only, like the reference (input_split_base.h:45-47); shrinking
-        # below 2 words would break the recordio head-scan invariants
+        # rounded up to the alignment unit: the reference stores chunks as
+        # uint32 words, making unaligned sizes impossible by construction
+        chunk_size = ((chunk_size + self._align - 1) // self._align) * self._align
         self._chunk_bytes = max(chunk_size, self._chunk_bytes_min)
 
     def get_total_size(self) -> int:
@@ -423,6 +573,13 @@ class InputSplitBase(InputSplit):
         if self._fs is not None:
             self._fs.close()
             self._fs = None
+        for i, mm in enumerate(self._maps):
+            if mm is not None:
+                try:
+                    mm.close()
+                except BufferError:
+                    pass  # exported views keep the mapping alive; GC reaps it
+                self._maps[i] = None
 
 
 class LineSplitter(InputSplitBase):
@@ -451,13 +608,25 @@ class LineSplitter(InputSplitBase):
             nstep += 1
         return nstep
 
-    def find_last_record_begin(self, buf, end: int) -> int:
-        # last EOL + 1, or 0 (line_split.cc:27-34); buf is bytes-like
-        # (bytearray in the hot path — no copy)
-        n = buf.rfind(b"\n", 0, end)
-        r = buf.rfind(b"\r", 0, end)
+    def find_last_record_begin(self, buf, begin: int, end: int) -> int:
+        # last EOL + 1, or begin (line_split.cc:27-34); buf is bytes-like
+        # (bytearray or mmap in the hot path — no copy)
+        n = buf.rfind(b"\n", begin, end)
+        r = buf.rfind(b"\r", begin, end)
         last = max(n, r)
-        return last + 1 if last >= 0 else 0
+        return last + 1 if last >= begin else begin
+
+    def seek_record_begin_mm(self, mm, off: int, end: int) -> int:
+        # mmap analog of the stream scan above: first EOL, then past the
+        # EOL run (C-speed find instead of byte-at-a-time reads)
+        n = mm.find(b"\n", off, end)
+        r = mm.find(b"\r", off, end)
+        if n < 0 and r < 0:
+            return end - off
+        p = (min(n, r) if (n >= 0 and r >= 0) else max(n, r)) + 1
+        while p < end and mm[p] in (10, 13):
+            p += 1
+        return p - off
 
     def extract_next_record(self, chunk: ChunkCursor) -> Optional[memoryview]:
         if chunk.pos >= chunk.end:
@@ -473,7 +642,9 @@ class LineSplitter(InputSplitBase):
             eol = min(n, r)
         if eol < 0:
             eol = chunk.end
-        rec = memoryview(data)[chunk.pos : eol]
+        if chunk.mv is None:
+            chunk.mv = memoryview(chunk.data)
+        rec = chunk.mv[chunk.pos : eol]
         # skip consecutive EOL bytes (line_split.cc:41-44)
         p = eol
         while p < chunk.end and data[p] in (10, 13):
@@ -507,49 +678,77 @@ class RecordIOSplitter(InputSplitBase):
                     break
         return nstep - 8
 
-    def find_last_record_begin(self, buf, end: int) -> int:
+    def find_last_record_begin(self, buf, begin: int, end: int) -> int:
         # backward u32 scan from end-2 words (recordio_split.cc:26-42);
-        # buf is bytes-like (bytearray in the hot path — no copy)
-        check(end % 4 == 0, "unaligned recordio chunk")
-        check(end >= 8, "recordio chunk too small")
-        idx = native.recordio_find_last(memoryview(buf)[:end], KMAGIC)
+        # buf is bytes-like (bytearray or mmap in the hot path — no copy)
+        if end - begin < 8:
+            return begin  # too small to hold a head: no complete record
+        check((end - begin) % 4 == 0, "unaligned recordio chunk")
+        idx = native.recordio_find_last(memoryview(buf)[begin:end], KMAGIC)
         if idx is not None:
-            return idx
+            return begin + idx
         hi = end - 4  # a head needs magic at idx plus lrec at idx+4
         while True:
-            idx = buf.rfind(_MAGIC_BYTES, 0, hi)
-            if idx <= 0:
-                return 0
-            if idx % 4 == 0:
+            idx = buf.rfind(_MAGIC_BYTES, begin, hi)
+            if idx <= begin:
+                return begin
+            if (idx - begin) % 4 == 0:
                 cflag = decode_flag(_U32.unpack_from(buf, idx + 4)[0])
                 if cflag in (0, 1):
                     return idx
             hi = idx + 3  # next candidate strictly below idx
 
+    def seek_record_begin_mm(self, mm, off: int, end: int) -> int:
+        # mmap analog of the stream scan: find an aligned magic whose lrec
+        # carries a head cflag; after a non-head cell the scan resumes past
+        # its lrec word, matching the u32-wise stream walk
+        pos = off
+        while True:
+            idx = mm.find(_MAGIC_BYTES, pos, end)
+            if idx < 0:
+                return end - off  # consumed everything, like stream EOF
+            if (idx - off) % 4 != 0:
+                pos = idx + 1
+                continue
+            check(idx + 8 <= end, "invalid recordio format")
+            cflag = decode_flag(_U32.unpack_from(mm, idx + 4)[0])
+            if cflag in (0, 1):
+                return idx - off
+            pos = idx + 8
+
     def extract_next_record(self, chunk: ChunkCursor) -> Optional[memoryview]:
         if chunk.pos >= chunk.end:
             return None
         # native fast path: scan the whole chunk once, then serve spans
-        if chunk.spans is None and chunk.pos == 0:
+        # as plain int triples (no per-record numpy unpacking)
+        if chunk.spans is None and chunk.pos == chunk.start:
             try:
-                chunk.spans = native.recordio_spans(
-                    memoryview(chunk.data)[: chunk.end], KMAGIC)
+                sp = native.recordio_spans(
+                    memoryview(chunk.data)[chunk.start : chunk.end], KMAGIC)
             except ValueError as e:
                 raise DMLCError(str(e)) from e
-        if chunk.spans is not None:
-            if chunk.span_i >= len(chunk.spans):
+            if sp is not None:
+                base = chunk.start
+                lst = sp.tolist()
+                if base:
+                    for t in lst:
+                        t[0] += base
+                chunk.spans = lst
+                chunk.mv = memoryview(chunk.data)
+        sp = chunk.spans
+        if sp is not None:
+            i = chunk.span_i
+            if i >= len(sp):
                 chunk.pos = chunk.end
                 return None
-            off, length, flag = (int(v) for v in chunk.spans[chunk.span_i])
-            chunk.span_i += 1
+            off, length, flag = sp[i]
+            chunk.span_i = i + 1
             if flag == 0:
                 chunk.pos = off + ((length + 3) & ~3)
-                return memoryview(chunk.data)[off : off + length]
+                return chunk.mv[off : off + length]
             # rare multi-segment record: reassemble via the Python walk
-            sub = ChunkCursor(chunk.data)
+            sub = ChunkCursor(chunk.data, start=off, end=off + length)
             sub.spans = ()  # force the Python path below
-            sub.pos = off
-            sub.end = off + length
             chunk.pos = sub.end
             return self._extract_py(sub)
         return self._extract_py(chunk)

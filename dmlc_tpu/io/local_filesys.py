@@ -61,3 +61,8 @@ class LocalFileSystem(FileSystem):
     def open_for_read(self, path: URI, allow_null: bool = False) -> Optional[SeekStream]:
         strm = self.open(path, "r", allow_null=allow_null)
         return strm  # FileStream is a SeekStream
+
+    def local_path(self, path: URI) -> Optional[str]:
+        if path.name in ("stdin", "stdout"):
+            return None
+        return path.name

@@ -57,7 +57,7 @@ class ThreadedInputSplit(InputSplit):
         if not ok:
             return None
         self._chunk = cur
-        return memoryview(cur.data)[: cur.end]
+        return memoryview(cur.data)[cur.pos : cur.end]
 
     def before_first(self) -> None:
         self._iter.before_first()

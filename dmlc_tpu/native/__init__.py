@@ -4,6 +4,12 @@ The shared library is compiled on demand with g++ (one-time, cached next
 to this package) — no pybind/pip dependency.  Every entry point has a
 pure-Python fallback in its caller; set DMLC_TPU_DISABLE_NATIVE=1 to
 force the fallbacks (tests exercise both paths).
+
+All entry points accept any bytes-like object (bytes, bytearray,
+memoryview — including memoryviews over mmap) with zero copies: the
+buffer pointer is passed straight to C, and ctypes releases the GIL for
+the duration of the call, so multi-threaded parses (``nthread > 1``) and
+concurrent Python threads genuinely overlap.
 """
 
 from __future__ import annotations
@@ -20,7 +26,7 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "cpp",
                     "dmlc_native.cc")
 _SO = os.path.join(_HERE, "libdmlc_native.so")
-_ABI = 1
+_ABI = 2
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -30,7 +36,8 @@ _tried = False
 def _build() -> Optional[str]:
     if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
         return _SO
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO]
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO,
+           "-pthread"]
     try:
         r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired):
@@ -65,16 +72,16 @@ def _load():
         lib.dmlc_parse_libsvm.restype = c.c_long
         lib.dmlc_parse_libsvm.argtypes = [
             c.c_void_p, c.c_long, c.c_void_p, c.c_void_p, c.c_void_p,
-            c.c_void_p, c.c_void_p, c.c_long, c.c_long,
+            c.c_void_p, c.c_void_p, c.c_long, c.c_long, c.c_int,
             c.POINTER(c.c_long), c.POINTER(c.c_long), c.POINTER(c.c_int)]
         lib.dmlc_parse_libfm.restype = c.c_long
         lib.dmlc_parse_libfm.argtypes = [
             c.c_void_p, c.c_long, c.c_void_p, c.c_void_p, c.c_void_p,
-            c.c_void_p, c.c_void_p, c.c_void_p, c.c_long, c.c_long,
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_long, c.c_long, c.c_int,
             c.POINTER(c.c_long), c.POINTER(c.c_long), c.POINTER(c.c_int)]
         lib.dmlc_parse_csv.restype = c.c_long
         lib.dmlc_parse_csv.argtypes = [
-            c.c_void_p, c.c_long, c.c_char, c.c_void_p, c.c_long,
+            c.c_void_p, c.c_long, c.c_char, c.c_int, c.c_void_p, c.c_long,
             c.POINTER(c.c_long), c.POINTER(c.c_long)]
         lib.dmlc_recordio_spans.restype = c.c_long
         lib.dmlc_recordio_spans.argtypes = [
@@ -92,26 +99,32 @@ def available() -> bool:
 
 
 def _as_carray(data):
-    """(ptr, len) for bytes/bytearray/memoryview without copy."""
+    """(np array view, ptr, len) for any bytes-like without copy."""
     mv = memoryview(data)
     if mv.ndim != 1 or mv.itemsize != 1:
         mv = mv.cast("B")
     arr = np.frombuffer(mv, np.uint8)
-    return arr.ctypes.data, arr.size
+    return arr, arr.ctypes.data, arr.size
 
 
-def parse_libsvm(data) -> Optional[dict]:
+def _count(data, arr: np.ndarray, byte: int) -> int:
+    """Occurrences of ``byte`` — C-speed .count when the object has it,
+    vectorized numpy otherwise (memoryview has no .count)."""
+    if isinstance(data, (bytes, bytearray)):
+        return data.count(bytes((byte,)))
+    return int(np.count_nonzero(arr == byte))
+
+
+def parse_libsvm(data, nthread: int = 1) -> Optional[dict]:
     """Parse a LibSVM chunk.  Returns dict of arrays or None if native
     unavailable.  Raises ValueError on malformed input."""
     lib = _load()
     if lib is None:
         return None
-    if isinstance(data, memoryview):
-        data = bytes(data)
-    ptr, n = _as_carray(data)
-    max_rows = data.count(b"\n") + 2
+    arr, ptr, n = _as_carray(data)
+    max_rows = _count(data, arr, 10) + 2
     # nnz bound: one feature per separator-delimited token
-    max_nnz = data.count(b" ") + data.count(b"\t") + max_rows + 1
+    max_nnz = _count(data, arr, 32) + _count(data, arr, 9) + max_rows + 1
     while True:
         labels = np.empty(max_rows, np.float32)
         weights = np.empty(max_rows, np.float32)
@@ -124,8 +137,8 @@ def parse_libsvm(data) -> Optional[dict]:
         ret = lib.dmlc_parse_libsvm(
             ptr, n, labels.ctypes.data, weights.ctypes.data,
             offsets.ctypes.data, index.ctypes.data, value.ctypes.data,
-            max_rows, max_nnz, ctypes.byref(n_rows), ctypes.byref(n_nnz),
-            ctypes.byref(has_w))
+            max_rows, max_nnz, nthread, ctypes.byref(n_rows),
+            ctypes.byref(n_nnz), ctypes.byref(has_w))
         if ret == -1:
             max_rows *= 2
             max_nnz *= 2
@@ -139,15 +152,13 @@ def parse_libsvm(data) -> Optional[dict]:
         }
 
 
-def parse_libfm(data) -> Optional[dict]:
+def parse_libfm(data, nthread: int = 1) -> Optional[dict]:
     lib = _load()
     if lib is None:
         return None
-    if isinstance(data, memoryview):
-        data = bytes(data)
-    ptr, n = _as_carray(data)
-    max_rows = data.count(b"\n") + 2
-    max_nnz = data.count(b" ") + data.count(b"\t") + max_rows + 1
+    arr, ptr, n = _as_carray(data)
+    max_rows = _count(data, arr, 10) + 2
+    max_nnz = _count(data, arr, 32) + _count(data, arr, 9) + max_rows + 1
     while True:
         labels = np.empty(max_rows, np.float32)
         weights = np.empty(max_rows, np.float32)
@@ -161,7 +172,7 @@ def parse_libfm(data) -> Optional[dict]:
         ret = lib.dmlc_parse_libfm(
             ptr, n, labels.ctypes.data, weights.ctypes.data,
             offsets.ctypes.data, fields.ctypes.data, index.ctypes.data,
-            value.ctypes.data, max_rows, max_nnz,
+            value.ctypes.data, max_rows, max_nnz, nthread,
             ctypes.byref(n_rows), ctypes.byref(n_nnz), ctypes.byref(has_w))
         if ret == -1:
             max_rows *= 2
@@ -177,7 +188,7 @@ def parse_libfm(data) -> Optional[dict]:
         }
 
 
-def parse_csv(data, delim: bytes = b",") -> Optional[tuple]:
+def parse_csv(data, delim: bytes = b",", nthread: int = 1) -> Optional[np.ndarray]:
     """Returns (values [rows, cols] f32) or None; raises on bad input.
 
     Whitespace delimiters are not supported natively (the number scanner
@@ -185,13 +196,14 @@ def parse_csv(data, delim: bytes = b",") -> Optional[tuple]:
     lib = _load()
     if lib is None or delim in (b" ", b"\t", b"\r"):
         return None
-    ptr, n = _as_carray(data)
+    arr, ptr, n = _as_carray(data)
     max_vals = n // 2 + 16
     out = np.empty(max_vals, np.float32)
     n_rows = ctypes.c_long()
     n_cols = ctypes.c_long()
-    ret = lib.dmlc_parse_csv(ptr, n, delim, out.ctypes.data, max_vals,
-                             ctypes.byref(n_rows), ctypes.byref(n_cols))
+    ret = lib.dmlc_parse_csv(ptr, n, delim, nthread, out.ctypes.data,
+                             max_vals, ctypes.byref(n_rows),
+                             ctypes.byref(n_cols))
     if ret == -2:
         raise ValueError("CSV: non-numeric cell")
     if ret == -3:
@@ -204,11 +216,12 @@ def parse_csv(data, delim: bytes = b",") -> Optional[tuple]:
 
 def recordio_spans(data, magic: int):
     """(spans [n,3] uint64: offset, len, flag) or None.  flag 0 = zero-copy
-    payload span; flag 1 = multi-segment region needing reassembly."""
+    payload span; flag 1 = multi-segment region needing reassembly.
+    Raises ValueError if the chunk is not a clean sequence of records."""
     lib = _load()
     if lib is None:
         return None
-    ptr, n = _as_carray(data)
+    _, ptr, n = _as_carray(data)
     max_spans = max(n // 12 + 2, 16)
     while True:
         out = np.empty((max_spans, 3), np.uint64)
@@ -227,5 +240,5 @@ def recordio_find_last(data, magic: int) -> Optional[int]:
     lib = _load()
     if lib is None:
         return None
-    ptr, n = _as_carray(data)
+    _, ptr, n = _as_carray(data)
     return int(lib.dmlc_recordio_find_last(ptr, n, magic))

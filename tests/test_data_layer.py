@@ -244,3 +244,43 @@ def test_csv_tab_delimiter_falls_back(tmp_path):
     np.testing.assert_allclose(blk[0].value, [1, 2.5])
     np.testing.assert_allclose(blk[1].value, [3, 4.5])
     parser.close()
+
+
+# ---------- native parallel chunk parse (text_parser.h:89-118 analog) ----
+
+def _collect_blocks(uri, fmt, nthread, **kw):
+    parser = create_parser(uri, type=fmt, threaded=False, nthread=nthread, **kw)
+    rows = []
+    for blk in parser:
+        for i in range(blk.size):
+            row = blk[i]
+            rows.append((row.label, row.weight,
+                         tuple(row.index.tolist()),
+                         tuple(np.asarray(row.value).tolist()) if row.value is not None else None))
+    if hasattr(parser, "close"):
+        parser.close()
+    return rows
+
+
+@pytest.mark.parametrize("fmt,sample", [
+    ("libsvm", None),
+    ("csv", b"1.0,2.0,3.0\n4.0,5.0,6.0\n7.5,8.5,9.5\n" * 50),
+    ("libfm", b"1 1:3:0.5 2:7:1.5\n0 1:2:2.0\n" * 70),
+])
+def test_parse_nthread_identical_output(tmp_path, fmt, sample):
+    if sample is None:
+        import random
+        rng = random.Random(7)
+        lines = []
+        for i in range(500):
+            feats = " ".join(
+                f"{rng.randrange(0, 100)}:{rng.uniform(-5, 5):.4f}"
+                for _ in range(rng.randrange(0, 12))
+            )
+            lines.append(f"{rng.randrange(0, 2)} {feats}".strip())
+        sample = ("\n".join(lines) + "\n").encode()
+    p = write(tmp_path, f"data.{fmt}", sample)
+    one = _collect_blocks(p, fmt, nthread=1)
+    four = _collect_blocks(p, fmt, nthread=4)
+    assert len(one) > 0
+    assert one == four

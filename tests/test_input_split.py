@@ -347,3 +347,89 @@ def test_single_file_split(tmp_path):
     assert [bytes(r) for r in sp] == [b"x", b"y", b"z"]
     sp.before_first()
     assert sp.next_record() is not None
+
+
+# ---------- zero-copy (mmap) fast path vs generic copy path -------------
+
+def _read_with_mode(monkeypatch, uri, typ, num_parts, mmap_on, hint=None):
+    if mmap_on:
+        monkeypatch.delenv("DMLC_TPU_DISABLE_MMAP", raising=False)
+    else:
+        monkeypatch.setenv("DMLC_TPU_DISABLE_MMAP", "1")
+    out = []
+    for part in range(num_parts):
+        sp = isplit.create(uri, part, num_parts, typ, threaded=False)
+        if hint:
+            sp.hint_chunk_size(hint)
+        out.append(read_all(sp))
+        sp.close()
+    return out
+
+
+@pytest.mark.parametrize("num_parts", [1, 2, 3, 5])
+def test_mmap_matches_copy_path_text(tmp_path, monkeypatch, num_parts):
+    uri, lines = make_text_files(tmp_path)
+    fast = _read_with_mode(monkeypatch, uri, "text", num_parts, True)
+    slow = _read_with_mode(monkeypatch, uri, "text", num_parts, False)
+    assert fast == slow
+    assert [r.decode() for part in fast for r in part] == lines
+
+
+@pytest.mark.parametrize("num_parts", [1, 2, 4])
+def test_mmap_matches_copy_path_recordio(tmp_path, monkeypatch, num_parts):
+    paths = []
+    recs = []
+    for i in range(3):
+        p, r = make_recordio_file(tmp_path, n=97, seed=10 + i, name=f"f{i}.rec")
+        paths.append(p)
+        recs.extend(r)
+    uri = ";".join(paths)
+    fast = _read_with_mode(monkeypatch, uri, "recordio", num_parts, True)
+    slow = _read_with_mode(monkeypatch, uri, "recordio", num_parts, False)
+    assert fast == slow
+    assert [r for part in fast for r in part] == recs
+
+
+def test_mmap_text_line_crosses_file_seam(tmp_path, monkeypatch):
+    # file A has no trailing newline: its last line joins file B's first
+    # line in the concatenated byte space (reference Read() semantics)
+    a = tmp_path / "a.txt"
+    b = tmp_path / "b.txt"
+    a.write_bytes(b"alpha\nbeta\ngam")
+    b.write_bytes(b"ma\ndelta\n")
+    uri = f"{a};{b}"
+    fast = _read_with_mode(monkeypatch, uri, "text", 1, True)
+    slow = _read_with_mode(monkeypatch, uri, "text", 1, False)
+    assert fast == slow
+    assert fast[0] == [b"alpha", b"beta", b"gamma", b"delta"]
+
+
+def test_mmap_seam_with_tiny_chunks(tmp_path, monkeypatch):
+    # tiny hint forces many windows + the stitch path right at the seam
+    a = tmp_path / "a.txt"
+    b = tmp_path / "b.txt"
+    a.write_bytes(b"one\ntwo\nthree-is-longer-than-the-hint")
+    b.write_bytes(b"...continued\nfour\n")
+    uri = f"{a};{b}"
+    fast = _read_with_mode(monkeypatch, uri, "text", 1, True, hint=8)
+    slow = _read_with_mode(monkeypatch, uri, "text", 1, False, hint=8)
+    assert fast == slow
+    assert fast[0][2] == b"three-is-longer-than-the-hint...continued"
+
+
+def test_mmap_recordio_tiny_hint(tmp_path, monkeypatch):
+    path, recs = make_recordio_file(tmp_path, n=61, seed=3)
+    fast = _read_with_mode(monkeypatch, path, "recordio", 2, True, hint=16)
+    slow = _read_with_mode(monkeypatch, path, "recordio", 2, False, hint=16)
+    assert fast == slow
+    assert [r for part in fast for r in part] == recs
+
+
+def test_mmap_before_first_rereads_identically(tmp_path):
+    uri, lines = make_text_files(tmp_path, n_files=2)
+    sp = isplit.create(uri, 0, 2, "text", threaded=False)
+    first = read_all(sp)
+    sp.before_first()
+    second = read_all(sp)
+    sp.close()
+    assert first == second
