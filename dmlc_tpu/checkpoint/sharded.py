@@ -144,6 +144,51 @@ def _parse_index(ikey: str, shape) -> tuple:
     )
 
 
+def _try_extents(ikey: str, shape) -> Optional[tuple]:
+    """((start, stop), ...) if ikey is a well-formed in-bounds shard key
+    for ``shape``, else None (e.g. a suffix captured from another leaf)."""
+    if ikey == "scalar":
+        return () if shape == () else None
+    parts = ikey.split("_")
+    if len(parts) != len(shape):
+        return None
+    out = []
+    for p, dim in zip(parts, shape):
+        m = p.split("-")
+        if len(m) != 2 or not (m[0].isdigit() and m[1].isdigit()):
+            return None
+        a, b = int(m[0]), int(m[1])
+        if not (0 <= a < b <= dim):
+            return None
+        out.append((a, b))
+    return tuple(out)
+
+
+def _exact_cover(ikeys, shape) -> bool:
+    """True iff the shard boxes tile the array exactly: pairwise disjoint
+    and total volume == array size (O(#shards) memory, no bool mask)."""
+    boxes = [_try_extents(k, shape) for k in ikeys]
+    if any(b is None for b in boxes):
+        return False
+    total = 1
+    for d in shape:
+        total *= d
+    vol = 0
+    for b in boxes:
+        v = 1
+        for a, c in b:
+            v *= c - a
+        vol += v
+    if vol != total:
+        return False
+    for i in range(len(boxes)):
+        for j in range(i + 1, len(boxes)):
+            if all(a1 < b2 and a2 < b1
+                   for (a1, b1), (a2, b2) in zip(boxes[i], boxes[j])):
+                return False  # overlap (scalar duplicates hit vol != total)
+    return True
+
+
 def restore_pytree(uri: str, template: Any, *, mesh=None) -> Any:
     """Restore a pytree saved by save_pytree.
 
@@ -161,10 +206,51 @@ def restore_pytree(uri: str, template: Any, *, mesh=None) -> Any:
     paths, treedef = jax.tree_util.tree_flatten_with_path(template)
 
     def load_shard_bytes(key: str, ikey: str, dtype, shape) -> np.ndarray:
-        fname = leaves_meta[key]["shards"][ikey]
-        with Stream.create(_join(uri, fname), "r") as s:
+        # shard filenames are derived deterministically (f"{key}.{ikey}"),
+        # NOT looked up in the manifest: in a multi-host save every process
+        # writes its own addressable shards but only process 0 writes the
+        # manifest, so the manifest's shards dict covers one process only
+        with Stream.create(_join(uri, f"{key}.{ikey}"), "r") as s:
             raw = _read_all(s)
         return np.frombuffer(raw, dtype=dtype).reshape(shape)
+
+    listing_cache: list = []
+
+    def dir_listing() -> list:
+        """Checkpoint-dir file names, listed once per restore (lazy)."""
+        if not listing_cache:
+            from ..io.filesys import FileSystem
+            from ..io.uri import URI
+
+            base = URI(uri if "://" in uri else "file://" + uri)
+            fs = FileSystem.get_instance(base)
+            listing_cache.append(
+                [f.path.name.rsplit("/", 1)[-1]
+                 for f in fs.list_directory(base)])
+        return listing_cache[0]
+
+    def shard_keys_for(key: str, meta, shape) -> list:
+        """Shard ikeys covering the leaf.  The manifest is the fast path;
+        when it does not cover the array (multi-host save: each process
+        writes its shards but only process 0 writes the manifest), the
+        directory listing supplies the rest.  Suffixes are validated as
+        ikeys for this shape, so a leaf key that dot-prefixes another
+        leaf's key never captures the other leaf's files."""
+        ikeys = [k for k in meta["shards"]
+                 if _try_extents(k, shape) is not None]
+        if _exact_cover(ikeys, shape):
+            return ikeys
+        prefix = key + "."
+        extra = {n[len(prefix):] for n in dir_listing()
+                 if n.startswith(prefix)}
+        ikeys = sorted(set(ikeys)
+                       | {k for k in extra if _try_extents(k, shape)})
+        check(_exact_cover(ikeys, shape),
+              f"checkpoint leaf {key}: shard files {ikeys} do not tile the "
+              f"array exactly (incomplete multi-host save, or stale shards "
+              f"from a save with a different sharding layout — clean the "
+              f"checkpoint directory)")
+        return ikeys
 
     out_leaves = []
     for path, _ in paths:
@@ -191,7 +277,7 @@ def restore_pytree(uri: str, template: Any, *, mesh=None) -> Any:
                 jax.make_array_from_callback(shape, sharding, cb))
         else:
             full = np.zeros(shape, dtype)
-            for ikey in meta["shards"]:
+            for ikey in shard_keys_for(key, meta, shape):
                 idx = _parse_index(ikey, shape)
                 sub_shape = tuple(sl.stop - sl.start for sl in idx)
                 full[idx] = load_shard_bytes(key, ikey, dtype, sub_shape)
@@ -208,6 +294,9 @@ class CheckpointManager:
     """
 
     def __init__(self, base_uri: str, *, max_to_keep: int = 3):
+        check(max_to_keep >= 1,
+              f"max_to_keep must be >= 1, got {max_to_keep} (0 would "
+              f"delete every checkpoint including the one just saved)")
         self.base = base_uri.rstrip("/")
         self.max_to_keep = max_to_keep
 

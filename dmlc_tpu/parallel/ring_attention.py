@@ -61,6 +61,7 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = True,
     scale: Optional[float] = None,
+    impl: str = "auto",
 ):
     """Exact multi-head attention over a ring of sequence shards.
 
@@ -68,12 +69,33 @@ def ring_attention(
     shapes [B, T_local, H, D] where T_global = T_local * axis_size(sp).
     Head layouts may additionally be tensor-sharded; this function only
     touches the sequence dimension.
+
+    ``impl``: 'auto' routes each ring step through the Pallas flash
+    kernel (ops/flash_attention) on TPU when the shapes pass its
+    alignment gate, pure-lax otherwise; 'flash' forces the kernel
+    (interpret mode off-TPU, for tests); 'lax' forces the fallback.
     """
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     b, t_local, h, d = q.shape
     if scale is None:
         scale = 1.0 / (d ** 0.5)
+
+    from ..ops import flash_attention as _flash
+
+    interpret = False
+    if impl == "auto":
+        use_flash = (
+            jax.default_backend() == "tpu"
+            and _flash.supports(q.shape, k.shape, 128, 128)
+        )
+    elif impl == "flash":
+        use_flash = True
+        interpret = jax.default_backend() != "tpu"
+    elif impl == "lax":
+        use_flash = False
+    else:
+        raise ValueError(f"unknown ring_attention impl {impl!r}")
 
     q_pos = jnp.arange(t_local)  # local positions; global = blk*t_local + pos
     acc0 = jnp.zeros((b, t_local, h, d), jnp.float32)
@@ -86,14 +108,22 @@ def ring_attention(
     def step(i, carry):
         acc, m, l, k_blk, v_blk = carry
         src = (my - i) % n  # ring position the held KV block originated from
-        if causal:
-            # global causal mask between my Q block and the src KV block
-            gq = my * t_local + q_pos[:, None]
-            gk = src * t_local + q_pos[None, :]
-            mask = gq >= gk
+        if use_flash:
+            # the kernel takes the global offsets as scalar-prefetch args,
+            # so one compiled kernel serves every ring step
+            pv, bm, bl = _flash.block_attend_flash(
+                q, k_blk, v_blk, scale=scale, causal=causal,
+                q_offset=my * t_local, kv_offset=src * t_local,
+                interpret=interpret)
         else:
-            mask = None
-        pv, bm, bl = _block_attend(q, k_blk, v_blk, scale=scale, mask=mask)
+            if causal:
+                # global causal mask between my Q block and the src KV block
+                gq = my * t_local + q_pos[:, None]
+                gk = src * t_local + q_pos[None, :]
+                mask = gq >= gk
+            else:
+                mask = None
+            pv, bm, bl = _block_attend(q, k_blk, v_blk, scale=scale, mask=mask)
         m_new = jnp.maximum(m, bm)
         corr = jnp.exp(m - m_new)          # rescale old accumulator
         bcor = jnp.exp(bm - m_new)         # rescale this block
@@ -134,12 +164,14 @@ def ring_attention_reference(q, k, v, *, causal: bool = True, scale=None):
     return out.astype(q.dtype)
 
 
-def make_sharded_ring_attention(mesh, *, causal: bool = True):
+def make_sharded_ring_attention(mesh, *, causal: bool = True,
+                                impl: str = "auto"):
     """Wrap ring_attention in shard_map over (sp sequence, tp heads)."""
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, "sp", "tp", None)
-    fn = functools.partial(ring_attention, axis_name="sp", causal=causal)
+    fn = functools.partial(ring_attention, axis_name="sp", causal=causal,
+                          impl=impl)
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,

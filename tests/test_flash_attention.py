@@ -42,14 +42,16 @@ def test_block_attend_matches_lax_with_offsets():
     v = jax.random.normal(kv, (b, tk, h, d), jnp.float32)
     scale = 1.0 / (d ** 0.5)
 
-    # emulate ring step: q block at global 64, kv block at global 32
+    # emulate ring step: q and kv blocks at the SAME global offset, so the
+    # mask is genuinely triangular and the causal path is exercised
     q_pos = np.arange(tq)
-    gq = 64 + q_pos[:, None]
+    gq = 32 + q_pos[:, None]
     gk = 32 + q_pos[None, :]
     mask = jnp.asarray(gq >= gk)
+    assert bool(mask.all()) is False  # partially masked, not all-visible
     pv_l, m_l, l_l = _block_attend(q, k, v, scale=scale, mask=mask)
     pv_f, m_f, l_f = block_attend_flash(
-        q, k, v, scale=scale, causal=True, q_offset=64, kv_offset=32,
+        q, k, v, scale=scale, causal=True, q_offset=32, kv_offset=32,
         block_q=16, block_k=16, interpret=True)
     np.testing.assert_allclose(np.asarray(pv_f), np.asarray(pv_l), atol=2e-5)
     np.testing.assert_allclose(np.asarray(m_f), np.asarray(m_l), atol=2e-5)
@@ -72,7 +74,16 @@ def test_flash_under_jit_with_traced_offsets():
             block_q=16, block_k=16, interpret=True)
         return pv
 
-    a = run(q, jnp.int32(32))
-    b2 = run(q, jnp.int32(320))  # same compiled kernel, different offset
-    # larger q offset -> strictly more keys visible -> different result
+    # q_offset=0 vs kv at 0 is triangular; q_offset=320 is fully visible —
+    # the same compiled kernel must produce different results (proving the
+    # offsets are traced, not baked in at trace time)
+    a = run(q, jnp.int32(0))
+    b2 = run(q, jnp.int32(320))
     assert not np.allclose(np.asarray(a), np.asarray(b2))
+    # and each run matches the lax oracle for its mask
+    q_pos = np.arange(t)
+    for off, out in ((0, a), (320, b2)):
+        mask = jnp.asarray(off + q_pos[:, None] >= q_pos[None, :])
+        pv_l, _, _ = _block_attend(q, q, q, scale=0.1, mask=mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(pv_l),
+                                   atol=2e-5)
