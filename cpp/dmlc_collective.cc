@@ -1,0 +1,481 @@
+// Native collective backend for dmlc_tpu (see dmlc_collective.h).
+//
+// Speaks the tracker rendezvous protocol (native-endian int32 frames,
+// magic 0xff99, string frames as [len][bytes] — reference
+// tracker/dmlc_tracker/tracker.py:24-50 behavior) against
+// dmlc_tpu/tracker/rendezvous.py, builds the brokered peer overlay, and
+// runs binomial-tree reductions over it.  Topology math mirrors
+// dmlc_tpu/tracker/protocol.py (heap tree + DFS ring relabel,
+// reference tracker.py:165-252) so every rank can recompute the global
+// tree locally — which is what lets broadcast/allgather route through
+// arbitrary roots without extra tracker round trips.
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC dmlc_collective.cc -o libdmlc_collective.so
+
+#include "dmlc_collective.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int32_t kMagic = 0xff99;
+constexpr long kMaxFrame = 0x7fffffffL;  // int32 length frames: < 2 GiB
+constexpr int kBrokerRetries = 50;       // ~10 s of peer-dial retries
+
+thread_local std::string g_init_error;
+
+// ---------------------------------------------------------------------
+// framing
+struct Frame {
+  int fd = -1;
+
+  bool send_all(const void* buf, size_t n) {
+    const char* p = static_cast<const char*>(buf);
+    while (n > 0) {
+      ssize_t k = ::send(fd, p, n, MSG_NOSIGNAL);
+      if (k <= 0) return false;
+      p += k;
+      n -= static_cast<size_t>(k);
+    }
+    return true;
+  }
+  bool recv_all(void* buf, size_t n) {
+    char* p = static_cast<char*>(buf);
+    while (n > 0) {
+      ssize_t k = ::recv(fd, p, n, 0);
+      if (k <= 0) return false;
+      p += k;
+      n -= static_cast<size_t>(k);
+    }
+    return true;
+  }
+  bool send_int(int32_t v) { return send_all(&v, 4); }
+  bool recv_int(int32_t* v) { return recv_all(v, 4); }
+  bool send_str(const std::string& s) {
+    return send_int(static_cast<int32_t>(s.size())) &&
+           (s.empty() || send_all(s.data(), s.size()));
+  }
+  bool recv_str(std::string* s) {
+    int32_t n;
+    if (!recv_int(&n) || n < 0) return false;
+    s->resize(static_cast<size_t>(n));
+    return n == 0 || recv_all(&(*s)[0], static_cast<size_t>(n));
+  }
+  void close() {
+    if (fd >= 0) ::close(fd);
+    fd = -1;
+  }
+};
+
+int dial(const std::string& host, int port) {
+  addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  char portbuf[16];
+  snprintf(portbuf, sizeof portbuf, "%d", port);
+  if (getaddrinfo(host.c_str(), portbuf, &hints, &res) != 0) return -1;
+  int fd = -1;
+  for (addrinfo* ai = res; ai != nullptr; ai = ai->ai_next) {
+    fd = ::socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+    if (fd < 0) continue;
+    if (::connect(fd, ai->ai_addr, ai->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd >= 0) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  }
+  return fd;
+}
+
+// ---------------------------------------------------------------------
+// overlay topology (mirror of dmlc_tpu/tracker/protocol.py)
+void binomial_tree(int n, std::vector<std::vector<int>>* tree,
+                   std::vector<int>* parent) {
+  tree->assign(n, {});
+  parent->assign(n, -1);
+  for (int r = 0; r < n; ++r) {
+    if (r > 0) (*tree)[r].push_back((r + 1) / 2 - 1);
+    if (2 * r + 1 < n) (*tree)[r].push_back(2 * r + 1);
+    if (2 * r + 2 < n) (*tree)[r].push_back(2 * r + 2);
+    (*parent)[r] = (r + 1) / 2 - 1;
+  }
+}
+
+void dfs_ring(const std::vector<std::vector<int>>& tree,
+              const std::vector<int>& parent, int r, std::vector<int>* out) {
+  std::vector<int> children;
+  for (int v : tree[r])
+    if (v != parent[r]) children.push_back(v);
+  out->push_back(r);
+  for (size_t i = 0; i < children.size(); ++i) {
+    std::vector<int> sub;
+    dfs_ring(tree, parent, children[i], &sub);
+    if (i + 1 == children.size()) std::reverse(sub.begin(), sub.end());
+    out->insert(out->end(), sub.begin(), sub.end());
+  }
+}
+
+// Relabeled parent map: parent_of[new_rank] in ring-order labels.
+std::vector<int> relabeled_parents(int n) {
+  std::vector<std::vector<int>> tree;
+  std::vector<int> parent, order;
+  binomial_tree(n, &tree, &parent);
+  dfs_ring(tree, parent, 0, &order);
+  std::vector<int> relabel(n);
+  for (int i = 0; i < n; ++i) relabel[order[i]] = i;
+  std::vector<int> out(n, -1);
+  for (int r = 0; r < n; ++r)
+    out[relabel[r]] = parent[r] >= 0 ? relabel[parent[r]] : -1;
+  return out;
+}
+
+template <typename T>
+void fold(T* acc, const T* in, long n, int op) {
+  switch (op) {
+    case DMLC_SUM:
+      for (long i = 0; i < n; ++i) acc[i] += in[i];
+      break;
+    case DMLC_MAX:
+      for (long i = 0; i < n; ++i) acc[i] = std::max(acc[i], in[i]);
+      break;
+    default:
+      for (long i = 0; i < n; ++i) acc[i] = std::min(acc[i], in[i]);
+      break;
+  }
+}
+
+}  // namespace
+
+struct DmlcComm {
+  int rank = -1;
+  int world = -1;
+  int parent = -1;                 // my tree parent (tracker-reported)
+  std::vector<int> tree_nbrs;     // tracker-reported neighbours
+  std::vector<int> parents;       // full relabeled parent map, all ranks
+  std::map<int, Frame> links;     // peer rank -> socket
+  int listener = -1;
+  std::string tracker_host;
+  int tracker_port = 9091;
+  std::string jobid;
+  std::string error;
+
+  std::vector<int> children() const {
+    std::vector<int> out;
+    for (int r : tree_nbrs)
+      if (r != parent) out.push_back(r);
+    return out;
+  }
+
+  bool session(const char* cmd, Frame* fs, int world_hint = -1) {
+    fs->fd = dial(tracker_host, tracker_port);
+    if (fs->fd < 0) {
+      error = "cannot reach tracker " + tracker_host;
+      return false;
+    }
+    int32_t m;
+    if (!fs->send_int(kMagic) || !fs->recv_int(&m) || m != kMagic) {
+      error = "tracker magic mismatch";
+      fs->close();
+      return false;
+    }
+    if (!fs->send_int(rank) || !fs->send_int(world_hint) ||
+        !fs->send_str(jobid) || !fs->send_str(cmd)) {
+      error = "tracker handshake send failed";
+      fs->close();
+      return false;
+    }
+    return true;
+  }
+
+  bool send_block(Frame& f, const void* data, long n) {
+    return f.send_int(static_cast<int32_t>(n)) && f.send_all(data, n);
+  }
+  bool recv_block(Frame& f, void* data, long n) {
+    int32_t got;
+    if (!f.recv_int(&got) || got != n) return false;
+    return f.recv_all(data, n);
+  }
+};
+
+extern "C" {
+
+static DmlcComm* fail_init(DmlcComm* c) {
+  g_init_error = c->error.empty() ? "rendezvous protocol error" : c->error;
+  for (auto& kv : c->links) kv.second.close();
+  if (c->listener >= 0) ::close(c->listener);
+  delete c;
+  return nullptr;
+}
+
+DmlcComm* dmlc_comm_init(void) {
+  auto* c = new DmlcComm();
+  const char* uri = getenv("DMLC_TRACKER_URI");
+  const char* port = getenv("DMLC_TRACKER_PORT");
+  const char* jid = getenv("DMLC_TASK_ID");
+  c->tracker_host = uri ? uri : "127.0.0.1";
+  c->tracker_port = port ? atoi(port) : 9091;
+  c->jobid = jid ? jid : "NULL";
+
+  // accept socket for brokered peers
+  c->listener = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = 0;
+  if (c->listener < 0 ||
+      bind(c->listener, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0 ||
+      listen(c->listener, 16) != 0) {
+    c->error = "cannot bind accept socket";
+    return fail_init(c);
+  }
+  socklen_t alen = sizeof addr;
+  getsockname(c->listener, reinterpret_cast<sockaddr*>(&addr), &alen);
+  int my_port = ntohs(addr.sin_port);
+
+  Frame fs;
+  if (!c->session("start", &fs)) return fail_init(c);
+  int32_t n_nbrs = 0, ring_prev, ring_next;
+  bool ok = fs.recv_int(&c->rank) && fs.recv_int(&c->parent) &&
+            fs.recv_int(&c->world) && fs.recv_int(&n_nbrs);
+  for (int i = 0; ok && i < n_nbrs; ++i) {
+    int32_t r;
+    ok = fs.recv_int(&r);
+    c->tree_nbrs.push_back(r);
+  }
+  ok = ok && fs.recv_int(&ring_prev) && fs.recv_int(&ring_next);
+
+  // brokering: report good links, connect assigned peers, repeat until a
+  // round has zero dial errors (the tracker's nerr-retry loop,
+  // rendezvous.py:71-95 — transient peer failures must NOT tear down the
+  // tracker session, which would kill the whole job)
+  int32_t n_accept = 0;
+  int attempts = 0;
+  while (ok) {
+    ok = fs.send_int(static_cast<int32_t>(c->links.size()));
+    for (auto& kv : c->links) ok = ok && fs.send_int(kv.first);
+    int32_t n_conn = 0;
+    ok = ok && fs.recv_int(&n_conn) && fs.recv_int(&n_accept);
+    if (!ok) break;
+    int32_t nerr = 0;
+    for (int i = 0; ok && i < n_conn; ++i) {
+      std::string host;
+      int32_t pport, prank;
+      ok = fs.recv_str(&host) && fs.recv_int(&pport) && fs.recv_int(&prank);
+      if (!ok) break;
+      Frame pf;
+      pf.fd = dial(host, pport);
+      int32_t m, got;
+      bool linked = pf.fd >= 0 && pf.send_int(kMagic) &&
+                    pf.send_int(c->rank) && pf.recv_int(&m) && m == kMagic &&
+                    pf.recv_int(&got) && got == prank;
+      if (linked) {
+        c->links[prank] = pf;
+      } else {
+        pf.close();
+        ++nerr;
+      }
+    }
+    if (!ok) break;
+    if (nerr == 0) {
+      ok = fs.send_int(0) && fs.send_int(my_port);
+      break;
+    }
+    if (++attempts > kBrokerRetries) {
+      c->error = "peer connect failed after retries";
+      ok = false;
+      break;
+    }
+    ok = fs.send_int(nerr);  // tracker loops back to the good-links report
+    usleep(200 * 1000);
+  }
+  fs.close();
+  for (int i = 0; ok && i < n_accept; ++i) {
+    Frame pf;
+    pf.fd = accept(c->listener, nullptr, nullptr);
+    int32_t m, prank;
+    ok = pf.fd >= 0 && pf.recv_int(&m) && m == kMagic &&
+         pf.recv_int(&prank) && pf.send_int(kMagic) && pf.send_int(c->rank);
+    if (ok) {
+      c->links[prank] = pf;
+    } else {
+      pf.close();
+    }
+  }
+  if (!ok) {
+    if (c->error.empty()) c->error = "rendezvous failed";
+    return fail_init(c);
+  }
+  c->parents = relabeled_parents(c->world);
+  return c;
+}
+
+int dmlc_comm_rank(const DmlcComm* c) { return c->rank; }
+int dmlc_comm_world_size(const DmlcComm* c) { return c->world; }
+const char* dmlc_comm_last_error(const DmlcComm* c) {
+  // NULL queries the thread-local init failure (the comm is gone then)
+  return c == nullptr ? g_init_error.c_str() : c->error.c_str();
+}
+
+static int tree_allreduce_bytes(DmlcComm* c, void* data, long count,
+                                int dtype, int op) {
+  const long esize = (dtype == DMLC_F32 || dtype == DMLC_I32) ? 4 : 8;
+  const long nbytes = count * esize;
+  std::vector<char> tmp(nbytes);
+  // reduce up the tree
+  for (int ch : c->children()) {
+    if (!c->recv_block(c->links[ch], tmp.data(), nbytes)) return -1;
+    switch (dtype) {
+      case DMLC_F32:
+        fold(static_cast<float*>(data),
+             reinterpret_cast<const float*>(tmp.data()), count, op);
+        break;
+      case DMLC_F64:
+        fold(static_cast<double*>(data),
+             reinterpret_cast<const double*>(tmp.data()), count, op);
+        break;
+      case DMLC_I32:
+        fold(static_cast<int32_t*>(data),
+             reinterpret_cast<const int32_t*>(tmp.data()), count, op);
+        break;
+      case DMLC_I64:
+        fold(static_cast<int64_t*>(data),
+             reinterpret_cast<const int64_t*>(tmp.data()), count, op);
+        break;
+      default:
+        return -2;
+    }
+  }
+  if (c->parent >= 0) {
+    if (!c->send_block(c->links[c->parent], data, nbytes)) return -1;
+    if (!c->recv_block(c->links[c->parent], data, nbytes)) return -1;
+  }
+  for (int ch : c->children())
+    if (!c->send_block(c->links[ch], data, nbytes)) return -1;
+  return 0;
+}
+
+int dmlc_comm_allreduce(DmlcComm* c, void* data, long count, int dtype,
+                        int op) {
+  // validate BEFORE any communication: a rank erroring mid-protocol while
+  // its peers proceed would deadlock the tree
+  if (op < 0 || op > 2) return -2;
+  if (dtype < 0 || dtype > 3) return -2;
+  const long esize = (dtype == DMLC_F32 || dtype == DMLC_I32) ? 4 : 8;
+  if (count < 0 || count > kMaxFrame / esize) {
+    c->error = "allreduce payload exceeds the 2 GiB frame limit";
+    return -3;
+  }
+  if (c->world <= 1) return 0;
+  return tree_allreduce_bytes(c, data, count, dtype, op);
+}
+
+int dmlc_comm_broadcast(DmlcComm* c, void* data, long nbytes, int root) {
+  if (root < 0 || root >= c->world) return -2;
+  if (nbytes < 0 || nbytes > kMaxFrame) {
+    c->error = "broadcast payload exceeds the 2 GiB frame limit";
+    return -3;
+  }
+  if (c->world <= 1) return 0;
+  // relay root's buffer up its ancestor path to rank 0 (every rank can
+  // compute the path from the deterministic relabeled tree), then do a
+  // plain top-down tree broadcast
+  std::vector<bool> on_path(c->world, false);
+  for (int r = root; r >= 0; r = c->parents[r]) on_path[r] = true;
+  if (root != 0) {
+    if (c->rank != root && on_path[c->rank]) {
+      // which child of mine is on the path?
+      for (int ch : c->children()) {
+        if (on_path[ch]) {
+          if (!c->recv_block(c->links[ch], data, nbytes)) return -1;
+          break;
+        }
+      }
+    }
+    if (on_path[c->rank] && c->rank != 0) {
+      if (!c->send_block(c->links[c->parent], data, nbytes)) return -1;
+    }
+  }
+  // top-down from 0
+  if (c->rank != 0) {
+    if (!c->recv_block(c->links[c->parent], data, nbytes)) return -1;
+  }
+  for (int ch : c->children())
+    if (!c->send_block(c->links[ch], data, nbytes)) return -1;
+  return 0;
+}
+
+int dmlc_comm_allgather(DmlcComm* c, const void* in, long nbytes, void* out) {
+  if (nbytes < 0 || (c->world > 0 && nbytes > kMaxFrame / c->world)) {
+    c->error = "allgather total payload exceeds the 2 GiB frame limit";
+    return -3;
+  }
+  char* o = static_cast<char*>(out);
+  memcpy(o + c->rank * nbytes, in, nbytes);
+  if (c->world <= 1) return 0;
+  // gather subtree blocks to rank 0: each child sends (rank, block) pairs
+  std::vector<std::pair<int32_t, std::vector<char>>> blocks;
+  blocks.emplace_back(c->rank, std::vector<char>(
+      static_cast<const char*>(in), static_cast<const char*>(in) + nbytes));
+  for (int ch : c->children()) {
+    Frame& f = c->links[ch];
+    int32_t cnt;
+    if (!f.recv_int(&cnt)) return -1;
+    for (int i = 0; i < cnt; ++i) {
+      int32_t r;
+      std::vector<char> b(nbytes);
+      if (!f.recv_int(&r) || !f.recv_all(b.data(), nbytes)) return -1;
+      blocks.emplace_back(r, std::move(b));
+    }
+  }
+  if (c->parent >= 0) {
+    Frame& f = c->links[c->parent];
+    if (!f.send_int(static_cast<int32_t>(blocks.size()))) return -1;
+    for (auto& rb : blocks) {
+      if (!f.send_int(rb.first) || !f.send_all(rb.second.data(), nbytes))
+        return -1;
+    }
+  } else {
+    for (auto& rb : blocks)
+      memcpy(o + rb.first * nbytes, rb.second.data(), nbytes);
+  }
+  // broadcast the assembled buffer
+  return dmlc_comm_broadcast(c, out, nbytes * c->world, 0);
+}
+
+int dmlc_comm_log(DmlcComm* c, const char* msg) {
+  Frame fs;
+  if (!c->session("print", &fs)) return -1;
+  bool ok = fs.send_str(msg);
+  fs.close();
+  return ok ? 0 : -1;
+}
+
+void dmlc_comm_shutdown(DmlcComm* c) {
+  if (c == nullptr) return;
+  if (c->rank >= 0) {
+    Frame fs;
+    if (c->session("shutdown", &fs)) fs.close();
+  }
+  for (auto& kv : c->links) kv.second.close();
+  if (c->listener >= 0) ::close(c->listener);
+  delete c;
+}
+
+}  // extern "C"

@@ -1,0 +1,84 @@
+/*
+ * dmlc_collective.h — native-consumer collective C ABI (SURVEY.md §7 step 9).
+ *
+ * The substrate role of the reference (README.md:9 "backbone library to
+ * support all DMLC projects") is that NATIVE binaries — XGBoost-style,
+ * rabit-linked — can rendezvous and allreduce under the launcher's env
+ * contract.  This header is that surface for the TPU rebuild: a C program
+ * links libdmlc_collective.so, calls dmlc_comm_init() under `dmlc-submit`,
+ * and gets rank/world + tree allreduce/broadcast/allgather over the
+ * tracker's brokered TCP overlay (protocol: tracker/dmlc_tracker/
+ * tracker.py:24-135 behavior; topology tracker.py:165-252) — zero
+ * NCCL/CUDA/MPI dependency.  The TPU *device* data plane stays in XLA
+ * collectives (dmlc_tpu/parallel/collectives.py); this ABI is the host
+ * control/data plane that rabit provided downstream.
+ *
+ * Env contract (read by dmlc_comm_init):
+ *   DMLC_TRACKER_URI   tracker host (default 127.0.0.1)
+ *   DMLC_TRACKER_PORT  tracker port (default 9091)
+ *   DMLC_TASK_ID       job id used for rank re-admission (default "NULL")
+ */
+#ifndef DMLC_COLLECTIVE_H_
+#define DMLC_COLLECTIVE_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct DmlcComm DmlcComm;
+
+/* dtype codes for allreduce */
+enum {
+  DMLC_F32 = 0,
+  DMLC_F64 = 1,
+  DMLC_I32 = 2,
+  DMLC_I64 = 3,
+};
+
+/* reduction ops */
+enum {
+  DMLC_SUM = 0,
+  DMLC_MAX = 1,
+  DMLC_MIN = 2,
+};
+
+/* Rendezvous with the tracker and establish peer links.
+ * Returns NULL on failure (no tracker, protocol error). */
+DmlcComm* dmlc_comm_init(void);
+
+/* Rank / world size assigned by the tracker. */
+int dmlc_comm_rank(const DmlcComm* c);
+int dmlc_comm_world_size(const DmlcComm* c);
+
+/* In-place binomial-tree allreduce over `count` elements of `dtype`.
+ * Returns 0 on success, -2 on bad dtype/op, -3 if the payload exceeds
+ * the 2 GiB frame limit (int32 length frames, shared with the Python
+ * peer protocol), -1 on link errors.  All payload-size/argument errors
+ * are raised BEFORE any bytes move, so a failed call never desyncs the
+ * overlay.  The same limits apply to broadcast (nbytes) and allgather
+ * (nbytes * world). */
+int dmlc_comm_allreduce(DmlcComm* c, void* data, long count,
+                        int dtype, int op);
+
+/* Broadcast `nbytes` from `root`'s buffer to every rank (in place). */
+int dmlc_comm_broadcast(DmlcComm* c, void* data, long nbytes, int root);
+
+/* Gather each rank's `nbytes` block into out[world*nbytes], rank order. */
+int dmlc_comm_allgather(DmlcComm* c, const void* in, long nbytes, void* out);
+
+/* Relay a message through the tracker's print channel. */
+int dmlc_comm_log(DmlcComm* c, const char* msg);
+
+/* Send 'shutdown' to the tracker and release all sockets. */
+void dmlc_comm_shutdown(DmlcComm* c);
+
+/* Human-readable description of the last error on this comm ("" if none).
+ * Pass NULL to retrieve the (thread-local) reason a dmlc_comm_init call
+ * returned NULL. */
+const char* dmlc_comm_last_error(const DmlcComm* c);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* DMLC_COLLECTIVE_H_ */

@@ -1,0 +1,52 @@
+"""Native-consumer collective C ABI (cpp/dmlc_collective.{h,cc}).
+
+Builds libdmlc_collective.so + the pure-C driver and runs it under the
+real local launcher + tracker, proving a C program with zero
+NCCL/MPI/Python dependency can rendezvous and allreduce through the
+DMLC env contract — the substrate role the reference played for
+XGBoost/rabit (SURVEY.md §7 step 9).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CPP = os.path.join(REPO, "cpp")
+
+
+@pytest.fixture(scope="module")
+def driver(tmp_path_factory):
+    work = tmp_path_factory.mktemp("collective")
+    lib = str(work / "libdmlc_collective.so")
+    exe = str(work / "test_collective")
+    r = subprocess.run(
+        ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+         os.path.join(CPP, "dmlc_collective.cc"), "-o", lib],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    # the driver is plain C, compiled with a C compiler: proves ABI purity
+    r = subprocess.run(
+        ["gcc", "-O2", "-std=c99", "-I", CPP,
+         os.path.join(CPP, "test_collective.c"),
+         lib, "-o", exe, "-lm", f"-Wl,-rpath,{work}"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    return exe
+
+
+@pytest.mark.parametrize("world", [1, 2, 5, 8])
+def test_c_driver_collectives_under_local_launcher(driver, world):
+    env = os.environ.copy()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "dmlc_tpu.tracker.submit",
+         "--cluster", "local", "--num-workers", str(world), "--", driver],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "FAIL" not in r.stderr
+    # every rank logged through the tracker print relay
+    for rank in range(world):
+        assert f"rank {rank}/{world}: collective ABI OK" in r.stderr, r.stderr
