@@ -17,6 +17,7 @@ Design (TPU-first):
 
 from __future__ import annotations
 
+import functools
 import threading
 from queue import Queue
 from typing import Callable, Dict, Iterator, Optional
@@ -45,17 +46,32 @@ def pack_rowblock(blk, batch_size: int, max_nnz: int, num_col: int = 0):
     """
     b = min(batch_size, blk.size)
     label = np.zeros(batch_size, np.float32)
-    value = np.zeros((batch_size, max_nnz), np.float32)
-    index = np.zeros((batch_size, max_nnz), np.int32)
-    mask = np.zeros((batch_size, max_nnz), np.float32)
     label[:b] = blk.label[:b]
-    offsets = blk.offset
-    for i in range(b):
-        lo, hi = int(offsets[i]), int(offsets[i + 1])
-        k = min(hi - lo, max_nnz)
-        value[i, :k] = blk.value[lo : lo + k]
-        index[i, :k] = blk.index[lo : lo + k]
-        mask[i, :k] = 1.0
+    src_val = np.asarray(blk.value)
+    src_idx = np.asarray(blk.index)
+    if b == 0 or src_val.size == 0:
+        zeros = np.zeros((batch_size, max_nnz), np.float32)
+        return {"label": label, "value": zeros,
+                "index": np.zeros((batch_size, max_nnz), np.int32),
+                "mask": zeros.copy()}
+    # vectorized CSR -> padded batch via a broadcast GATHER (each cell
+    # reads offset[row] + column, masked past the row length) — no
+    # per-row Python loop, no fancy scatter
+    offsets = np.asarray(blk.offset[: b + 1], np.int64)
+    lens = np.diff(offsets)
+    ar = np.arange(max_nnz, dtype=np.int64)
+    sel = ar[None, :] < lens[:, None]                        # [b, K]
+    src = np.minimum(offsets[:-1, None] + ar[None, :], src_val.size - 1)
+    value = src_val[src].astype(np.float32, copy=False)
+    index = src_idx[src].astype(np.int32)
+    mask = sel.astype(np.float32)
+    value = value * mask
+    index *= sel
+    if b < batch_size:
+        pad = batch_size - b
+        value = np.vstack([value, np.zeros((pad, max_nnz), np.float32)])
+        index = np.vstack([index, np.zeros((pad, max_nnz), np.int32)])
+        mask = np.vstack([mask, np.zeros((pad, max_nnz), np.float32)])
     if num_col > 0:
         np.minimum(index, num_col - 1, out=index)
     return {"label": label, "value": value, "index": index, "mask": mask}
@@ -64,31 +80,37 @@ def pack_rowblock(blk, batch_size: int, max_nnz: int, num_col: int = 0):
 class DeviceFeed:
     """Assemble per-partition host batches into one sharded global array.
 
-    ``part_iters``: list of host-side iterators (one per local data
-    partition, in mesh part_index order for this process's addressable
-    devices) yielding dicts of equal-shaped np arrays.  Batches are
-    stacked on the leading axis and placed with a NamedSharding over the
-    data axes, so the leading dim of the global batch is
-    n_parts * per_part_batch.
+    ``part_sources``: list of iterator FACTORIES (one per data partition,
+    in mesh part_index order), each returning a fresh host-side iterator
+    of dicts of equal-shaped np arrays.  Fresh iterators are created at
+    the start of every epoch, so one feed serves multi-epoch training
+    (iterate it again after exhaustion).  Plain iterators are accepted
+    for single-epoch use.  Batches are stacked on the leading axis and
+    placed with a NamedSharding over the data axes, so the leading dim
+    of the global batch is n_parts * per_part_batch.
     """
 
-    def __init__(self, mesh, part_iters, *, queue_depth: int = 2,
+    def __init__(self, mesh, part_sources, *, queue_depth: int = 2,
                  axes=(AXIS_DP, AXIS_SP), log_every_mb: int = 10):
         import jax
 
         self.mesh = mesh
-        self.part_iters = part_iters
         cfg = mesh_config(mesh)
         n_parts = 1
         for a in axes:
             n_parts *= cfg.axis_size(a)
-        check(len(part_iters) == n_parts,
-              f"need {n_parts} partition iterators, got {len(part_iters)}")
+        check(len(part_sources) == n_parts,
+              f"need {n_parts} partition sources, got {len(part_sources)}")
+        self._multi_epoch = all(callable(s) for s in part_sources)
+        self._sources = part_sources
+        self._epochs_started = 0
         self.sharding = jax.sharding.NamedSharding(
             mesh, jax.sharding.PartitionSpec(axes)
         )
+        self._depth = queue_depth
         self._queue: Queue = Queue(maxsize=queue_depth)
-        self._part_done = [False] * len(part_iters)
+        self.part_iters: list = []
+        self._part_done = [False] * len(part_sources)
         self._template: Optional[Dict[str, np.ndarray]] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -158,11 +180,21 @@ class DeviceFeed:
 
     # ---- consumer ------------------------------------------------------
     def __iter__(self) -> Iterator[Dict[str, "object"]]:
-        if self._thread is not None:
+        if self._thread is not None and self._thread.is_alive():
             raise RuntimeError(
-                "DeviceFeed is single-epoch: create a fresh feed per epoch "
-                "(the partition iterators are already exhausted)"
+                "previous DeviceFeed epoch still in flight: exhaust the "
+                "iterator or close() before starting a new epoch"
             )
+        if self._epochs_started > 0 and not self._multi_epoch:
+            raise RuntimeError(
+                "DeviceFeed built from plain iterators is single-epoch: "
+                "pass iterator factories (callables) for multi-epoch use"
+            )
+        self._epochs_started += 1
+        self.part_iters = [s() if callable(s) else s for s in self._sources]
+        self._part_done = [False] * len(self._sources)
+        self._queue = Queue(maxsize=self._depth)
+        self._stop.clear()
         self._thread = threading.Thread(target=self._produce, daemon=True)
         self._thread.start()
         while True:
@@ -197,7 +229,6 @@ def libsvm_feed(uri: str, mesh, *, batch_size: int, max_nnz: int,
     n_parts = cfg.data_parts
 
     def part_iter(part: int):
-        # one epoch; the caller creates a fresh feed per epoch
         it = create_row_iter(uri, part, n_parts, fmt)
         ncol = it.num_col()
         for blk in it:
@@ -206,8 +237,10 @@ def libsvm_feed(uri: str, mesh, *, batch_size: int, max_nnz: int,
                 sub = blk.slice(lo, min(lo + batch_size, blk.size))
                 yield pack_rowblock(sub, batch_size, max_nnz, ncol)
 
-    iters = [part_iter(p) for p in range(n_parts)]
-    return DeviceFeed(mesh, iters, queue_depth=queue_depth)
+    # factories, not iterators: each epoch re-creates the row iters (which
+    # hit the DiskRowIter/#cachefile cache when the URI requests one)
+    factories = [functools.partial(part_iter, p) for p in range(n_parts)]
+    return DeviceFeed(mesh, factories, queue_depth=queue_depth)
 
 
 def recordio_feed(uri: str, mesh, *, batch_records: int, max_bytes: int,
@@ -245,5 +278,5 @@ def recordio_feed(uri: str, mesh, *, batch_records: int, max_bytes: int,
         finally:
             split.close()
 
-    iters = [part_iter(p) for p in range(n_parts)]
-    return DeviceFeed(mesh, iters, queue_depth=queue_depth)
+    factories = [functools.partial(part_iter, p) for p in range(n_parts)]
+    return DeviceFeed(mesh, factories, queue_depth=queue_depth)

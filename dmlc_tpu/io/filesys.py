@@ -93,6 +93,18 @@ def register_filesystem(protocol: str, factory: Callable[[URI], FileSystem]) -> 
     FileSystem._registry[protocol] = factory
 
 
+def _unsupported_protocol(proto: str, guidance: str):
+    """Stub factory for known-but-not-built protocols: the dispatch must
+    fail with actionable guidance, matching the reference's
+    "compile with DMLC_USE_X=1" FATALs (src/io.cc:31-60)."""
+
+    def factory(_uri: URI) -> FileSystem:
+        raise DMLCError(f"{proto} filesystem is not built into dmlc_tpu: "
+                        f"{guidance}")
+
+    return factory
+
+
 # built-in registrations
 def _init_builtin() -> None:
     from .local_filesys import LocalFileSystem
@@ -113,6 +125,19 @@ def _init_builtin() -> None:
         register_filesystem("gs://", lambda u: GCSFileSystem())
     except ImportError:  # optional backend not present
         pass
+    register_filesystem("hdfs://", _unsupported_protocol(
+        "hdfs://",
+        "the TPU-native substrate uses gs:// in the HDFS/S3 role "
+        "(SURVEY.md §2.4 mapping); copy the data to GCS, or plug in a "
+        "backend via dmlc_tpu.io.filesys.register_filesystem('hdfs://', ...)"))
+    register_filesystem("s3://", _unsupported_protocol(
+        "s3://",
+        "use gs:// (the S3-role backend here) or an S3-compatible proxy "
+        "over https://; custom backends plug in via register_filesystem"))
+    register_filesystem("azure://", _unsupported_protocol(
+        "azure://",
+        "not built (optional in the reference too); plug in a backend via "
+        "register_filesystem('azure://', ...)"))
 
 
 _init_builtin()

@@ -55,21 +55,65 @@ def _auth_headers() -> dict:
     return {"Authorization": f"Bearer {token}"} if token else {}
 
 
+class GCSError(DMLCError):
+    """GCS API failure; ``transient`` marks retry-worthy conditions."""
+
+    def __init__(self, msg: str, *, code: Optional[int] = None,
+                 transient: bool = False):
+        super().__init__(msg)
+        self.code = code
+        self.transient = transient
+
+
+_TRANSIENT_HTTP = {408, 429, 500, 502, 503, 504}
+
+
+def _retry_policy():
+    return (int(os.environ.get("DMLC_GCS_RETRIES", "5")),
+            float(os.environ.get("DMLC_GCS_RETRY_BASE_S", "0.25")))
+
+
 def _api(url: str, *, method: str = "GET", data: Optional[bytes] = None,
-         headers: Optional[dict] = None, ok=(200,)):
-    req = urllib.request.Request(url, data=data, method=method,
-                                 headers={**_auth_headers(),
-                                          **(headers or {})})
-    try:
-        resp = urllib.request.urlopen(req, timeout=60)
-    except urllib.error.HTTPError as e:
-        if e.code in ok:
-            return e  # e.g. 308 resume-incomplete is a valid answer
-        raise DMLCError(
-            f"GCS {method} {url.split('?')[0]} failed: HTTP {e.code} "
-            f"{e.read()[:200]!r}") from e
-    check(resp.status in ok, f"GCS {method}: unexpected HTTP {resp.status}")
-    return resp
+         headers: Optional[dict] = None, ok=(200,), retry: bool = True):
+    """One API call with exponential-backoff retry on 5xx/429/timeouts
+    (the reference's S3 retry-on-disconnect role, s3_filesys.cc:295-446).
+
+    ``retry=False`` disables in-call retries for NON-idempotent requests
+    (resumable chunk PUTs) whose callers recover through the 308
+    committed-range query instead — blindly resending a chunk after a
+    connection error could double-commit bytes."""
+    import time
+
+    attempts, base = _retry_policy() if retry else (1, 0.0)
+    last = "no attempts"
+    for i in range(attempts):
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers={**_auth_headers(),
+                                              **(headers or {})})
+        try:
+            resp = urllib.request.urlopen(req, timeout=60)
+        except urllib.error.HTTPError as e:
+            if e.code in ok:
+                return e  # e.g. 308 resume-incomplete is a valid answer
+            if e.code in _TRANSIENT_HTTP and i + 1 < attempts:
+                last = f"HTTP {e.code}"
+                time.sleep(base * (2 ** i))
+                continue
+            raise GCSError(
+                f"GCS {method} {url.split('?')[0]} failed: HTTP {e.code} "
+                f"{e.read()[:200]!r}", code=e.code,
+                transient=e.code in _TRANSIENT_HTTP) from e
+        except urllib.error.URLError as e:  # DNS, refused, timeouts
+            if i + 1 < attempts:
+                last = str(e.reason)
+                time.sleep(base * (2 ** i))
+                continue
+            raise GCSError(f"GCS {method} {url.split('?')[0]} failed: "
+                           f"{e.reason}", transient=True) from e
+        check(resp.status in ok, f"GCS {method}: unexpected HTTP {resp.status}")
+        return resp
+    raise GCSError(f"GCS {method} {url.split('?')[0]} failed after "
+                   f"{attempts} attempts: {last}", transient=True)
 
 
 class GCSWriteStream(Stream):
@@ -107,29 +151,86 @@ class GCSWriteStream(Stream):
             self._put_chunk(final=False)
         return len(data)
 
+    def _query_committed(self) -> Optional[int]:
+        """Bytes the session has durably committed (the 308-range recovery
+        probe), or None if the upload already finalized."""
+        resp = _api(self._session, method="PUT", data=b"",
+                    headers={"Content-Range": "bytes */*"},
+                    ok=(308, 200, 201))
+        status = getattr(resp, "status", None) or resp.code
+        if status in (200, 201):
+            return None  # object finalized
+        rng = resp.headers.get("Range")  # "bytes=0-<last>" or absent
+        return int(rng.rsplit("-", 1)[1]) + 1 if rng else 0
+
+    def _put_range(self, body: bytes, total_str: str, ok) -> None:
+        """PUT with interrupted-chunk recovery: on a transient failure,
+        ask the session how much it committed (308 + Range) and resend
+        only the remainder — never double-commits, never loses bytes."""
+        import time
+
+        attempts, base = _retry_policy()
+        start = self._offset
+        for i in range(attempts):
+            if body:
+                crange = f"bytes {start}-{start + len(body) - 1}/{total_str}"
+            else:
+                crange = f"bytes */{total_str}"
+            try:
+                _api(self._session, method="PUT", data=body,
+                     headers={"Content-Range": crange}, ok=ok, retry=False)
+                self._offset = start + len(body)
+                return
+            except GCSError as e:
+                if not e.transient or i + 1 >= attempts:
+                    raise
+                time.sleep(base * (2 ** i))
+                committed = self._query_committed()
+                if committed is None:  # finalized under us (final PUT)
+                    self._offset = start + len(body)
+                    return
+                skip = committed - start
+                if skip > 0:
+                    body = body[skip:]
+                    start = committed
+
     def _put_chunk(self, final: bool) -> None:
         if final:
             body = bytes(self._buf)
             self._buf = bytearray()
             total = self._offset + len(body)
-            crange = (f"bytes {self._offset}-{total - 1}/{total}"
-                      if body else f"bytes */{total}")
-            ok = (200, 201)
+            self._put_range(body, str(total), ok=(200, 201))
         else:
             body = bytes(self._buf[: self._chunk])
             del self._buf[: self._chunk]
-            end = self._offset + len(body) - 1
-            crange = f"bytes {self._offset}-{end}/*"
-            ok = (308,)
-        _api(self._session, method="PUT", data=body,
-             headers={"Content-Range": crange}, ok=ok)
-        self._offset += len(body)
+            self._put_range(body, "*", ok=(308,))
 
     def close(self) -> None:
         if self._closed:
             return
         self._closed = True
         self._put_chunk(final=True)
+
+    def abort(self) -> None:
+        """Cancel the upload: DELETE the resumable session (the commit/
+        abort lifecycle of the reference's S3 writer, s3_filesys.cc:583-590)
+        so no partial object is ever visible."""
+        if self._closed:
+            return
+        self._closed = True
+        self._buf = bytearray()
+        try:
+            _api(self._session, method="DELETE", data=b"",
+                 ok=(200, 204, 404, 499))
+        except GCSError:
+            pass  # abandoning the session is best-effort
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # an exception mid-write must not commit a truncated object
+        if exc_type is not None:
+            self.abort()
+        else:
+            self.close()
 
 
 class GCSFileSystem(FileSystem):

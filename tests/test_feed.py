@@ -107,3 +107,47 @@ def test_feed_producer_error_propagates(tmp_path, mesh):
     feed = libsvm_feed(str(p), mesh, batch_size=2, max_nnz=4)
     with pytest.raises(Exception):
         list(feed)
+
+
+def test_feed_multi_epoch_same_feed(tmp_path, mesh):
+    """One feed object serves multiple epochs (fresh partition iterators
+    per epoch) and yields identical data each time."""
+    uri = _write_libsvm(tmp_path, rows=32)
+    feed = libsvm_feed(uri, mesh, batch_size=2, max_nnz=4)
+    e1 = [{k: np.asarray(v) for k, v in b.items()} for b in feed]
+    e2 = [{k: np.asarray(v) for k, v in b.items()} for b in feed]
+    assert len(e1) == len(e2) > 0
+    for b1, b2 in zip(e1, e2):
+        for k in b1:
+            np.testing.assert_array_equal(b1[k], b2[k])
+
+
+def test_pack_rowblock_vectorized_matches_reference_loop():
+    from dmlc_tpu.data.row_block import RowBlockContainer
+
+    rng = np.random.default_rng(0)
+    nrows, nnz = 200, 1000
+    offs = np.sort(rng.integers(0, nnz, nrows - 1))
+    offsets = np.concatenate([[0], offs, [nnz]]).astype(np.uint64)
+    c = RowBlockContainer()
+    c.push_arrays(
+        labels=rng.random(nrows).astype(np.float32),
+        offsets=offsets,
+        index=rng.integers(0, 50, nnz).astype(np.uint32),
+        value=rng.random(nnz).astype(np.float32),
+    )
+    blk = c.get_block()
+    out = pack_rowblock(blk, batch_size=nrows, max_nnz=8, num_col=50)
+    # python reference loop
+    want_v = np.zeros((nrows, 8), np.float32)
+    want_i = np.zeros((nrows, 8), np.int32)
+    want_m = np.zeros((nrows, 8), np.float32)
+    for i in range(nrows):
+        lo, hi = int(offsets[i]), int(offsets[i + 1])
+        k = min(hi - lo, 8)
+        want_v[i, :k] = np.asarray(blk.value[lo:lo + k])
+        want_i[i, :k] = np.minimum(np.asarray(blk.index[lo:lo + k]), 49)
+        want_m[i, :k] = 1.0
+    np.testing.assert_array_equal(out["value"], want_v)
+    np.testing.assert_array_equal(out["index"], want_i)
+    np.testing.assert_array_equal(out["mask"], want_m)

@@ -11,7 +11,9 @@ import json
 import os
 import re
 import threading
+import urllib.error
 import urllib.parse
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
@@ -26,6 +28,10 @@ class _FakeGCS(BaseHTTPRequestHandler):
     store = {}       # (bucket, name) -> bytes
     sessions = {}    # sid -> {bucket, name, data}
     _sid = [0]
+    # fault injection: every data-bearing session PUT fails once with 500
+    # BEFORE committing (client must recover via the 308-range probe)
+    fail_each_put = False
+    _failed_once = set()  # (sid, declared_start) already failed
 
     def log_message(self, *a):  # quiet
         pass
@@ -63,25 +69,48 @@ class _FakeGCS(BaseHTTPRequestHandler):
         if not m or m.group(1) not in self.sessions:
             self.send_error(404)
             return
-        sess = self.sessions[m.group(1)]
+        sid = m.group(1)
+        sess = self.sessions[sid]
         n = int(self.headers.get("Content-Length", "0"))
         body = self.rfile.read(n)
         crange = self.headers.get("Content-Range", "")
-        # oracle for the client's offset bookkeeping: the declared start
-        # must equal the bytes already committed
         m2 = re.match(r"^bytes (\d+)-(\d+)/", crange)
-        if m2 and int(m2.group(1)) != len(sess["data"]):
-            self.send_error(400, "Content-Range offset mismatch")
-            return
-        sess["data"] += body
-        if crange.endswith("/*"):  # intermediate chunk
+        if body and self.fail_each_put:
+            key = (sid, m2.group(1) if m2 else crange)
+            if key not in self._failed_once:
+                self._failed_once.add(key)
+                self.send_error(500, "injected transient failure")
+                return
+        if m2:
+            declared = int(m2.group(1))
+            committed = len(sess["data"])
+            if declared > committed:
+                self.send_error(400, "Content-Range offset gap")
+                return
+            if declared < committed:  # overlap resend: drop known bytes
+                body = body[committed - declared:]
+        if body:
+            sess["data"] += body
+        if crange.endswith("/*"):  # intermediate chunk or status query
             self.send_response(308)
+            if sess["data"]:
+                self.send_header("Range", f"bytes=0-{len(sess['data']) - 1}")
             self.send_header("Content-Length", "0")
             self.end_headers()
             return
         # final chunk: commit the object
         self.store[(sess["bucket"], sess["name"])] = bytes(sess["data"])
         self._json({"name": sess["name"], "size": str(len(sess["data"]))})
+
+    def do_DELETE(self):
+        m = re.match(r"^/session/(\d+)$", self.path)
+        if m and m.group(1) in self.sessions:
+            del self.sessions[m.group(1)]
+            self.send_response(204)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_error(404)
 
     def do_HEAD(self):
         self.do_GET(head=True)
@@ -229,3 +258,68 @@ def test_http_read_stream(gcs_server):
     assert strm.read(10) == b"0123456789"
     strm.seek(9995)
     assert strm.read(100) == b"56789"
+
+
+def test_gcs_write_retries_through_injected_500s(gcs_server):
+    """Every chunk PUT fails once with a 500; the writer must recover via
+    the 308 committed-range probe and commit byte-identical content."""
+    payload = bytes(np.random.default_rng(7).integers(0, 256, 5 * 70_000,
+                                                      dtype=np.uint8))
+    os.environ["DMLC_GCS_WRITE_BUFFER_MB"] = "1"   # floor: 256KiB chunks
+    os.environ["DMLC_GCS_RETRY_BASE_S"] = "0.01"
+    _FakeGCS.fail_each_put = True
+    _FakeGCS._failed_once.clear()
+    try:
+        with Stream.create("gs://bkt/faulty/blob.bin", "w") as s:
+            for lo in range(0, len(payload), 70_000):
+                s.write(payload[lo: lo + 70_000])
+    finally:
+        _FakeGCS.fail_each_put = False
+        os.environ.pop("DMLC_GCS_WRITE_BUFFER_MB")
+        os.environ.pop("DMLC_GCS_RETRY_BASE_S")
+    assert _FakeGCS.store[("bkt", "faulty/blob.bin")] == payload
+
+
+def test_gcs_abort_deletes_session_and_commits_nothing(gcs_server):
+    from dmlc_tpu.io.gcs_filesys import GCSWriteStream
+
+    s = GCSWriteStream("bkt", "aborted/blob.bin")
+    s.write(b"partial data that must never become visible")
+    before = len(_FakeGCS.sessions)
+    s.abort()
+    assert ("bkt", "aborted/blob.bin") not in _FakeGCS.store
+    assert len(_FakeGCS.sessions) == before - 1
+    # closing after abort is a no-op, not a commit
+    s.close()
+    assert ("bkt", "aborted/blob.bin") not in _FakeGCS.store
+
+
+def test_gcs_exception_in_with_block_aborts(gcs_server):
+    with pytest.raises(RuntimeError):
+        with Stream.create("gs://bkt/ctx/blob.bin", "w") as s:
+            s.write(b"doomed bytes")
+            raise RuntimeError("simulated trainer crash")
+    assert ("bkt", "ctx/blob.bin") not in _FakeGCS.store
+
+
+def test_gcs_read_api_retries_transient_500(gcs_server, monkeypatch):
+    # one-shot 500 on a GET: _api retries and succeeds
+    from dmlc_tpu.io import gcs_filesys
+
+    with Stream.create("gs://bkt/retry/read.bin", "w") as s:
+        s.write(b"abcdef")
+    real = urllib.request.urlopen
+    state = {"failed": False}
+
+    def flaky(req, timeout=None):
+        if not state["failed"] and "retry%2Fread.bin" in req.full_url:
+            state["failed"] = True
+            raise urllib.error.HTTPError(req.full_url, 503, "flaky", {}, None)
+        return real(req, timeout=timeout)
+
+    monkeypatch.setenv("DMLC_GCS_RETRY_BASE_S", "0.01")
+    monkeypatch.setattr(urllib.request, "urlopen", flaky)
+    from dmlc_tpu.io.filesys import FileSystem
+    info = FileSystem.get_instance(URI("gs://bkt")).get_path_info(
+        URI("gs://bkt/retry/read.bin"))
+    assert state["failed"] and info.size == 6

@@ -433,3 +433,15 @@ def test_mmap_before_first_rereads_identically(tmp_path):
     second = read_all(sp)
     sp.close()
     assert first == second
+
+
+def test_known_unbuilt_protocols_give_guidance():
+    from dmlc_tpu.io.filesys import FileSystem
+    from dmlc_tpu.io.uri import URI
+
+    for proto in ("hdfs://nn/path", "s3://bucket/key", "azure://c/b"):
+        with pytest.raises(DMLCError, match="not built into dmlc_tpu"):
+            FileSystem.get_instance(URI(proto))
+    # truly unknown protocols still get the generic error
+    with pytest.raises(DMLCError, match="unknown filesystem protocol"):
+        FileSystem.get_instance(URI("xyz://whatever"))
