@@ -23,8 +23,7 @@ from typing import Optional
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(os.path.dirname(os.path.dirname(_HERE)), "cpp",
-                    "dmlc_native.cc")
+_SRC = os.path.join(os.path.dirname(_HERE), "cpp", "dmlc_native.cc")
 _SO = os.path.join(_HERE, "libdmlc_native.so")
 _ABI = 2
 

@@ -36,7 +36,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--worker-memory", default="1g")
     p.add_argument("--server-memory", default="1g")
     p.add_argument("--jobname", default=None)
-    p.add_argument("--queue", default="default")
+    p.add_argument("--queue", default="default",
+                   help="scheduler queue (sge backend only)")
     p.add_argument("--log-level", default="INFO",
                    choices=["INFO", "DEBUG", "WARNING", "ERROR"])
     p.add_argument("--log-file", default=None)
@@ -47,7 +48,6 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sge-log-dir", default=None)
     p.add_argument("--slurm-worker-nodes", default=None, type=int)
     p.add_argument("--slurm-server-nodes", default=None, type=int)
-    p.add_argument("--mesos-master", default=os.environ.get("DMLC_MESOS_MASTER"))
     p.add_argument("--sync-dst-dir", default=None,
                    help="rsync the working dir to this path on each host first")
     p.add_argument("--max-attempts", default=3, type=int,

@@ -14,7 +14,7 @@ import sys
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-CPP = os.path.join(REPO, "cpp")
+CPP = os.path.join(REPO, "dmlc_tpu", "cpp")
 
 
 @pytest.fixture(scope="module")
