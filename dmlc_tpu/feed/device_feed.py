@@ -180,11 +180,17 @@ class DeviceFeed:
 
     # ---- consumer ------------------------------------------------------
     def __iter__(self) -> Iterator[Dict[str, "object"]]:
-        if self._thread is not None and self._thread.is_alive():
-            raise RuntimeError(
-                "previous DeviceFeed epoch still in flight: exhaust the "
-                "iterator or close() before starting a new epoch"
-            )
+        if self._thread is not None:
+            # A producer that already delivered its None sentinel is done
+            # but may not have exited yet; give it a moment rather than
+            # spuriously refusing an immediate epoch restart.
+            self._thread.join(timeout=2.0)
+            if self._thread.is_alive():
+                raise RuntimeError(
+                    "previous DeviceFeed epoch still in flight: exhaust "
+                    "the iterator or close() before starting a new epoch"
+                )
+            self._thread = None
         if self._epochs_started > 0 and not self._multi_epoch:
             raise RuntimeError(
                 "DeviceFeed built from plain iterators is single-epoch: "
@@ -206,10 +212,24 @@ class DeviceFeed:
             yield item
 
     def close(self):
+        import time
+
         self._stop.set()
-        # drain so the producer can observe the stop flag
-        while not self._queue.empty():
-            self._queue.get_nowait()
+        # drain so a producer blocked on a full queue can observe the stop
+        # flag, then actually join it — close() must leave no live thread
+        t = self._thread
+        deadline = time.monotonic() + 5.0
+        while t is not None and t.is_alive() and time.monotonic() < deadline:
+            while not self._queue.empty():
+                try:
+                    self._queue.get_nowait()
+                except Exception:
+                    break
+            t.join(timeout=0.05)
+        if t is None or not t.is_alive():
+            self._thread = None
+        # else: keep _thread set so __iter__'s in-flight guard still
+        # refuses to start a second producer over live shared state
 
     @property
     def bytes_fed(self) -> int:

@@ -251,11 +251,16 @@ class InputSplitBase(InputSplit):
             at += take
         return out
 
-    def _load_cursor_stitch(self, curr: int) -> Optional[ChunkCursor]:
+    def _load_cursor_stitch(self, curr: int, max_size: Optional[int] = None):
         """Seam-crossing chunk: assemble bytes across files, cut at the
-        last record head (the rare copy on the otherwise zero-copy path)."""
+        last record head (the rare copy on the otherwise zero-copy path).
+
+        With ``max_size`` the attempt is capped at that many bytes and
+        returns _GROW instead of doubling, preserving the bytes API's
+        at-most-max_size contract (the caller grows and retries)."""
         end_part = self._offset_end
-        size = max(self._chunk_bytes, self._chunk_bytes_min)
+        size = max_size if max_size is not None \
+            else max(self._chunk_bytes, self._chunk_bytes_min)
         while True:
             take_end = min(curr + size, end_part)
             buf = self._gather(curr, take_end)
@@ -267,6 +272,8 @@ class InputSplitBase(InputSplit):
                 return ChunkCursor(buf, end=cut)
             if take_end == end_part:
                 return None  # curr == end_part: nothing left
+            if max_size is not None:
+                return self._GROW
             size *= 2
 
     # ---- URI expansion (input_split_base.cc:96-175) ---------------------
@@ -515,9 +522,11 @@ class InputSplitBase(InputSplit):
             if cur is self._GROW:
                 return b""  # caller grows, reference Chunk::Load contract
             if cur is self._STITCH:
-                cur = self._load_cursor_stitch(curr)
+                cur = self._load_cursor_stitch(curr, max_size)
                 if cur is None:
                     return None
+                if cur is self._GROW:
+                    return b""  # caller doubles, same as the window path
         else:
             cur = self._read_cursor(max_size)
             if cur is None:

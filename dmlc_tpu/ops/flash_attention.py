@@ -25,9 +25,9 @@ from jax import lax
 _NEG_BIG = -1e30
 
 
-def _kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref,
+def _kernel(qoff_ref, kvoff_ref, kvend_ref, q_ref, k_ref, v_ref,
             pv_ref, m_ref, l_ref, *, block_k: int, causal: bool,
-            scale: float):
+            kv_padded: bool, scale: float):
     from jax.experimental import pallas as pl
 
     q = q_ref[0]                      # [block_q, D]
@@ -49,15 +49,22 @@ def _kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref,
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [block_q, block_k]
-        if causal:
+        keep = None
+        if causal or kv_padded:
             k_pos = kvoff_ref[0] + j * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
+        if causal:
             keep = q_pos >= k_pos
+        if kv_padded:
+            # tail KV rows past the real length are padding, never attend
+            in_range = k_pos < kvend_ref[0]
+            keep = in_range if keep is None else keep & in_range
+        if keep is not None:
             s = jnp.where(keep, s, _NEG_BIG)
         bm = jnp.max(s, axis=1)
         m_new = jnp.maximum(m, bm)
         p = jnp.exp(s - m_new[:, None])
-        if causal:
+        if keep is not None:
             p = jnp.where(keep, p, 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=1)
@@ -75,12 +82,12 @@ def _kernel(qoff_ref, kvoff_ref, q_ref, k_ref, v_ref,
 
 def supports(q_shape: Tuple[int, ...], k_shape: Tuple[int, ...],
              block_q: int, block_k: int) -> bool:
-    """Alignment gate: lane dim multiple of 128, seq dims tile evenly."""
+    """Kernel applicability gate: lane dim multiple of 128, seq dims big
+    enough to tile.  Unaligned seq lengths are handled by the kernel's
+    pad-and-mask path, so they no longer disqualify."""
     _, tq, _, d = q_shape
     tk = k_shape[1]
-    return (d % 128 == 0 and tq % min(block_q, tq) == 0
-            and tk % min(block_k, tk) == 0
-            and tq >= 8 and tk >= 8)
+    return d % 128 == 0 and tq >= 8 and tk >= 8
 
 
 def block_attend_flash(q, k, v, *, scale: float, causal: bool,
@@ -103,19 +110,34 @@ def block_attend_flash(q, k, v, *, scale: float, causal: bool,
     block_k = min(block_k, tk)
     bh = b * h
 
-    qt = q.transpose(0, 2, 1, 3).reshape(bh, tq, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(bh, tk, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(bh, tk, d)
+    # Unaligned seq lengths: pad to block multiples and mask.  Padded Q
+    # rows are sliced off the outputs; padded KV rows are excluded in
+    # the kernel via the kvend position bound (a scalar-prefetch arg, so
+    # the padded and exact cases share one compiled kernel per shape).
+    tq_pad = -tq % block_q
+    tk_pad = -tk % block_k
+    kv_padded = tk_pad != 0
+    if tq_pad:
+        q = jnp.pad(q, ((0, 0), (0, tq_pad), (0, 0), (0, 0)))
+    if tk_pad:
+        k = jnp.pad(k, ((0, 0), (0, tk_pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, tk_pad), (0, 0), (0, 0)))
+    tq_p, tk_p = tq + tq_pad, tk + tk_pad
+
+    qt = q.transpose(0, 2, 1, 3).reshape(bh, tq_p, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(bh, tk_p, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(bh, tk_p, d)
     qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
     kvoff = jnp.asarray(kv_offset, jnp.int32).reshape(1)
+    kvend = kvoff + tk
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(bh, tq // block_q),
+        num_scalar_prefetch=3,
+        grid=(bh, tq_p // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda bi, qi, *_: (bi, qi, 0)),
-            pl.BlockSpec((1, tk, d), lambda bi, qi, *_: (bi, 0, 0)),
-            pl.BlockSpec((1, tk, d), lambda bi, qi, *_: (bi, 0, 0)),
+            pl.BlockSpec((1, tk_p, d), lambda bi, qi, *_: (bi, 0, 0)),
+            pl.BlockSpec((1, tk_p, d), lambda bi, qi, *_: (bi, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bi, qi, *_: (bi, qi, 0)),
@@ -125,19 +147,19 @@ def block_attend_flash(q, k, v, *, scale: float, causal: bool,
     )
     pv, m, l = pl.pallas_call(
         functools.partial(_kernel, block_k=block_k, causal=causal,
-                          scale=scale),
+                          kv_padded=kv_padded, scale=scale),
         grid_spec=grid_spec,
         out_shape=[
-            jax.ShapeDtypeStruct((bh, tq, d), jnp.float32),
-            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
-            jax.ShapeDtypeStruct((bh, tq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tq_p, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tq_p), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tq_p), jnp.float32),
         ],
         interpret=interpret,
-    )(qoff, kvoff, qt, kt, vt)
+    )(qoff, kvoff, kvend, qt, kt, vt)
 
-    pv = pv.reshape(b, h, tq, d).transpose(0, 2, 1, 3)
-    m = m.reshape(b, h, tq)
-    l = l.reshape(b, h, tq)
+    pv = pv.reshape(b, h, tq_p, d).transpose(0, 2, 1, 3)[:, :tq]
+    m = m.reshape(b, h, tq_p)[:, :, :tq]
+    l = l.reshape(b, h, tq_p)[:, :, :tq]
     return pv, m, l
 
 

@@ -122,6 +122,20 @@ def test_feed_multi_epoch_same_feed(tmp_path, mesh):
             np.testing.assert_array_equal(b1[k], b2[k])
 
 
+def test_feed_close_joins_producer(tmp_path, mesh):
+    """close() mid-epoch must leave no live producer thread, even one
+    blocked on a full queue."""
+    uri = _write_libsvm(tmp_path, rows=64)
+    feed = libsvm_feed(uri, mesh, batch_size=2, max_nnz=4, queue_depth=1)
+    it = iter(feed)
+    next(it)  # start the producer; with depth 1 it will block on put
+    feed.close()
+    assert feed._thread is None
+    # an immediate new epoch must start cleanly after close()
+    n = len(list(feed))
+    assert n > 0
+
+
 def test_pack_rowblock_vectorized_matches_reference_loop():
     from dmlc_tpu.data.row_block import RowBlockContainer
 

@@ -58,9 +58,49 @@ def test_block_attend_matches_lax_with_offsets():
     np.testing.assert_allclose(np.asarray(l_f), np.asarray(l_l), atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("t", [200, 77])
+def test_flash_attention_unaligned_tail(causal, t):
+    """T not a multiple of block sizes must pad-and-mask, not silently
+    drop tail blocks (rows past the last full block were uncomputed)."""
+    b, h, d = 1, 2, 128
+    key = jax.random.PRNGKey(3)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, t, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, t, h, d), jnp.float32)
+    v = jax.random.normal(kv, (b, t, h, d), jnp.float32)
+    want = ring_attention_reference(q, k, v, causal=causal)
+    got = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64,
+                          interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+def test_block_attend_unaligned_kv_shard():
+    """Ring-step shape: KV shard length not a block multiple; the (pv,m,l)
+    partials must exclude the padded KV rows."""
+    b, tq, tk, h, d = 1, 32, 40, 1, 128
+    key = jax.random.PRNGKey(4)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, tq, h, d), jnp.float32)
+    k = jax.random.normal(kk, (b, tk, h, d), jnp.float32)
+    v = jax.random.normal(kv, (b, tk, h, d), jnp.float32)
+    scale = 1.0 / (d ** 0.5)
+    mask = jnp.ones((tq, tk), bool)
+    pv_l, m_l, l_l = _block_attend(q, k, v, scale=scale, mask=mask)
+    pv_f, m_f, l_f = block_attend_flash(
+        q, k, v, scale=scale, causal=False, q_offset=0, kv_offset=0,
+        block_q=16, block_k=16, interpret=True)
+    np.testing.assert_allclose(np.asarray(pv_f), np.asarray(pv_l), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(m_f), np.asarray(m_l), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(l_f), np.asarray(l_l), atol=2e-5)
+
+
 def test_supports_gate():
     assert supports((1, 64, 2, 128), (1, 64, 2, 128), 128, 128)
     assert not supports((1, 64, 2, 96), (1, 64, 2, 96), 128, 128)  # lane
+    # unaligned seq lengths are padded-and-masked in-kernel, so supported
+    assert supports((1, 200, 2, 128), (1, 200, 2, 128), 128, 128)
+    assert not supports((1, 4, 2, 128), (1, 4, 2, 128), 128, 128)  # tiny
 
 
 def test_flash_under_jit_with_traced_offsets():

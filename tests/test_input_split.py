@@ -417,6 +417,33 @@ def test_mmap_seam_with_tiny_chunks(tmp_path, monkeypatch):
     assert fast[0][2] == b"three-is-longer-than-the-hint...continued"
 
 
+def test_read_chunk_respects_max_size_at_seam(tmp_path):
+    # bytes API contract: read_chunk(max_size) never returns more than
+    # max_size bytes, even when a record crosses a file seam (stitch path)
+    from dmlc_tpu.io import input_split
+
+    a = tmp_path / "a.txt"
+    b = tmp_path / "b.txt"
+    a.write_bytes(b"one\ntwo\nthree-is-longer-than-max" )
+    b.write_bytes(b"...over-the-seam\nfour\n")
+    split = input_split.create(f"{a};{b}", 0, 1, "text", threaded=False)
+    try:
+        max_size, chunks = 16, []
+        while True:
+            c = split.read_chunk(max_size)
+            if c is None:
+                break
+            if c == b"":
+                max_size *= 2
+                continue
+            assert len(c) <= max_size, (len(c), max_size)
+            chunks.append(bytes(c))
+    finally:
+        split.close()
+    joined = b"".join(chunks)
+    assert b"three-is-longer-than-max...over-the-seam" in joined
+
+
 def test_mmap_recordio_tiny_hint(tmp_path, monkeypatch):
     path, recs = make_recordio_file(tmp_path, n=61, seed=3)
     fast = _read_with_mode(monkeypatch, path, "recordio", 2, True, hint=16)
