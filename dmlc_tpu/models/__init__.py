@@ -8,9 +8,12 @@ InputSplit partitions through the device feed into a 5-way-parallel
 
 from .transformer import (  # noqa: F401
     TransformerConfig,
+    count_params,
+    flagship_config,
     forward_local,
     init_params,
     make_train_step,
     param_specs,
+    train_flops_per_token,
     unsharded_loss,
 )

@@ -55,10 +55,52 @@ class TransformerConfig:
     n_experts: int = 2         # 1 = dense FFN
     microbatches: int = 2      # pipeline schedule M
     dtype: str = "float32"     # bf16 for real runs; f32 for CPU tests
+    remat: bool = False        # checkpoint each block (trade FLOPs for HBM)
 
     @property
     def jdtype(self):
         return jnp.dtype(self.dtype)
+
+
+def flagship_config() -> TransformerConfig:
+    """The single-chip benchmark model: ~1.0B-param dense decoder LM,
+    bf16 + per-block remat, head_dim 128 to ride the Pallas flash kernel.
+    Sized so a full AdamW train step fits a 16 GB-HBM chip (v5e)."""
+    return TransformerConfig(
+        vocab=32768,
+        d_model=2048,
+        n_heads=16,
+        head_dim=128,
+        d_ff=6144,
+        n_layers=16,
+        n_experts=1,
+        microbatches=1,
+        dtype="bfloat16",
+        remat=True,
+    )
+
+
+def count_params(cfg: TransformerConfig) -> int:
+    """Total parameter count of init_params' pytree."""
+    e, hd, f, x = (cfg.d_model, cfg.n_heads * cfg.head_dim, cfg.d_ff,
+                   cfg.n_experts)
+    per_layer = 2 * e + 4 * e * hd + e * x + 3 * x * e * f
+    return cfg.n_layers * per_layer + 2 * cfg.vocab * e + e
+
+
+def train_flops_per_token(cfg: TransformerConfig, t: int,
+                          causal: bool = True) -> float:
+    """Executed matmul FLOPs per token for one train step (fwd + bwd ≈ 3×
+    fwd): qkvo + FFN + unembed projections plus the attention score/value
+    matmuls.  With ``causal`` the attention term is halved — the flash
+    kernels skip fully-masked KV blocks, so full-T counting would inflate
+    MFU (conservative: the partially-masked diagonal blocks run full)."""
+    e, hd, f, x = (cfg.d_model, cfg.n_heads * cfg.head_dim, cfg.d_ff,
+                   cfg.n_experts)
+    attn = (2 if causal else 4) * t * hd
+    per_layer = 2 * 4 * e * hd + attn + 2 * 3 * e * f * x
+    fwd = cfg.n_layers * per_layer + 2 * e * cfg.vocab
+    return 3.0 * fwd
 
 
 def init_params(key, cfg: TransformerConfig, n_stages: int = 1):
@@ -123,7 +165,15 @@ def _attention(x, p, positions, axes: ShardAxes):
     if axes.sp is not None:
         o = ring_attention(q, k, v, axis_name=axes.sp, causal=True)
     else:
-        o = ring_attention_reference(q, k, v, causal=True)
+        from ..ops import flash_attention as _flash
+
+        if (jax.default_backend() == "tpu"
+                and _flash.supports(q.shape, k.shape, 128, 128)):
+            # single-chip MXU hot path: O(T) memory instead of the
+            # oracle's materialized [B,H,T,T] score matrix
+            o = _flash.flash_attention(q, k, v, causal=True)
+        else:
+            o = ring_attention_reference(q, k, v, causal=True)
     y = jnp.einsum("bthd,hde->bte", o, p["wo"])
     if axes.tp is not None:
         y = lax.psum(y, axes.tp)
@@ -158,11 +208,18 @@ def _block(x, p, positions, axes: ShardAxes):
     return x
 
 
-def _stage_fn(stage_params, x, positions, axes: ShardAxes):
+def _stage_fn(stage_params, x, positions, axes: ShardAxes,
+              remat: bool = False):
     """Apply this stage's L/S blocks via scan over the layer dim."""
+    blk = _block
+    if remat:
+        # rematerialize each block on the backward pass: only the block
+        # inputs (residual stream) are saved, so activation memory is
+        # O(L·B·T·E) instead of O(L·B·T·(E+F+hd...))
+        blk = jax.checkpoint(_block, static_argnums=(3,))
 
     def body(h, layer_p):
-        return _block(h, layer_p, positions, axes), None
+        return blk(h, layer_p, positions, axes), None
 
     out, _ = lax.scan(body, x, stage_params)
     return out
@@ -188,7 +245,7 @@ def forward_local(params, ids, labels, cfg: TransformerConfig, axes: ShardAxes):
         assert b % m == 0, f"batch {b} must divide microbatches {m}"
         xmb = x.reshape(m, b // m, t_local, cfg.d_model)
         out = pipeline_spmd(
-            lambda p_, h: _stage_fn(p_, h, positions, axes),
+            lambda p_, h: _stage_fn(p_, h, positions, axes, cfg.remat),
             stage_params,
             xmb,
             axis_name=axes.pp,
@@ -198,7 +255,7 @@ def forward_local(params, ids, labels, cfg: TransformerConfig, axes: ShardAxes):
         n_stages = blocks["ln1"].shape[0]
         for s in range(n_stages):
             stage_params = jax.tree.map(lambda a: a[s], blocks)
-            x = _stage_fn(stage_params, x, positions, axes)
+            x = _stage_fn(stage_params, x, positions, axes, cfg.remat)
 
     x = rms_norm(x, params["ln_f"])
     logits = jnp.einsum("bte,ev->btv", x, params["unembed"])

@@ -1,16 +1,26 @@
-"""Pallas TPU kernel for the flash-attention block attend.
+"""Pallas TPU kernels for flash attention (forward + backward).
 
-This is the MXU hot loop of ring attention (parallel/ring_attention.py):
-one Q block against one KV shard with an online softmax, returning the
-partial (pv, m, l) triple the ring combiner folds across ranks.  The
-kernel keeps Q/K/V tiles in VMEM, loops KV in block_k tiles with a
-fori_loop carry (running max / denominator in f32), and takes the global
-position offsets as scalar-prefetch arguments so the SAME compiled
-kernel serves every ring step (offsets are traced values there).
+This is the MXU hot loop of both the single-chip flagship model and ring
+attention (parallel/ring_attention.py).  The forward computes one Q block
+against one KV shard with an online softmax, returning the partial
+(pv, m, l) triple the ring combiner folds across ranks.  Q/K/V tiles
+live in VMEM, the KV loop is a fori_loop with f32 carries, and the
+global position offsets are scalar-prefetch arguments so the SAME
+compiled kernel serves every ring step (offsets are traced values
+there).  Causal steps skip fully-masked KV blocks via a dynamic loop
+bound, halving attention compute at large T.
 
-Falls back to the pure-lax path (ring_attention._block_attend) off-TPU
-or for unaligned shapes; interpret=True runs the kernel on CPU for
-tests.  Layout/tiling per /opt/skills/guides/pallas_guide.md.
+The standalone `flash_attention` entry is fully differentiable with
+FlashAttention-style backward kernels (dkv + dq passes over saved
+(o, lse) residuals) — no T×T matrix is ever materialized, which is what
+makes long-context training fit in HBM.  The ring-step
+`block_attend_flash` is differentiable through a pure-lax recompute twin
+(its (pv, m, l) outputs feed the ring combine, whose rescales cancel
+analytically).
+
+Falls back to the pure-lax path off-TPU or for unaligned head dims;
+interpret=True runs the kernels on CPU for tests.  Layout/tiling per
+/opt/skills/guides/pallas_guide.md.
 """
 
 from __future__ import annotations
@@ -20,9 +30,18 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 _NEG_BIG = -1e30
+_POS_BIG = 1e30
+
+
+def _causal_hi(qoff, kvoff, qi, block_q, block_k, nk):
+    """Number of KV blocks a causal Q block [qi] must visit (traced)."""
+    last_q = qoff + (qi + 1) * block_q - 1          # last global q position
+    need = (last_q - kvoff) // block_k + 1
+    return jnp.clip(need, 0, nk)
 
 
 def _kernel(qoff_ref, kvoff_ref, kvend_ref, q_ref, k_ref, v_ref,
@@ -74,10 +93,19 @@ def _kernel(qoff_ref, kvoff_ref, kvend_ref, q_ref, k_ref, v_ref,
         acc_new = acc * corr[:, None] + pv
         return acc_new, m_new, l_new
 
-    acc, m, l = lax.fori_loop(0, nk, body, (acc0, m0, l0))
+    if causal:
+        # skip KV blocks that are entirely in the masked future
+        nk_hi = _causal_hi(qoff_ref[0], kvoff_ref[0], qi, block_q,
+                           block_k, nk)
+    else:
+        nk_hi = nk
+    acc, m, l = lax.fori_loop(0, nk_hi, body, (acc0, m0, l0))
     pv_ref[0] = acc
-    m_ref[0] = m
-    l_ref[0] = l
+    # m/l are per-row scalars; Mosaic requires the minor (lane) block dim
+    # to divide 128 or equal the array dim, so they are stored broadcast
+    # over an 8-lane minor axis (callers slice lane 0)
+    m_ref[0] = jnp.broadcast_to(m[:, None], (block_q, 8))
+    l_ref[0] = jnp.broadcast_to(l[:, None], (block_q, 8))
 
 
 def supports(q_shape: Tuple[int, ...], k_shape: Tuple[int, ...],
@@ -90,20 +118,88 @@ def supports(q_shape: Tuple[int, ...], k_shape: Tuple[int, ...],
     return d % 128 == 0 and tq >= 8 and tk >= 8
 
 
+def lax_block_attend(q, k, v, *, scale, mask):
+    """One Q-block × KV-block partial attention, pure lax — the canonical
+    (pv, m, l) contract shared by the ring fallback and the kernel's VJP
+    twin.  q: [B,Tq,H,D]; k/v: [B,Tk,H,D]; mask: [Tq,Tk] bool or None."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, _NEG_BIG)
+    m = jnp.max(s, axis=-1)                      # [B, H, Tq]
+    p = jnp.exp(s - m[..., None])
+    if mask is not None:
+        p = p * mask[None, None].astype(p.dtype)
+    l = jnp.sum(p, axis=-1)                      # [B, H, Tq]
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    return pv, m, l
+
+
+def _lax_block_attend(q, k, v, qoff, kvoff, *, scale: float, causal: bool):
+    """Offset-based wrapper of lax_block_attend: the recompute target for
+    the ring-step VJP (mask built from global positions, as the kernel)."""
+    tq, tk = q.shape[1], k.shape[1]
+    mask = None
+    if causal:
+        gq = qoff + jnp.arange(tq)
+        gk = kvoff + jnp.arange(tk)
+        mask = gq[:, None] >= gk[None, :]
+    return lax_block_attend(q, k, v, scale=scale, mask=mask)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash_core(static, q, k, v, qoff, kvoff):
+    return _flash_forward(static, q, k, v, qoff, kvoff)
+
+
+def _flash_core_fwd(static, q, k, v, qoff, kvoff):
+    out = _flash_forward(static, q, k, v, qoff, kvoff)
+    return out, (q, k, v, qoff, kvoff)
+
+
+def _flash_core_bwd(static, res, cts):
+    scale, causal, _, _, _ = static
+    q, k, v, qoff, kvoff = res
+    _, vjp = jax.vjp(
+        functools.partial(_lax_block_attend, scale=scale, causal=causal),
+        q, k, v, qoff, kvoff)
+    dq, dk, dv, _, _ = vjp(cts)
+    zero_i = np.zeros(np.shape(qoff), jax.dtypes.float0)
+    return dq, dk, dv, zero_i, zero_i
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
 def block_attend_flash(q, k, v, *, scale: float, causal: bool,
                        q_offset, kv_offset,
                        block_q: int = 128, block_k: int = 128,
                        interpret: bool = False):
-    """Partial attention of q against one KV shard.
+    """Partial attention of q against one KV shard (the ring step).
 
     q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; q_offset/kv_offset: traced
     int32 global positions of element 0.  Returns (pv [B,Tq,H,D] f32,
     m [B,H,Tq] f32, l [B,H,Tq] f32) — same contract as the lax
-    _block_attend in ring_attention.
+    _block_attend in ring_attention.  Differentiable: the forward runs
+    the Pallas kernel, the backward rematerializes through the lax twin.
     """
+    qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
+    kvoff = jnp.asarray(kv_offset, jnp.int32).reshape(1)
+    static = (float(scale), bool(causal), int(block_q), int(block_k),
+              bool(interpret))
+    return _flash_core(static, q, k, v, qoff, kvoff)
+
+
+def _pad_seq(x, pad):
+    return jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0))) if pad else x
+
+
+def _flash_forward(static, q, k, v, qoff, kvoff):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    scale, causal, block_q, block_k, interpret = static
     b, tq, h, d = q.shape
     tk = k.shape[1]
     block_q = min(block_q, tq)
@@ -117,18 +213,14 @@ def block_attend_flash(q, k, v, *, scale: float, causal: bool,
     tq_pad = -tq % block_q
     tk_pad = -tk % block_k
     kv_padded = tk_pad != 0
-    if tq_pad:
-        q = jnp.pad(q, ((0, 0), (0, tq_pad), (0, 0), (0, 0)))
-    if tk_pad:
-        k = jnp.pad(k, ((0, 0), (0, tk_pad), (0, 0), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, tk_pad), (0, 0), (0, 0)))
+    q = _pad_seq(q, tq_pad)
+    k = _pad_seq(k, tk_pad)
+    v = _pad_seq(v, tk_pad)
     tq_p, tk_p = tq + tq_pad, tk + tk_pad
 
     qt = q.transpose(0, 2, 1, 3).reshape(bh, tq_p, d)
     kt = k.transpose(0, 2, 1, 3).reshape(bh, tk_p, d)
     vt = v.transpose(0, 2, 1, 3).reshape(bh, tk_p, d)
-    qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
-    kvoff = jnp.asarray(kv_offset, jnp.int32).reshape(1)
     kvend = kvoff + tk
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -141,8 +233,8 @@ def block_attend_flash(q, k, v, *, scale: float, causal: bool,
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bi, qi, *_: (bi, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bi, qi, *_: (bi, qi)),
-            pl.BlockSpec((1, block_q), lambda bi, qi, *_: (bi, qi)),
+            pl.BlockSpec((1, block_q, 8), lambda bi, qi, *_: (bi, qi, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda bi, qi, *_: (bi, qi, 0)),
         ],
     )
     pv, m, l = pl.pallas_call(
@@ -151,33 +243,264 @@ def block_attend_flash(q, k, v, *, scale: float, causal: bool,
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((bh, tq_p, d), jnp.float32),
-            jax.ShapeDtypeStruct((bh, tq_p), jnp.float32),
-            jax.ShapeDtypeStruct((bh, tq_p), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tq_p, 8), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tq_p, 8), jnp.float32),
         ],
         interpret=interpret,
     )(qoff, kvoff, kvend, qt, kt, vt)
 
     pv = pv.reshape(b, h, tq_p, d).transpose(0, 2, 1, 3)[:, :tq]
-    m = m.reshape(b, h, tq_p)[:, :, :tq]
-    l = l.reshape(b, h, tq_p)[:, :, :tq]
+    m = m[..., 0].reshape(b, h, tq_p)[:, :, :tq]
+    l = l[..., 0].reshape(b, h, tq_p)[:, :, :tq]
     return pv, m, l
+
+
+# ---------------------------------------------------------------------
+# FlashAttention backward: two passes over saved (o, lse), no T×T matrix.
+#
+#   P   = exp(S - lse)           (normalized probabilities, recomputed)
+#   dV  = Pᵀ dO
+#   dS  = P ∘ (dO Vᵀ - delta)    with delta = rowsum(dO ∘ O)
+#   dQ  = scale · dS K
+#   dK  = scale · dSᵀ Q
+# ---------------------------------------------------------------------
+
+def _bwd_dkv_kernel(kvend_ref, q_ref, do_ref, k_ref, v_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, *, block_q: int,
+                    causal: bool, kv_padded: bool, scale: float):
+    from jax.experimental import pallas as pl
+
+    kb = k_ref[0]                     # [block_k, D]
+    vb = v_ref[0]
+    block_k, d = kb.shape
+    tq = q_ref.shape[1]
+    nq = tq // block_q
+    j = pl.program_id(1)
+    k_pos = j * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+
+    def body(qi, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(qi * block_q, block_q)]       # [block_q, D]
+        dob = do_ref[0, pl.ds(qi * block_q, block_q)]
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q), 0]  # [block_q]
+        dlt = delta_ref[0, pl.ds(qi * block_q, block_q), 0]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # [bq, bk]
+        p = jnp.exp(s - lse[:, None])
+        keep = None
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            keep = q_pos >= k_pos
+        if kv_padded:
+            in_range = k_pos < kvend_ref[0]
+            keep = in_range if keep is None else keep & in_range
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
+        dv_new = dv + jax.lax.dot_general(
+            p, dob.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, D]
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bq, bk]
+        ds = p * (dp - dlt[:, None])
+        dk_new = dk + scale * jax.lax.dot_general(
+            ds, qb.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [bk, D]
+        return dk_new, dv_new
+
+    if causal:
+        # Q blocks strictly before this KV block are fully masked
+        qi_lo = jnp.clip((j * block_k) // block_q, 0, nq)
+    else:
+        qi_lo = 0
+    dk, dv = lax.fori_loop(qi_lo, nq, body, (dk0, dv0))
+    dk_ref[0] = dk
+    dv_ref[0] = dv
+
+
+def _bwd_dq_kernel(kvend_ref, q_ref, do_ref, k_ref, v_ref, lse_ref,
+                   delta_ref, dq_ref, *, block_k: int, causal: bool,
+                   kv_padded: bool, scale: float):
+    from jax.experimental import pallas as pl
+
+    qb = q_ref[0]                      # [block_q, D]
+    block_q, d = qb.shape
+    tk = k_ref.shape[1]
+    nk = tk // block_k
+    qi = pl.program_id(1)
+    lse = lse_ref[0, :, 0]             # [block_q]
+    dlt = delta_ref[0, :, 0]
+    dob = do_ref[0]
+    q_pos = qi * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    dq0 = jnp.zeros((block_q, d), jnp.float32)
+
+    def body(j, dq):
+        kb = k_ref[0, pl.ds(j * block_k, block_k)]
+        vb = v_ref[0, pl.ds(j * block_k, block_k)]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse[:, None])
+        keep = None
+        if causal or kv_padded:
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+        if causal:
+            keep = q_pos >= k_pos
+        if kv_padded:
+            in_range = k_pos < kvend_ref[0]
+            keep = in_range if keep is None else keep & in_range
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dlt[:, None])
+        return dq + scale * jax.lax.dot_general(
+            ds, kb.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        nk_hi = _causal_hi(0, 0, qi, block_q, block_k, nk)
+    else:
+        nk_hi = nk
+    dq = lax.fori_loop(0, nk_hi, body, dq0)
+    dq_ref[0] = dq
+
+
+def _flash_backward(static, q, k, v, o, lse, do):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    scale, causal, block_q, block_k, interpret = static
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    bh = b * h
+
+    # delta = rowsum(dO ∘ O), [B, T, H] — cheap, fused by XLA
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    tq_pad = -tq % block_q
+    tk_pad = -tk % block_k
+    kv_padded = tk_pad != 0
+    q = _pad_seq(q, tq_pad)
+    do = _pad_seq(do, tq_pad)
+    k = _pad_seq(k, tk_pad)
+    v = _pad_seq(v, tk_pad)
+    tq_p, tk_p = tq + tq_pad, tk + tk_pad
+
+    qt = q.transpose(0, 2, 1, 3).reshape(bh, tq_p, d)
+    dot = do.transpose(0, 2, 1, 3).reshape(bh, tq_p, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(bh, tk_p, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(bh, tk_p, d)
+    # lse/delta: [B,H,Tq]-like → [bh, tq_p, 8] lane-broadcast; padded Q
+    # rows get lse=+BIG so exp(S - lse) underflows to exactly 0 and they
+    # contribute nothing to dK/dV
+    lse_p = jnp.pad(lse.reshape(bh, tq), ((0, 0), (0, tq_pad)),
+                    constant_values=_POS_BIG)
+    delta_p = jnp.pad(delta.transpose(0, 2, 1).reshape(bh, tq),
+                      ((0, 0), (0, tq_pad)))
+    lse8 = jnp.broadcast_to(lse_p[:, :, None], (bh, tq_p, 8))
+    delta8 = jnp.broadcast_to(delta_p[:, :, None], (bh, tq_p, 8))
+    kvend = jnp.asarray([tk], jnp.int32)
+
+    full_q = pl.BlockSpec((1, tq_p, d), lambda bi, i, *_: (bi, 0, 0))
+    full_k = pl.BlockSpec((1, tk_p, d), lambda bi, i, *_: (bi, 0, 0))
+    full_s = pl.BlockSpec((1, tq_p, 8), lambda bi, i, *_: (bi, 0, 0))
+    blk_q = pl.BlockSpec((1, block_q, d), lambda bi, i, *_: (bi, i, 0))
+    blk_k = pl.BlockSpec((1, block_k, d), lambda bi, i, *_: (bi, i, 0))
+    blk_s = pl.BlockSpec((1, block_q, 8), lambda bi, i, *_: (bi, i, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, causal=causal,
+                          kv_padded=kv_padded, scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, tk_p // block_k),
+            in_specs=[full_q, full_q, blk_k, blk_k, full_s, full_s],
+            out_specs=[blk_k, blk_k],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tk_p, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tk_p, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(kvend, qt, dot, kt, vt, lse8, delta8)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_k=block_k, causal=causal,
+                          kv_padded=kv_padded, scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, tq_p // block_q),
+            in_specs=[blk_q, blk_q, full_k, full_k, blk_s, blk_s],
+            out_specs=blk_q,
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, tq_p, d), jnp.float32),
+        interpret=interpret,
+    )(kvend, qt, dot, kt, vt, lse8, delta8)
+
+    def unpack(x, t):
+        return x.reshape(b, h, -1, d).transpose(0, 2, 1, 3)[:, :t]
+
+    dq = unpack(dq, tq).astype(q.dtype)
+    dk = unpack(dk, tk).astype(k.dtype)
+    dv = unpack(dv, tk).astype(v.dtype)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash_attn(static, q, k, v):
+    o, _ = _flash_attn_impl(static, q, k, v)
+    return o
+
+
+def _flash_attn_impl(static, q, k, v):
+    zero = jnp.zeros(1, jnp.int32)
+    pv, m, l = _flash_forward(static, q, k, v, zero, zero)
+    lsafe = jnp.maximum(l, 1e-20)                         # [B,H,Tq]
+    o = (pv / jnp.transpose(lsafe, (0, 2, 1))[..., None]).astype(q.dtype)
+    lse = m + jnp.log(lsafe)
+    return o, lse
+
+
+def _flash_attn_fwd(static, q, k, v):
+    o, lse = _flash_attn_impl(static, q, k, v)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_attn_bwd(static, res, do):
+    q, k, v, o, lse = res
+    return _flash_backward(static, q, k, v, o, lse, do)
+
+
+_flash_attn.defvjp(_flash_attn_fwd, _flash_attn_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None,
                     block_q: int = 128, block_k: int = 128,
                     interpret: bool = False):
-    """Standalone exact attention via the flash kernel (single device).
+    """Standalone exact attention via the flash kernels (single device).
 
     q/k/v: [B, T, H, D].  The oracle-equivalent of
-    ring_attention_reference with O(T) memory per block row.
+    ring_attention_reference with O(T) memory in BOTH directions: the
+    backward recomputes P from the saved (o, lse) residuals in blocks
+    (dkv + dq kernels) instead of materializing the T×T matrix.
     """
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
-    pv, m, l = block_attend_flash(
-        q, k, v, scale=scale, causal=causal, q_offset=0, kv_offset=0,
-        block_q=block_q, block_k=block_k, interpret=interpret)
-    denom = jnp.maximum(l, 1e-20)
-    out = pv / jnp.transpose(denom, (0, 2, 1))[..., None]
-    return out.astype(q.dtype)
+    static = (float(scale), bool(causal), int(block_q), int(block_k),
+              bool(interpret))
+    return _flash_attn(static, q, k, v)

@@ -30,27 +30,9 @@ from .collectives import match_vma as _match_vma
 _NEG_BIG = -1e30
 
 
-def _block_attend(q, k, v, *, scale, mask):
-    """One Q-block × KV-block partial attention.
-
-    Returns (p @ v, row_max, row_sum) in f32 accumulators.
-    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; mask: [Tq, Tk] bool or None.
-    """
-    s = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
-    if mask is not None:
-        s = jnp.where(mask[None, None, :, :], s, _NEG_BIG)
-    m = jnp.max(s, axis=-1)                      # [B, H, Tq]
-    p = jnp.exp(s - m[..., None])
-    if mask is not None:
-        p = p * mask[None, None, :, :].astype(p.dtype)
-    l = jnp.sum(p, axis=-1)                      # [B, H, Tq]
-    pv = jnp.einsum(
-        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
-        preferred_element_type=jnp.float32,
-    )                                            # [B, Tq, H, D]
-    return pv, m, l
+# canonical lax (pv, m, l) block attend — one implementation, shared with
+# the flash kernel's VJP twin so the two can never diverge
+from ..ops.flash_attention import lax_block_attend as _block_attend  # noqa: E402
 
 
 def ring_attention(
