@@ -1,17 +1,24 @@
 #!/usr/bin/env python
-"""Benchmark: RecordIO InputSplit record-read throughput vs the reference.
+"""Benchmarks vs the reference, printed as ONE JSON line on stdout.
 
-Measures the #1 hot path (SURVEY.md §3.1) the way the reference's own
-harness does (test/split_read_test.cc): iterate every record of a
-RecordIO file through InputSplit and report MB/s.  The baseline is the
-reference C++ implementation compiled from /root/reference on this
-machine and run on the same file — a true same-hardware, same-data
-comparison.  The data file is written by OUR RecordIO writer and read by
-the REFERENCE reader, so every run also re-proves bit-exact format
-compatibility.
+Primary metric (vs_baseline is measured, same-hardware, same-file):
+  recordio_inputsplit_read_MBps — the #1 hot path (SURVEY.md §3.1),
+  measured the way the reference's own harness does
+  (test/split_read_test.cc): iterate every record of a RecordIO file
+  through InputSplit.  The baseline is the reference C++ compiled from
+  /root/reference on this machine reading the same file (which our
+  writer produced — every run re-proves bit-exact format compat).
 
-Prints exactly ONE JSON line on stdout:
-  {"metric": ..., "value": ..., "unit": "MB/s", "vs_baseline": ...}
+extra_metrics:
+  indexed_shuffled_read_MBps — shuffled IndexedRecordIO batch reads,
+      ours vs the reference's indexed path (vs in
+      indexed_shuffled_vs_baseline).
+  transformer_tokens_per_s / transformer_mfu_pct — full AdamW train
+      step of the flagship 1B bf16 LM (models.flagship_config) on the
+      real chip; MFU = tokens/s × train FLOPs/token ÷ chip peak
+      (causal-halved attention accounting, models.train_flops_per_token).
+  recordio_feed_to_hbm_MBps — RecordIO payload bytes landed in device
+      HBM per second via feed.recordio_feed (BASELINE config #2).
 """
 
 import json
@@ -22,6 +29,7 @@ import time
 
 WORK = "/tmp/dmlc_tpu_bench"
 DATA = os.path.join(WORK, "data.rec")
+INDEX = os.path.join(WORK, "data.idx")
 REFBIN = os.path.join(WORK, "refbench")
 TARGET_PAYLOAD = 128 << 20  # 128 MB
 TRIALS = 3
@@ -30,11 +38,14 @@ REF_MAIN = r"""
 #include <dmlc/io.h>
 #include <dmlc/timer.h>
 #include <cstdio>
+#include <cstring>
 #include <memory>
 int main(int argc, char *argv[]) {
-  if (argc < 2) { fprintf(stderr, "usage: prog uri\n"); return 1; }
+  if (argc < 2) { fprintf(stderr, "usage: prog uri [index_uri]\n"); return 1; }
   std::unique_ptr<dmlc::InputSplit> split(
-      dmlc::InputSplit::Create(argv[1], 0, 1, "recordio"));
+      argc > 2 ? dmlc::InputSplit::Create(argv[1], argv[2], 0, 1,
+                                          "indexed_recordio", true, 0, 256)
+               : dmlc::InputSplit::Create(argv[1], 0, 1, "recordio"));
   dmlc::InputSplit::Blob blob;
   double start = dmlc::GetTime();
   size_t bytes = 0, n = 0;
@@ -61,12 +72,17 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
+def repo_path():
+    return os.path.dirname(os.path.abspath(__file__))
+
+
 def ensure_data():
-    if os.path.exists(DATA) and os.path.getsize(DATA) > TARGET_PAYLOAD:
+    if (os.path.exists(DATA) and os.path.getsize(DATA) > TARGET_PAYLOAD
+            and os.path.exists(INDEX)):
         return
     import numpy as np
 
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo_path())
     from dmlc_tpu.io.recordio import RecordIOWriter
     from dmlc_tpu.io.stream import Stream
 
@@ -79,6 +95,19 @@ def ensure_data():
             n = int(rng.integers(32 << 10, 96 << 10))
             w.write_record(rng.integers(0, 256, n, dtype=np.uint8).tobytes())
             total += n
+
+    # index file (record head offsets) via the span scanner — the same
+    # format the reference's ReadIndexFile consumes: "<index> <offset>".
+    # _chunk_spans falls back to a Python header walk without the .so.
+    from dmlc_tpu.feed.device_feed import _chunk_spans
+
+    with open(DATA, "rb") as f:
+        buf = f.read()
+    sp = _chunk_spans(memoryview(buf))
+    with open(INDEX, "w") as f:
+        for i, (off, _ln, flag) in enumerate(sp.tolist()):
+            head = off - 8 if flag == 0 else off
+            f.write(f"{i} {head}\n")
 
 
 def ensure_refbin():
@@ -102,18 +131,19 @@ def ensure_refbin():
     return True
 
 
-def run_reference():
+def run_reference(indexed=False):
     best = 0.0
+    args = [REFBIN, DATA] + ([INDEX] if indexed else [])
     for _ in range(TRIALS):
         out = subprocess.run(
-            [REFBIN, DATA], capture_output=True, text=True, check=True
+            args, capture_output=True, text=True, check=True
         ).stdout.split()
         best = max(best, float(out[0]))
     return best
 
 
 def run_ours():
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, repo_path())
     from dmlc_tpu.io import input_split
 
     best = 0.0
@@ -132,19 +162,192 @@ def run_ours():
     return best
 
 
+def run_ours_indexed_shuffled():
+    sys.path.insert(0, repo_path())
+    from dmlc_tpu.io import input_split
+
+    best = 0.0
+    for _ in range(TRIALS):
+        split = input_split.create(
+            DATA, 0, 1, "indexed_recordio", index_uri=INDEX, shuffle=True,
+            seed=0, batch_size=256)
+        t0 = time.perf_counter()
+        nbytes = 0
+        while True:
+            rec = split.next_record()
+            if rec is None:
+                break
+            nbytes += len(rec)
+        dt = time.perf_counter() - t0
+        split.close()
+        best = max(best, nbytes / 1.0e6 / dt)
+    return best
+
+
+def bench_transformer():
+    """Flagship 1B bf16 LM: full AdamW train step on the real chip."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    sys.path.insert(0, repo_path())
+    from dmlc_tpu.models import (flagship_config, init_params,
+                                 train_flops_per_token, unsharded_loss)
+
+    if jax.devices()[0].platform != "tpu":
+        log("bench: no TPU visible, skipping transformer bench")
+        return None
+
+    B, T, N_STEPS = 8, 1024, 16
+    cfg = flagship_config()
+    params = init_params(jax.random.PRNGKey(0), cfg, n_stages=1)
+    opt = optax.adamw(1e-4)
+    opt_state = opt.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(p, s, ids, labels):
+        loss, g = jax.value_and_grad(
+            lambda p_: unsharded_loss(p_, ids, labels, cfg))(p)
+        up, s = opt.update(g, s, p)
+        return optax.apply_updates(p, up), s, loss
+
+    ids = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0, cfg.vocab)
+    labels = jnp.roll(ids, -1, axis=1)
+    for _ in range(2):  # compile + settle
+        params, opt_state, loss = step(params, opt_state, ids, labels)
+    # NB: on tunneled platforms block_until_ready() can return before the
+    # remote compute finishes; a scalar VALUE fetch is the only reliable
+    # synchronization point, so the clock brackets float(loss) fetches.
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(N_STEPS):
+        params, opt_state, loss = step(params, opt_state, ids, labels)
+    final_loss = float(loss)  # forces the whole chain
+    dt = time.perf_counter() - t0
+    assert jnp.isfinite(final_loss)
+    tok_s = B * T * N_STEPS / dt
+
+    kind = jax.devices()[0].device_kind
+    peak = {  # dense bf16 peak FLOP/s per chip
+        "TPU v4": 275e12,
+        "TPU v5 lite": 197e12,
+        "TPU v5e": 197e12,
+        "TPU v5": 459e12,
+        "TPU v5p": 459e12,
+        "TPU v6 lite": 918e12,
+        "TPU v6e": 918e12,
+    }.get(kind)
+    fpt = train_flops_per_token(cfg, T, causal=True)
+    mfu = round(tok_s * fpt / peak * 100, 1) if peak else None
+    log(f"bench: transformer {tok_s:,.0f} tok/s, MFU={mfu}% on {kind} "
+        f"(B={B} T={T}, {fpt / 1e9:.2f} GFLOP/token)")
+    return {"transformer_tokens_per_s": round(tok_s, 1),
+            "transformer_mfu_pct": mfu}
+
+
+def bench_feed_to_hbm():
+    """RecordIO shards → device HBM payload MB/s (BASELINE config #2).
+
+    Measures both the padded [B, max_bytes] feed and the packed
+    zero-padding feed, plus the raw device_put ceiling of this link so
+    feed efficiency is attributable (on a tunneled dev chip the link,
+    not the host pipeline, is the bottleneck)."""
+    import jax
+    import numpy as np
+
+    sys.path.insert(0, repo_path())
+    from dmlc_tpu.feed import recordio_feed, recordio_packed_feed
+    from dmlc_tpu.parallel import build_mesh
+
+    if jax.devices()[0].platform != "tpu":
+        log("bench: no TPU visible, skipping feed bench")
+        return None
+
+    # raw host→HBM ceiling at the packed feed's transfer size
+    buf = 24 << 20
+    x = np.random.randint(0, 256, (buf,), dtype=np.uint8)
+    dev = jax.devices()[0]
+    a = jax.device_put(x, dev)
+    int(np.asarray(a[0]))
+    t0 = time.perf_counter()
+    for _ in range(4):
+        a = jax.device_put(x, dev)
+    int(np.asarray(a[0]))
+    ceiling = 4 * buf / 1.0e6 / (time.perf_counter() - t0)
+
+    mesh = build_mesh(1, devices=jax.devices()[:1], dp=1, sp=1, tp=1,
+                      pp=1, ep=1)
+
+    def run(make_feed, payload_of):
+        best = 0.0
+        for _ in range(2):
+            feed = make_feed()
+            t0 = time.perf_counter()
+            payload = 0
+            last = None
+            for b in feed:
+                payload += payload_of(b)
+                last = b
+            if last is not None:
+                # value fetch, not block_until_ready: see bench_transformer.
+                # Index on DEVICE first — np.asarray(whole array) would
+                # pull the full buffer back through the link inside dt.
+                arr = last["data"]
+                int(np.asarray(arr[(0,) * arr.ndim]))
+            dt = time.perf_counter() - t0
+            best = max(best, payload / 1.0e6 / dt)
+        return best
+
+    padded = run(
+        lambda: recordio_feed(DATA, mesh, batch_records=256,
+                              max_bytes=96 << 10),
+        lambda b: int(np.sum(np.asarray(b["length"]))))
+    packed = run(
+        lambda: recordio_packed_feed(DATA, mesh, buf_bytes=buf,
+                                     max_records=1024),
+        lambda b: int(np.asarray(b["offsets"])[int(np.asarray(b["count"])[0])]))
+    log(f"bench: feed→HBM padded={padded:.1f} packed={packed:.1f} "
+        f"device_put ceiling={ceiling:.1f} MB/s")
+    return {"recordio_feed_to_hbm_MBps": round(packed, 1),
+            "recordio_feed_padded_MBps": round(padded, 1),
+            "device_put_ceiling_MBps": round(ceiling, 1)}
+
+
 def main():
     os.makedirs(WORK, exist_ok=True)
     ensure_data()
     ours = run_ours()
+    extra = {}
     baseline = None
+    idx_vs = None
     if ensure_refbin():
         baseline = run_reference()
         log(f"bench: ours={ours:.1f} MB/s reference={baseline:.1f} MB/s")
+        try:
+            ours_idx = run_ours_indexed_shuffled()
+            ref_idx = run_reference(indexed=True)
+            extra["indexed_shuffled_read_MBps"] = round(ours_idx, 1)
+            idx_vs = round(ours_idx / ref_idx, 3) if ref_idx else None
+            extra["indexed_shuffled_vs_baseline"] = idx_vs
+            log(f"bench: indexed-shuffled ours={ours_idx:.1f} "
+                f"reference={ref_idx:.1f} MB/s")
+        except Exception as e:  # noqa: BLE001
+            log(f"bench: indexed bench failed: {e!r}")
+    for fn in (bench_transformer, bench_feed_to_hbm):
+        try:
+            r = fn()
+            if r:
+                extra.update(r)
+        except Exception as e:  # noqa: BLE001
+            log(f"bench: {fn.__name__} failed: {e!r}")
     result = {
         "metric": "recordio_inputsplit_read_MBps",
         "value": round(ours, 1),
         "unit": "MB/s",
         "vs_baseline": round(ours / baseline, 3) if baseline else None,
+        "extra_metrics": extra,
     }
     print(json.dumps(result))
 

@@ -12,4 +12,5 @@ from .device_feed import (  # noqa: F401
     libsvm_feed,
     pack_rowblock,
     recordio_feed,
+    recordio_packed_feed,
 )

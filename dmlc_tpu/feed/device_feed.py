@@ -263,13 +263,9 @@ def libsvm_feed(uri: str, mesh, *, batch_size: int, max_nnz: int,
     return DeviceFeed(mesh, factories, queue_depth=queue_depth)
 
 
-def _recordio_chunk_rows(mv: memoryview, max_bytes: int):
-    """One record-aligned RecordIO chunk → ([n, max_bytes] uint8 rows,
-    [n] int32 lengths) in ONE numpy gather (no per-record Python loop).
-
-    The native span scan yields (offset, len, flag) per logical record;
-    flag-0 payloads are gathered with a broadcast index, the rare flag-1
-    multi-segment records are reassembled individually afterwards."""
+def _chunk_spans(mv: memoryview):
+    """Span triples (offset, len, flag) for one record-aligned RecordIO
+    chunk: native scan, or a validated Python header walk as fallback."""
     from .. import native
     from ..io.recordio import KMAGIC, _MAGIC_BYTES, _U32, decode_flag, \
         decode_length
@@ -302,47 +298,140 @@ def _recordio_chunk_rows(mv: memoryview, max_bytes: int):
                         break
                 triples.append((start, pos - start, 1))
         sp = np.asarray(triples, np.uint64).reshape(-1, 3)
-    if sp.shape[0] == 0:
-        return (np.zeros((0, max_bytes), np.uint8), np.zeros(0, np.int32))
+    return sp
 
+
+def _reassemble_region(mv: memoryview, off: int, ln: int) -> bytes:
+    """Reassemble one escaped-magic (multi-segment) record region."""
+    from ..io.recordio import _MAGIC_BYTES, _U32, decode_flag, decode_length
+
+    region = mv[off: off + ln]
+    parts, pos = [], 0
+    first = True
+    while pos + 8 <= len(region):
+        lrec = _U32.unpack_from(region, pos + 4)[0]
+        cf, n = decode_flag(lrec), decode_length(lrec)
+        if not first:
+            parts.append(_MAGIC_BYTES)
+        parts.append(bytes(region[pos + 8: pos + 8 + n]))
+        first = False
+        pos += 8 + ((n + 3) & ~3)
+        if cf in (0, 3):
+            break
+    return b"".join(parts)
+
+
+def _chunk_record_views(mv: memoryview):
+    """Per-record uint8 numpy views over one chunk (zero-copy for flag-0
+    records; flag-1 reassembled as owned arrays)."""
+    sp = _chunk_spans(mv)
     arr = np.frombuffer(mv, np.uint8)
-    offs = sp[:, 0].astype(np.int32)   # chunk-local: always < 2^31
-    lens = np.minimum(sp[:, 1].astype(np.int64), max_bytes)
-    flags = sp[:, 2]
-    n_rows = offs.shape[0]
-    rows = np.empty((n_rows, max_bytes), np.uint8)
-    ar = np.arange(max_bytes, dtype=np.int32)
-    mask = ar[None, :].astype(np.int64) < lens[:, None]
-    # gather in row groups so the transient index array stays bounded
-    # (~16 MB) even for MB-sized records
-    group = max(1, (16 << 20) // max(max_bytes, 1))
-    for lo in range(0, n_rows, group):
-        hi = min(lo + group, n_rows)
-        idx = offs[lo:hi, None] + ar[None, :]
-        np.minimum(idx, arr.size - 1, out=idx)
-        rows[lo:hi] = arr[idx]
-    rows *= mask
+    out = []
+    for off, ln, flag in sp.tolist():
+        if flag == 0:
+            out.append(arr[off: off + ln])
+        else:
+            out.append(np.frombuffer(
+                _reassemble_region(mv, int(off), int(ln)), np.uint8))
+    return out
 
-    for i in np.nonzero(flags == 1)[0]:  # escaped-magic records: reassemble
-        region = mv[int(offs[i]): int(offs[i]) + int(sp[i, 1])]
-        parts, pos = [], 0
-        first = True
-        while pos + 8 <= len(region):
-            lrec = _U32.unpack_from(region, pos + 4)[0]
-            cf, ln = decode_flag(lrec), decode_length(lrec)
-            if not first:
-                parts.append(_MAGIC_BYTES)
-            parts.append(bytes(region[pos + 8: pos + 8 + ln]))
-            first = False
-            pos += 8 + ((ln + 3) & ~3)
-            if cf in (0, 3):
-                break
-        payload = b"".join(parts)
-        n = min(len(payload), max_bytes)
-        rows[i, :n] = np.frombuffer(payload, np.uint8, n)
-        rows[i, n:] = 0
-        lens[i] = n
-    return rows, lens.astype(np.int32)
+
+def _recordio_chunk_rows(mv: memoryview, max_bytes: int, group_rows: int):
+    """One record-aligned RecordIO chunk → groups of ([g, max_bytes]
+    uint8 rows, [g] int32 lengths), each a single numpy gather (no
+    per-record Python loop), yielded in ≤ group_rows slices so peak
+    memory is bounded by the caller's batch size, not the chunk's
+    record count (a chunk of tiny records can hold 100k+ of them).
+
+    The native span scan yields (offset, len, flag) per logical record;
+    flag-0 payloads are gathered with a broadcast index, the rare flag-1
+    multi-segment records are reassembled individually afterwards."""
+    sp = _chunk_spans(mv)
+    arr = np.frombuffer(mv, np.uint8)
+    all_offs = sp[:, 0].astype(np.int32)   # chunk-local: always < 2^31
+    all_lens = np.minimum(sp[:, 1].astype(np.int64), max_bytes)
+    all_flags = sp[:, 2]
+    ar = np.arange(max_bytes, dtype=np.int32)
+    # keep the transient gather index ≲16 MB even for MB-sized records
+    group = max(1, min(group_rows, (16 << 20) // max(max_bytes, 1)))
+    for lo in range(0, all_offs.shape[0], group):
+        hi = min(lo + group, all_offs.shape[0])
+        offs, lens = all_offs[lo:hi], all_lens[lo:hi].copy()
+        idx = offs[:, None] + ar[None, :]
+        np.minimum(idx, arr.size - 1, out=idx)
+        rows = arr[idx]
+        rows *= ar[None, :].astype(np.int64) < lens[:, None]
+        for i in np.nonzero(all_flags[lo:hi] == 1)[0]:  # escaped magic
+            payload = _reassemble_region(mv, int(offs[i]),
+                                         int(sp[lo + i, 1]))
+            n = min(len(payload), max_bytes)
+            rows[i, :n] = np.frombuffer(payload, np.uint8, n)
+            rows[i, n:] = 0
+            lens[i] = n
+        yield rows, lens.astype(np.int32)
+
+
+def recordio_packed_feed(uri: str, mesh, *, buf_bytes: int,
+                         max_records: int = 4096,
+                         queue_depth: int = 2) -> DeviceFeed:
+    """RecordIO shards → packed batches with NO per-record padding:
+    {data [buf_bytes] uint8, offsets [max_records+1] int32, count [1]}.
+
+    Padding a [B, max_bytes] batch wastes host→HBM bandwidth on the gap
+    between mean and max record size; the packed layout ships payload
+    bytes back-to-back (static buf_bytes, zero tail) with record offsets
+    for on-device slicing.  Records larger than buf_bytes are truncated.
+    """
+    from ..io import input_split
+
+    cfg = mesh_config(mesh)
+    n_parts = cfg.data_parts
+
+    def part_iter(part: int):
+        split = input_split.create(uri, part, n_parts, "recordio")
+        try:
+            views: list = []      # np views/copies of pending records
+            pend = 0              # pending payload bytes
+
+            def emit():
+                nonlocal views, pend
+                n = min(len(views), max_records)
+                take, views = views[:n], views[n:]
+                data = np.zeros(buf_bytes, np.uint8)
+                lens = np.fromiter((v.size for v in take), np.int64,
+                                   count=n)
+                packed = np.concatenate(take) if len(take) > 1 else take[0]
+                m = min(packed.size, buf_bytes)
+                data[:m] = packed[:m]
+                offsets = np.zeros(max_records + 1, np.int64)
+                np.cumsum(lens, out=offsets[1: n + 1])
+                np.minimum(offsets, buf_bytes, out=offsets)
+                offsets[n + 1:] = offsets[n]
+                pend = sum(v.size for v in views)
+                return {"data": data,
+                        "offsets": offsets.astype(np.int32),
+                        "count": np.array([n], np.int32)}
+
+            while True:
+                mv = split.next_chunk()
+                if mv is None:
+                    break
+                for v in _chunk_record_views(mv):
+                    if views and (pend + v.size > buf_bytes
+                                  or len(views) >= max_records):
+                        yield emit()
+                    views.append(v)
+                    pend += v.size
+                # chunk buffer may be recycled on the next next_chunk():
+                # materialize leftover views
+                views = [v if v.flags.owndata else v.copy() for v in views]
+            while views:
+                yield emit()
+        finally:
+            split.close()
+
+    factories = [functools.partial(part_iter, p) for p in range(n_parts)]
+    return DeviceFeed(mesh, factories, queue_depth=queue_depth)
 
 
 def recordio_feed(uri: str, mesh, *, batch_records: int, max_bytes: int,
@@ -363,40 +452,36 @@ def recordio_feed(uri: str, mesh, *, batch_records: int, max_bytes: int,
         split = input_split.create(uri, part, n_parts, "recordio")
         try:
             pend_rows = pend_lens = None
-            while True:
-                mv = split.next_chunk()
-                at_eof = mv is None
-                if at_eof:
-                    rows = pend_rows
-                    lens = pend_lens
-                else:
-                    rows, lens = _recordio_chunk_rows(mv, max_bytes)
-                    if pend_rows is not None and pend_rows.shape[0]:
-                        rows = np.concatenate([pend_rows, rows])
-                        lens = np.concatenate([pend_lens, lens])
-                    pend_rows = pend_lens = None
-                if rows is None or rows.shape[0] == 0:
-                    if at_eof:
+
+            def groups():
+                while True:
+                    mv = split.next_chunk()
+                    if mv is None:
                         return
-                    continue
+                    yield from _recordio_chunk_rows(mv, max_bytes,
+                                                    batch_records)
+
+            for rows, lens in groups():
+                if pend_rows is not None and pend_rows.shape[0]:
+                    rows = np.concatenate([pend_rows, rows])
+                    lens = np.concatenate([pend_lens, lens])
+                pend_rows = pend_lens = None
                 n = rows.shape[0]
                 full = (n // batch_records) * batch_records
                 for lo in range(0, full, batch_records):
                     yield {"data": rows[lo:lo + batch_records],
                            "length": lens[lo:lo + batch_records]}
-                if full < n:
-                    if at_eof:  # zero-pad the epoch's final short batch
-                        data = np.zeros((batch_records, max_bytes), np.uint8)
-                        length = np.zeros(batch_records, np.int32)
-                        r = n - full
-                        data[:r] = rows[full:]
-                        length[:r] = lens[full:]
-                        yield {"data": data, "length": length}
-                    else:  # rows are copies (gather output): safe to hold
-                        pend_rows = rows[full:]
-                        pend_lens = lens[full:]
-                if at_eof:
-                    return
+                if full < n:  # rows are copies (gather output): safe to hold
+                    pend_rows = rows[full:]
+                    pend_lens = lens[full:]
+            if pend_rows is not None and pend_rows.shape[0]:
+                # zero-pad the epoch's final short batch
+                data = np.zeros((batch_records, max_bytes), np.uint8)
+                length = np.zeros(batch_records, np.int32)
+                r = pend_rows.shape[0]
+                data[:r] = pend_rows
+                length[:r] = pend_lens
+                yield {"data": data, "length": length}
         finally:
             split.close()
 

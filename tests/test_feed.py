@@ -6,7 +6,8 @@ import pytest
 
 import jax
 
-from dmlc_tpu.feed import DeviceFeed, libsvm_feed, pack_rowblock, recordio_feed
+from dmlc_tpu.feed import (DeviceFeed, libsvm_feed, pack_rowblock,
+                           recordio_feed, recordio_packed_feed)
 from dmlc_tpu.parallel import build_mesh
 
 
@@ -131,6 +132,40 @@ def test_recordio_feed_content_exact(tmp_path, mesh):
     assert len(got) == len(recs)
     for i, (g, want) in enumerate(zip(got, recs)):
         assert g == want[:max_bytes], f"record {i} mismatch"
+
+
+def test_recordio_packed_feed_content_exact(tmp_path):
+    """Packed feed: records back-to-back with offsets, no per-record
+    padding; every record byte-exact incl. escaped-magic ones."""
+    from dmlc_tpu.io.recordio import KMAGIC, RecordIOWriter
+    from dmlc_tpu.io.stream import Stream
+    import struct
+
+    rng = np.random.default_rng(11)
+    magic = struct.pack("<I", KMAGIC)
+    recs = []
+    for i in range(73):
+        if i % 9 == 4:
+            body = b"x" * (4 * (i % 4)) + magic + b"y" * (4 + 4 * (i % 3))
+        else:
+            body = rng.integers(0, 256, 5 + i % 50, dtype=np.uint8).tobytes()
+        recs.append(body)
+    path = str(tmp_path / "packed.rec")
+    with Stream.create(path, "w") as s:
+        w = RecordIOWriter(s)
+        for r in recs:
+            w.write_record(r)
+
+    mesh1 = build_mesh(1, dp=1, sp=1, tp=1, pp=1, ep=1)
+    feed = recordio_packed_feed(path, mesh1, buf_bytes=512, max_records=16)
+    got = []
+    for b in feed:
+        data = np.asarray(b["data"])
+        offsets = np.asarray(b["offsets"])
+        n = int(np.asarray(b["count"])[0])
+        for i in range(n):
+            got.append(bytes(data[offsets[i]:offsets[i + 1]]))
+    assert got == recs
 
 
 def test_feed_epoch_ends_cleanly(tmp_path, mesh):
