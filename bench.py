@@ -30,7 +30,6 @@ import time
 WORK = "/tmp/dmlc_tpu_bench"
 DATA = os.path.join(WORK, "data.rec")
 INDEX = os.path.join(WORK, "data.idx")
-REFBIN = os.path.join(WORK, "refbench")
 TARGET_PAYLOAD = 128 << 20  # 128 MB
 TRIALS = 3
 
@@ -108,6 +107,14 @@ def ensure_data():
         for i, (off, _ln, flag) in enumerate(sp.tolist()):
             head = off - 8 if flag == 0 else off
             f.write(f"{i} {head}\n")
+
+
+# cache key includes the harness source: a stale binary from an earlier
+# bench version would silently measure the wrong reference path
+import hashlib
+
+REFBIN = os.path.join(
+    WORK, "refbench_" + hashlib.md5(REF_MAIN.encode()).hexdigest()[:10])
 
 
 def ensure_refbin():

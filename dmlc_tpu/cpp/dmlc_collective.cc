@@ -15,9 +15,11 @@
 #include "dmlc_collective.h"
 
 #include <arpa/inet.h>
+#include <errno.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -34,6 +36,7 @@ namespace {
 constexpr int32_t kMagic = 0xff99;
 constexpr long kMaxFrame = 0x7fffffffL;  // int32 length frames: < 2 GiB
 constexpr int kBrokerRetries = 50;       // ~10 s of peer-dial retries
+constexpr long kChunk = 256 << 10;       // streaming chunk (multiple of 8)
 
 thread_local std::string g_init_error;
 
@@ -161,12 +164,36 @@ void fold(T* acc, const T* in, long n, int op) {
   }
 }
 
+int fold_bytes(void* acc, const void* in, long count, int dtype, int op) {
+  switch (dtype) {
+    case DMLC_F32:
+      fold(static_cast<float*>(acc), static_cast<const float*>(in), count, op);
+      return 0;
+    case DMLC_F64:
+      fold(static_cast<double*>(acc), static_cast<const double*>(in), count,
+           op);
+      return 0;
+    case DMLC_I32:
+      fold(static_cast<int32_t*>(acc), static_cast<const int32_t*>(in), count,
+           op);
+      return 0;
+    case DMLC_I64:
+      fold(static_cast<int64_t*>(acc), static_cast<const int64_t*>(in), count,
+           op);
+      return 0;
+    default:
+      return -2;
+  }
+}
+
 }  // namespace
 
 struct DmlcComm {
   int rank = -1;
   int world = -1;
   int parent = -1;                 // my tree parent (tracker-reported)
+  int ring_prev = -1;             // DFS-ring neighbours (tracker-brokered)
+  int ring_next = -1;
   std::vector<int> tree_nbrs;     // tracker-reported neighbours
   std::vector<int> parents;       // full relabeled parent map, all ranks
   std::map<int, Frame> links;     // peer rank -> socket
@@ -204,14 +231,6 @@ struct DmlcComm {
     return true;
   }
 
-  bool send_block(Frame& f, const void* data, long n) {
-    return f.send_int(static_cast<int32_t>(n)) && f.send_all(data, n);
-  }
-  bool recv_block(Frame& f, void* data, long n) {
-    int32_t got;
-    if (!f.recv_int(&got) || got != n) return false;
-    return f.recv_all(data, n);
-  }
 };
 
 extern "C" {
@@ -251,7 +270,7 @@ DmlcComm* dmlc_comm_init(void) {
 
   Frame fs;
   if (!c->session("start", &fs)) return fail_init(c);
-  int32_t n_nbrs = 0, ring_prev, ring_next;
+  int32_t n_nbrs = 0;
   bool ok = fs.recv_int(&c->rank) && fs.recv_int(&c->parent) &&
             fs.recv_int(&c->world) && fs.recv_int(&n_nbrs);
   for (int i = 0; ok && i < n_nbrs; ++i) {
@@ -259,7 +278,7 @@ DmlcComm* dmlc_comm_init(void) {
     ok = fs.recv_int(&r);
     c->tree_nbrs.push_back(r);
   }
-  ok = ok && fs.recv_int(&ring_prev) && fs.recv_int(&ring_next);
+  ok = ok && fs.recv_int(&c->ring_prev) && fs.recv_int(&c->ring_next);
 
   // brokering: report good links, connect assigned peers, repeat until a
   // round has zero dial errors (the tracker's nerr-retry loop,
@@ -309,6 +328,10 @@ DmlcComm* dmlc_comm_init(void) {
   for (int i = 0; ok && i < n_accept; ++i) {
     Frame pf;
     pf.fd = accept(c->listener, nullptr, nullptr);
+    if (pf.fd >= 0) {
+      int one = 1;
+      setsockopt(pf.fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    }
     int32_t m, prank;
     ok = pf.fd >= 0 && pf.recv_int(&m) && m == kMagic &&
          pf.recv_int(&prank) && pf.send_int(kMagic) && pf.send_int(c->rank);
@@ -333,42 +356,92 @@ const char* dmlc_comm_last_error(const DmlcComm* c) {
   return c == nullptr ? g_init_error.c_str() : c->error.c_str();
 }
 
+// Streaming (chunked) binomial-tree allreduce.  The whole-buffer version
+// store-and-forwarded nbytes at every tree level (latency = depth ×
+// nbytes/bw and an nbytes temp per call); chunking at kChunk turns every
+// link into a pipeline — a rank folds+forwards chunk i while its children
+// are already transmitting chunk i+1 into the socket buffers — so
+// wall-clock approaches max-per-link-bytes/bw + depth × chunk latency,
+// and the temp is one chunk, not one payload.
+// One int32 size frame per direction per collective: peers disagreeing
+// on the payload size fail fast instead of desynchronizing the stream
+// (the whole-buffer version had this via its per-block length prefix).
+static bool size_handshake(DmlcComm* c, const std::vector<int>& kids,
+                           long nbytes) {
+  for (int ch : kids) {
+    int32_t got;
+    if (!c->links[ch].recv_int(&got) || got != nbytes) return false;
+  }
+  if (c->parent >= 0 &&
+      !c->links[c->parent].send_int(static_cast<int32_t>(nbytes)))
+    return false;
+  return true;
+}
+
 static int tree_allreduce_bytes(DmlcComm* c, void* data, long count,
                                 int dtype, int op) {
   const long esize = (dtype == DMLC_F32 || dtype == DMLC_I32) ? 4 : 8;
   const long nbytes = count * esize;
-  std::vector<char> tmp(nbytes);
-  // reduce up the tree
-  for (int ch : c->children()) {
-    if (!c->recv_block(c->links[ch], tmp.data(), nbytes)) return -1;
-    switch (dtype) {
-      case DMLC_F32:
-        fold(static_cast<float*>(data),
-             reinterpret_cast<const float*>(tmp.data()), count, op);
-        break;
-      case DMLC_F64:
-        fold(static_cast<double*>(data),
-             reinterpret_cast<const double*>(tmp.data()), count, op);
-        break;
-      case DMLC_I32:
-        fold(static_cast<int32_t*>(data),
-             reinterpret_cast<const int32_t*>(tmp.data()), count, op);
-        break;
-      case DMLC_I64:
-        fold(static_cast<int64_t*>(data),
-             reinterpret_cast<const int64_t*>(tmp.data()), count, op);
-        break;
-      default:
+  std::vector<char> tmp(std::min(nbytes, kChunk));
+  std::vector<int> kids = c->children();
+  char* p = static_cast<char*>(data);
+  if (!size_handshake(c, kids, nbytes)) return -1;
+  // upward: per chunk, fold every child's contribution then forward
+  for (long off = 0; off < nbytes; off += kChunk) {
+    const long n = std::min(kChunk, nbytes - off);
+    for (int ch : kids) {
+      if (!c->links[ch].recv_all(tmp.data(), n)) return -1;
+      if (fold_bytes(p + off, tmp.data(), n / esize, dtype, op) != 0)
         return -2;
     }
+    if (c->parent >= 0 && !c->links[c->parent].send_all(p + off, n))
+      return -1;
   }
-  if (c->parent >= 0) {
-    if (!c->send_block(c->links[c->parent], data, nbytes)) return -1;
-    if (!c->recv_block(c->links[c->parent], data, nbytes)) return -1;
+  // downward: stream the reduced chunks back out
+  for (long off = 0; off < nbytes; off += kChunk) {
+    const long n = std::min(kChunk, nbytes - off);
+    if (c->parent >= 0 && !c->links[c->parent].recv_all(p + off, n))
+      return -1;
+    for (int ch : kids)
+      if (!c->links[ch].send_all(p + off, n)) return -1;
   }
-  for (int ch : c->children())
-    if (!c->send_block(c->links[ch], data, nbytes)) return -1;
   return 0;
+}
+
+// Full-duplex bounded transfer: send src→out_fd while receiving
+// in_fd→dst, making progress on whichever direction is ready.  This is
+// what lets the ring run without threads and without deadlocking when
+// block size exceeds the socket buffers (everyone sends and receives
+// simultaneously).  out_fd and in_fd may be the same fd (world == 2).
+static bool duplex(int out_fd, int in_fd, const char* src, char* dst,
+                   long n) {
+  long sent = 0, rcvd = 0;
+  while (sent < n || rcvd < n) {
+    pollfd p[2];
+    int np = 0, oi = -1, ii = -1;
+    if (sent < n) {
+      p[np] = {out_fd, POLLOUT, 0};
+      oi = np++;
+    }
+    if (rcvd < n) {
+      p[np] = {in_fd, POLLIN, 0};
+      ii = np++;
+    }
+    if (poll(p, np, 60000) <= 0) return false;
+    if (oi >= 0 && (p[oi].revents & (POLLOUT | POLLERR | POLLHUP))) {
+      ssize_t k = ::send(out_fd, src + sent, n - sent,
+                         MSG_NOSIGNAL | MSG_DONTWAIT);
+      if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK) return false;
+      if (k > 0) sent += k;
+    }
+    if (ii >= 0 && (p[ii].revents & (POLLIN | POLLERR | POLLHUP))) {
+      ssize_t k = ::recv(in_fd, dst + rcvd, n - rcvd, MSG_DONTWAIT);
+      if (k == 0) return false;
+      if (k < 0 && errno != EAGAIN && errno != EWOULDBLOCK) return false;
+      if (k > 0) rcvd += k;
+    }
+  }
+  return true;
 }
 
 int dmlc_comm_allreduce(DmlcComm* c, void* data, long count, int dtype,
@@ -395,29 +468,31 @@ int dmlc_comm_broadcast(DmlcComm* c, void* data, long nbytes, int root) {
   if (c->world <= 1) return 0;
   // relay root's buffer up its ancestor path to rank 0 (every rank can
   // compute the path from the deterministic relabeled tree), then do a
-  // plain top-down tree broadcast
+  // top-down tree broadcast — chunked, so the relay and the fan-out
+  // stream concurrently instead of store-and-forwarding whole payloads
   std::vector<bool> on_path(c->world, false);
   for (int r = root; r >= 0; r = c->parents[r]) on_path[r] = true;
-  if (root != 0) {
-    if (c->rank != root && on_path[c->rank]) {
-      // which child of mine is on the path?
-      for (int ch : c->children()) {
-        if (on_path[ch]) {
-          if (!c->recv_block(c->links[ch], data, nbytes)) return -1;
-          break;
-        }
+  int path_child = -1;
+  for (int ch : c->children())
+    if (on_path[ch]) path_child = ch;
+  if (!size_handshake(c, c->children(), nbytes)) return -1;
+  char* p = static_cast<char*>(data);
+  for (long off = 0; off < nbytes; off += kChunk) {
+    const long n = std::min(kChunk, nbytes - off);
+    if (root != 0) {
+      if (c->rank != root && on_path[c->rank] && path_child >= 0) {
+        if (!c->links[path_child].recv_all(p + off, n)) return -1;
+      }
+      if (on_path[c->rank] && c->rank != 0) {
+        if (!c->links[c->parent].send_all(p + off, n)) return -1;
       }
     }
-    if (on_path[c->rank] && c->rank != 0) {
-      if (!c->send_block(c->links[c->parent], data, nbytes)) return -1;
+    if (c->rank != 0) {
+      if (!c->links[c->parent].recv_all(p + off, n)) return -1;
     }
+    for (int ch : c->children())
+      if (!c->links[ch].send_all(p + off, n)) return -1;
   }
-  // top-down from 0
-  if (c->rank != 0) {
-    if (!c->recv_block(c->links[c->parent], data, nbytes)) return -1;
-  }
-  for (int ch : c->children())
-    if (!c->send_block(c->links[ch], data, nbytes)) return -1;
   return 0;
 }
 
@@ -428,8 +503,33 @@ int dmlc_comm_allgather(DmlcComm* c, const void* in, long nbytes, void* out) {
   }
   char* o = static_cast<char*>(out);
   memcpy(o + c->rank * nbytes, in, nbytes);
-  if (c->world <= 1) return 0;
-  // gather subtree blocks to rank 0: each child sends (rank, block) pairs
+  if (c->world <= 1 || nbytes == 0) return 0;
+  // Ring allgather over the tracker-brokered DFS ring: world-1 steps,
+  // each rank forwarding the block it received in the previous step
+  // while receiving the next — every link carries (world-1)·nbytes in
+  // parallel, versus the old design funnelling world² blocks through
+  // rank 0's links.  duplex() makes each step deadlock-free regardless
+  // of block size.
+  if (c->ring_next >= 0 && c->ring_prev >= 0 &&
+      c->links.count(c->ring_next) && c->links.count(c->ring_prev)) {
+    const int w = c->world;
+    Frame& nxt = c->links[c->ring_next];
+    Frame& prv = c->links[c->ring_prev];
+    // size frame around the ring (4 bytes: socket buffers absorb it)
+    int32_t got;
+    if (!nxt.send_int(static_cast<int32_t>(nbytes)) || !prv.recv_int(&got) ||
+        got != nbytes)
+      return -1;
+    for (int s = 0; s < w - 1; ++s) {
+      const int sb = (c->rank - s + w) % w;       // block I forward
+      const int rb = (c->rank - s - 1 + w) % w;   // block I receive
+      if (!duplex(nxt.fd, prv.fd, o + sb * nbytes, o + rb * nbytes,
+                  nbytes))
+        return -1;
+    }
+    return 0;
+  }
+  // fallback (no ring links): subtree gather to rank 0 + broadcast
   std::vector<std::pair<int32_t, std::vector<char>>> blocks;
   blocks.emplace_back(c->rank, std::vector<char>(
       static_cast<const char*>(in), static_cast<const char*>(in) + nbytes));

@@ -1,13 +1,20 @@
 /* C test driver for the dmlc_collective ABI: run under
  *   dmlc-submit --cluster local --num-workers N -- ./test_collective
  * Exercises allreduce (sum/max/min, f32/i64), broadcast from a nonzero
- * root, and allgather; exits nonzero on any mismatch. */
+ * root, and allgather; exits nonzero on any mismatch.
+ *
+ * With argv[1] == "bench": allreduce bus-bandwidth microbench (1KB /
+ * 1MB / 64MB f32 payloads + a 1MB allgather); rank 0 prints one JSON
+ * line per size on stdout.  busbw follows the NCCL convention
+ * 2·(n-1)/n · algbw. */
+#define _POSIX_C_SOURCE 199309L  /* clock_gettime under -std=c99 */
 #include "dmlc_collective.h"
 
 #include <math.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <time.h>
 
 #define CHECK(cond, msg)                                   \
   do {                                                     \
@@ -17,7 +24,79 @@
     }                                                      \
   } while (0)
 
-int main(void) {
+static double now_s(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+static int run_bench(DmlcComm* c) {
+  int rank = dmlc_comm_rank(c);
+  int world = dmlc_comm_world_size(c);
+  const long sizes[] = {1 << 10, 1 << 20, 64l << 20};
+  const int reps[] = {50, 20, 4};
+  size_t si;
+  for (si = 0; si < sizeof sizes / sizeof sizes[0]; ++si) {
+    const long nbytes = sizes[si];
+    const long count = nbytes / 4;
+    float* buf = (float*)malloc(nbytes);
+    long i;
+    for (i = 0; i < count; ++i) buf[i] = 1.0f;
+    /* warmup + barrier-ish sync */
+    CHECK(dmlc_comm_allreduce(c, buf, count, DMLC_F32, DMLC_SUM) == 0,
+          "bench warmup");
+    double t0 = now_s();
+    int r;
+    for (r = 0; r < reps[si]; ++r) {
+      CHECK(dmlc_comm_allreduce(c, buf, count, DMLC_F32, DMLC_SUM) == 0,
+            "bench allreduce");
+    }
+    double dt = now_s() - t0;
+    if (rank == 0) {
+      double algbw = nbytes * (double)reps[si] / dt / 1e6;
+      double busbw = algbw * 2.0 * (world - 1) / world;
+      /* aggregate bytes the tree actually moves through the transport:
+       * every non-root sends nbytes up and receives nbytes down */
+      double linkbw = algbw * 2.0 * (world - 1);
+      printf("{\"op\": \"allreduce\", \"bytes\": %ld, \"algbw_MBps\": %.1f, "
+             "\"busbw_MBps\": %.1f, \"aggregate_link_MBps\": %.1f, "
+             "\"world\": %d}\n",
+             nbytes, algbw, busbw, linkbw, world);
+      fflush(stdout);
+    }
+    free(buf);
+  }
+  /* allgather 1MB per rank */
+  {
+    const long nbytes = 1 << 20;
+    char* in = (char*)malloc(nbytes);
+    char* out = (char*)malloc(nbytes * world);
+    memset(in, (char)rank, nbytes);
+    CHECK(dmlc_comm_allgather(c, in, nbytes, out) == 0, "bench allgather");
+    double t0 = now_s();
+    int r;
+    const int R = 10;
+    for (r = 0; r < R; ++r)
+      CHECK(dmlc_comm_allgather(c, in, nbytes, out) == 0, "bench allgather");
+    double dt = now_s() - t0;
+    int i;
+    for (i = 0; i < world; ++i)
+      CHECK(out[i * nbytes] == (char)i, "bench allgather value");
+    if (rank == 0) {
+      double algbw = nbytes * (double)world * R / dt / 1e6;
+      double busbw = algbw * (world - 1) / world;
+      printf("{\"op\": \"allgather\", \"bytes\": %ld, \"algbw_MBps\": %.1f, "
+             "\"busbw_MBps\": %.1f, \"world\": %d}\n",
+             nbytes, algbw, busbw, world);
+      fflush(stdout);
+    }
+    free(in);
+    free(out);
+  }
+  return 0;
+}
+
+int main(int argc, char** argv) {
   DmlcComm* c = dmlc_comm_init();
   if (c == NULL) {
     fprintf(stderr, "FAIL: dmlc_comm_init returned NULL\n");
@@ -26,6 +105,12 @@ int main(void) {
   int rank = dmlc_comm_rank(c);
   int world = dmlc_comm_world_size(c);
   CHECK(rank >= 0 && world >= 1, "bad rank/world");
+
+  if (argc > 1 && strcmp(argv[1], "bench") == 0) {
+    int rc = run_bench(c);
+    dmlc_comm_shutdown(c);
+    return rc;
+  }
 
   /* allreduce sum: rank+1 summed over ranks = world*(world+1)/2 */
   float v[8];
@@ -63,6 +148,36 @@ int main(void) {
     CHECK(all[2 * i] == i && all[2 * i + 1] == i * i, "allgather value");
   }
   free(all);
+
+  /* large chunked allreduce: exercises the streaming pipeline */
+  {
+    long n = (8 << 20) / 4;
+    float* big = (float*)malloc(n * 4);
+    long j;
+    for (j = 0; j < n; ++j) big[j] = (float)((j % 97) + rank);
+    CHECK(dmlc_comm_allreduce(c, big, n, DMLC_F32, DMLC_SUM) == 0,
+          "big allreduce rc");
+    for (j = 0; j < n; j += 1009) {
+      float want = world * (float)(j % 97) + world * (world - 1) / 2.0f;
+      CHECK(fabsf(big[j] - want) < 1e-2, "big allreduce value");
+    }
+    free(big);
+  }
+
+  /* large allgather: exercises the duplex ring path */
+  {
+    long nb = 512 << 10;
+    char* in2 = (char*)malloc(nb);
+    char* out2 = (char*)malloc(nb * world);
+    memset(in2, rank + 1, nb);
+    CHECK(dmlc_comm_allgather(c, in2, nb, out2) == 0, "big allgather rc");
+    for (i = 0; i < world; ++i)
+      CHECK(out2[(long)i * nb] == (char)(i + 1) &&
+                out2[(long)i * nb + nb - 1] == (char)(i + 1),
+            "big allgather value");
+    free(in2);
+    free(out2);
+  }
 
   {
     char msg[64];

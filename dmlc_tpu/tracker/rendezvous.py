@@ -22,8 +22,45 @@ from .protocol import MAGIC, FrameSocket, link_maps, resolve_ip
 logger = logging.getLogger("dmlc_tpu.tracker")
 
 
+class AcceptRegistry:
+    """Ranks currently listening for inbound peer dials.
+
+    A worker lands here after its brokering round leaves it with a
+    nonzero inbound quota (peers that were not yet assigned when it
+    finished, and so will be told to dial IT later).  Each time the
+    tracker directs some later worker to dial rank r, r's quota drops;
+    at zero the rank stops being a dial target and leaves the registry.
+    """
+
+    def __init__(self):
+        self._listening: Dict[int, "WorkerEntry"] = {}
+
+    def __contains__(self, rank: int) -> bool:
+        return rank in self._listening
+
+    def endpoint(self, rank: int):
+        w = self._listening[rank]
+        return w.host, w.port
+
+    def add(self, rank: int, worker: "WorkerEntry") -> None:
+        if worker.inbound_quota > 0:
+            self._listening[rank] = worker
+
+    def note_dialed(self, ranks) -> List[int]:
+        """Record that ``ranks`` each just received one inbound link;
+        returns those whose quota is now exhausted (and drops them)."""
+        filled = []
+        for r in ranks:
+            w = self._listening[r]
+            w.inbound_quota -= 1
+            if w.inbound_quota == 0:
+                filled.append(r)
+                del self._listening[r]
+        return filled
+
+
 class WorkerEntry:
-    """One accepted worker connection (SlaveEntry analog)."""
+    """One accepted worker connection (reference SlaveEntry role)."""
 
     def __init__(self, sock: socket.socket, addr):
         self.sock = FrameSocket(sock)
@@ -36,8 +73,8 @@ class WorkerEntry:
         self.world_size = self.sock.recv_int()
         self.jobid = self.sock.recv_str()
         self.cmd = self.sock.recv_str()
-        self.wait_accept = 0
-        self.port: Optional[int] = None
+        self.inbound_quota = 0          # peers that will dial in later
+        self.port: Optional[int] = None  # worker's accept port
 
     def decide_rank(self, job_map: Dict[str, int]) -> int:
         if self.rank >= 0:
@@ -46,53 +83,70 @@ class WorkerEntry:
             return job_map[self.jobid]
         return -1
 
-    def assign_rank(self, rank, wait_conn, tree_map, parent_map, ring_map):
-        """Send topology, then broker peer connections until the worker
-        reports zero errors.  Returns ranks whose accept quota filled."""
-        self.rank = rank
-        nnset = set(tree_map[rank])
-        rprev, rnext = ring_map[rank]
+    def _send_topology(self, rank, tree_map, parent_map, ring_map):
+        """Issue rank + overlay neighbours; returns the full set of peer
+        ranks this worker must end up linked to (tree ∪ ring)."""
+        peers = set(tree_map[rank])
         self.sock.send_int(rank)
         self.sock.send_int(parent_map[rank])
         self.sock.send_int(len(tree_map))
-        self.sock.send_int(len(nnset))
-        for r in nnset:
+        self.sock.send_int(len(peers))
+        for r in peers:
             self.sock.send_int(r)
-        if rprev != -1 and rprev != rank:
-            nnset.add(rprev)
-            self.sock.send_int(rprev)
-        else:
-            self.sock.send_int(-1)
-        if rnext != -1 and rnext != rank:
-            nnset.add(rnext)
-            self.sock.send_int(rnext)
-        else:
-            self.sock.send_int(-1)
+        for ring_nbr in ring_map[rank]:  # (prev, next)
+            if ring_nbr != -1 and ring_nbr != rank:
+                peers.add(ring_nbr)
+                self.sock.send_int(ring_nbr)
+            else:
+                self.sock.send_int(-1)
+        return peers
+
+    def assign_rank(self, rank, registry: AcceptRegistry, tree_map,
+                    parent_map, ring_map) -> List[int]:
+        """Send topology, then broker peer links until the worker reports
+        a clean round.  Wire format: reference tracker.py:80-135.
+
+        Each round: the worker reports which links it already holds; the
+        tracker answers with the endpoints it should DIAL now (peers
+        already listening) and the count it should expect to ACCEPT
+        later; the worker replies with its dial-error count — nonzero
+        restarts the round, zero ends with the worker's accept port.
+        Returns ranks whose inbound quota filled during this exchange.
+        """
+        self.rank = rank
+        required = self._send_topology(rank, tree_map, parent_map, ring_map)
+        filled: List[int] = []
+        debited: set = set()  # dial targets already charged one inbound link
+        dialed: set = set()   # every target we have handed out so far
         while True:
-            ngood = self.sock.recv_int()
-            goodset = {self.sock.recv_int() for _ in range(ngood)}
-            assert goodset.issubset(nnset), (goodset, nnset)
-            badset = nnset - goodset
-            conset = [r for r in badset if r in wait_conn]
-            self.sock.send_int(len(conset))
-            self.sock.send_int(len(badset) - len(conset))
-            for r in conset:
-                self.sock.send_str(wait_conn[r].host)
-                self.sock.send_int(wait_conn[r].port)
+            n_held = self.sock.recv_int()
+            held = {self.sock.recv_int() for _ in range(n_held)}
+            assert held.issubset(required), (held, required)
+            # dials that stuck during a FAILED earlier round show up in the
+            # worker's held set now — charge their quotas exactly once
+            confirmed = (held & dialed) - debited
+            filled += registry.note_dialed(confirmed)
+            debited |= confirmed
+            missing = required - held
+            dial_now = sorted(r for r in missing if r in registry)
+            n_accept = len(missing) - len(dial_now)
+            self.sock.send_int(len(dial_now))
+            self.sock.send_int(n_accept)
+            for r in dial_now:
+                host, port = registry.endpoint(r)
+                self.sock.send_str(host)
+                self.sock.send_int(port)
                 self.sock.send_int(r)
-            nerr = self.sock.recv_int()
-            if nerr != 0:
-                continue
+            dialed |= set(dial_now)
+            n_dial_errors = self.sock.recv_int()
+            if n_dial_errors != 0:
+                continue  # transient dial failures: rebroker from scratch
             self.port = self.sock.recv_int()
-            done = []
-            for r in conset:
-                wait_conn[r].wait_accept -= 1
-                if wait_conn[r].wait_accept == 0:
-                    done.append(r)
-            for r in done:
-                wait_conn.pop(r, None)
-            self.wait_accept = len(badset) - len(conset)
-            return done
+            # a clean round means every dial in it succeeded
+            filled += registry.note_dialed(set(dial_now) - debited)
+            self.inbound_quota = n_accept
+            registry.add(rank, self)
+            return filled
 
 
 class RabitTracker:
@@ -128,7 +182,7 @@ class RabitTracker:
 
     def _accept_loop(self, n_workers: int) -> None:
         shutdown: Dict[int, WorkerEntry] = {}
-        wait_conn: Dict[int, WorkerEntry] = {}
+        registry = AcceptRegistry()
         job_map: Dict[str, int] = {}
         pending: List[WorkerEntry] = []
         tree_map = None
@@ -148,7 +202,7 @@ class RabitTracker:
                 continue
             if w.cmd == "shutdown":
                 assert w.rank >= 0 and w.rank not in shutdown
-                assert w.rank not in wait_conn
+                assert w.rank not in registry
                 shutdown[w.rank] = w
                 logger.debug("shutdown from rank %d", w.rank)
                 continue
@@ -174,19 +228,15 @@ class RabitTracker:
                         rank = todo.pop(0)
                         if p.jobid != "NULL":
                             job_map[p.jobid] = rank
-                        p.assign_rank(rank, wait_conn, tree_map, parent_map,
+                        p.assign_rank(rank, registry, tree_map, parent_map,
                                       ring_map)
-                        if p.wait_accept > 0:
-                            wait_conn[rank] = p
                         logger.debug("assigned rank %d to %s", p.rank, p.host)
                     pending = []
                 if not todo:
                     logger.info("@tracker all %d workers started", n_workers)
                     self.start_time = time.time()
             else:
-                w.assign_rank(rank, wait_conn, tree_map, parent_map, ring_map)
-                if w.wait_accept > 0:
-                    wait_conn[rank] = w
+                w.assign_rank(rank, registry, tree_map, parent_map, ring_map)
                 logger.debug("%s from rank %d", w.cmd, w.rank)
         self.end_time = time.time()
         if self.start_time is not None:

@@ -114,6 +114,31 @@ def test_print_relay_and_walltime(caplog):
     assert any("hello from rank" in r.message for r in caplog.records)
 
 
+@pytest.mark.parametrize("n", [2, 5, 8])
+def test_recover_relinks_whole_world(n):
+    """All workers recover concurrently: everyone keeps their rank, the
+    full overlay re-establishes through the AcceptRegistry brokering,
+    and a post-recovery allreduce still sums correctly."""
+    barrier = threading.Barrier(n)
+
+    def fn(c):
+        pre = float(c.allreduce_sum(np.asarray([c.rank + 1.0], np.float64))[0])
+        old_rank = c.rank
+        old_links = sorted(c.links)
+        barrier.wait(timeout=20)
+        c.recover()
+        post = float(c.allreduce_sum(np.asarray([c.rank + 1.0],
+                                                np.float64))[0])
+        return old_rank, c.rank, old_links, sorted(c.links), pre, post
+
+    results = _run_workers(n, fn)
+    want = n * (n + 1) / 2.0
+    for old_rank, new_rank, old_links, new_links, pre, post in results:
+        assert new_rank == old_rank
+        assert new_links == old_links
+        assert pre == want and post == want
+
+
 def test_recover_single_worker():
     tracker = RabitTracker("127.0.0.1", 1)
     tracker.start(1)
