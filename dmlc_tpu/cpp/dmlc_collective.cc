@@ -427,7 +427,12 @@ static bool duplex(int out_fd, int in_fd, const char* src, char* dst,
       p[np] = {in_fd, POLLIN, 0};
       ii = np++;
     }
-    if (poll(p, np, 60000) <= 0) return false;
+    int pr = poll(p, np, -1);  // block like recv_all; stragglers are legal
+    if (pr < 0) {
+      if (errno == EINTR) continue;  // signals must not kill a collective
+      return false;
+    }
+    if (pr == 0) continue;
     if (oi >= 0 && (p[oi].revents & (POLLOUT | POLLERR | POLLHUP))) {
       ssize_t k = ::send(out_fd, src + sent, n - sent,
                          MSG_NOSIGNAL | MSG_DONTWAIT);

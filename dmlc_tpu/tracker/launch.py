@@ -239,26 +239,69 @@ class GangScheduler:
             raise RuntimeError(f"tasks failed: {errors}")
 
 
+def _ssh_call(cmd: List[str]) -> int:
+    """One transport invocation; module-level so tests can fake it."""
+    return subprocess.call(cmd)
+
+
+def _copy_to_host(host: str, paths: Sequence[str], dest: str) -> None:
+    """Ship ``paths`` into ``dest/`` on ``host`` (module-level: fakeable).
+
+    The remote dir is created via --rsync-path (portable back to old
+    rsync, unlike --mkpath which needs >= 3.2.3)."""
+    hostname = host.partition(":")[0]
+    subprocess.check_call(
+        ["rsync", "-az", f"--rsync-path=mkdir -p {dest!r} && rsync",
+         *paths, f"{hostname}:{dest}/"])
+
+
 def _make_ssh_runner(command: Sequence[str], sync_dst_dir=None):
     def runner(host, role, task_id, env):
         cmd = build_ssh_cmd(host, command, env, sync_dst_dir)
-        return subprocess.call(cmd)
+        return _ssh_call(cmd)
     return runner
+
+
+def _stage_cache(args, hosts: List[str]):
+    """Auto file cache (reference opts.py:6-36,110-124): ship command
+    files / --files / --archives plus the bootstrap script to a job
+    cache dir on every host; the remote command becomes
+    ``python3 ./bootstrap.py <rewritten command>`` running from there.
+
+    Returns (remote_command, remote_dir, extra_env); a no-op (original
+    command, --sync-dst-dir, {}) when nothing needs shipping.
+    """
+    from .opts import cache_file_set
+
+    fset, rewritten = cache_file_set(args)
+    archives = [a for a in getattr(args, "archives", [])
+                if os.path.exists(a)]
+    if not fset and not archives:
+        return list(args.command), args.sync_dst_dir, {}
+    dest = args.sync_dst_dir or "/tmp/dmlc-cache-{}".format(
+        args.jobname or os.getpid())
+    bootstrap = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "bootstrap.py")
+    paths = sorted(fset) + archives + [bootstrap]
+    for h in hosts:
+        _copy_to_host(h, paths, dest)
+    extra_env = {"DMLC_JOB_CACHE_DIR": dest}
+    if archives:
+        extra_env["DMLC_JOB_ARCHIVES"] = ":".join(
+            os.path.basename(a) for a in archives)
+    return ["python3", "./bootstrap.py", "--"] + rewritten, dest, extra_env
 
 
 def submit_ssh(args):
     """ssh backend (reference ssh.py:37-86), via GangScheduler for retry."""
     hosts = read_host_file(args.host_file)
     if args.sync_dst_dir:
-        for h in hosts:
-            hostname = h.partition(":")[0]
-            subprocess.check_call(
-                ["rsync", "-az", os.getcwd() + "/",
-                 f"{hostname}:{args.sync_dst_dir}/"])
-    sched = GangScheduler(hosts, _make_ssh_runner(args.command,
-                                                  args.sync_dst_dir),
+        for h in hosts:  # whole-workdir sync (reference ssh.py:13-21)
+            _copy_to_host(h, [os.getcwd() + "/"], args.sync_dst_dir)
+    command, remote_dir, cache_env = _stage_cache(args, hosts)
+    sched = GangScheduler(hosts, _make_ssh_runner(command, remote_dir),
                           max_attempts=args.max_attempts)
-    return _submit_gang(args, sched, "ssh")
+    return _submit_gang(args, sched, "ssh", cache_env)
 
 
 def submit_tpu_vm(args):
@@ -269,21 +312,24 @@ def submit_tpu_vm(args):
     placed round-robin with attempt counters and failing-host blacklist.
     """
     hosts = read_host_file(args.host_file)
-    sched = GangScheduler(hosts, _make_ssh_runner(args.command,
-                                                  args.sync_dst_dir),
+    command, remote_dir, cache_env = _stage_cache(args, hosts)
+    sched = GangScheduler(hosts, _make_ssh_runner(command, remote_dir),
                           max_attempts=args.max_attempts)
-    return _submit_gang(args, sched, "tpu-vm")
+    return _submit_gang(args, sched, "tpu-vm", cache_env)
 
 
-def _submit_gang(args, sched: "GangScheduler", cluster: str):
+def _submit_gang(args, sched: "GangScheduler", cluster: str,
+                 cache_env: Optional[Dict[str, str]] = None):
     failures = []
     threads = []
+    extra = dict(args.extra_env)
+    if cache_env:
+        extra.update(cache_env)
 
     def fun_submit(n_workers, n_servers, envs):
         def run():
             try:
-                sched.run_all(n_workers, n_servers, envs, cluster,
-                              args.extra_env)
+                sched.run_all(n_workers, n_servers, envs, cluster, extra)
             except Exception as e:
                 failures.append(e)
 

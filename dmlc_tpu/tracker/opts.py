@@ -50,6 +50,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--slurm-server-nodes", default=None, type=int)
     p.add_argument("--sync-dst-dir", default=None,
                    help="rsync the working dir to this path on each host first")
+    p.add_argument("--auto-file-cache", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="ship files named in the command to the remote job "
+                        "cache dir and rewrite them to ./basename "
+                        "(ssh/tpu-vm backends)")
+    p.add_argument("--files", action="append", default=[],
+                   help="extra files to ship to the job cache dir")
+    p.add_argument("--archives", action="append", default=[],
+                   help="archives (.zip/.tar[.gz]) shipped and unpacked in "
+                        "the job cache dir — python-library shipping")
     p.add_argument("--max-attempts", default=3, type=int,
                    help="per-task restart budget (DMLC_NUM_ATTEMPT contract)")
     p.add_argument("--env", action="append", default=[],
@@ -57,6 +67,46 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="command to run on every task")
     return p
+
+
+def cache_file_set(args):
+    """Files to ship to the execution environment + the rewritten command
+    (reference opts.py:6-36): with auto-file-cache on, every command
+    token naming an existing file is shipped and rewritten to
+    ``./basename``; --files adds extras without rewriting.
+
+    With --sync-dst-dir the whole working tree is already shipped, so
+    command rewriting is suppressed (relative paths stay valid there)
+    and only --files extras are staged.  A --files path that does not
+    exist is an error (a typo surfacing remotely is much harder to
+    trace); basename collisions in the flat cache dir are an error too.
+    """
+    fset = set()
+    cmds = []
+    auto = (getattr(args, "auto_file_cache", False)
+            and not getattr(args, "sync_dst_dir", None))
+    if auto:
+        for token in args.command:
+            if os.path.exists(token):
+                fset.add(token)
+                cmds.append("./" + os.path.basename(token))
+            else:
+                cmds.append(token)
+    else:
+        cmds = list(args.command)
+    for fname in getattr(args, "files", []):
+        if not os.path.exists(fname):
+            raise FileNotFoundError(f"--files {fname!r} does not exist")
+        fset.add(fname)
+    by_base = {}
+    for f in sorted(fset):
+        base = os.path.basename(f)
+        if base in by_base and by_base[base] != f:
+            raise ValueError(
+                f"cache files {by_base[base]!r} and {f!r} collide on "
+                f"basename {base!r} in the flat job cache dir")
+        by_base[base] = f
+    return fset, cmds
 
 
 def get_opts(argv=None) -> argparse.Namespace:

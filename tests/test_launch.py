@@ -149,6 +149,121 @@ def test_train_libsvm_end_to_end(tmp_path):
     assert "epoch 1 loss" in r.stderr
 
 
+def test_cache_file_set_rewrites_command(tmp_path, monkeypatch):
+    from dmlc_tpu.tracker.opts import get_opts
+
+    script = tmp_path / "sub" / "worker.py"
+    script.parent.mkdir()
+    script.write_text("print('hi')\n")
+    extra = tmp_path / "model.conf"
+    extra.write_text("k = v\n")
+    monkeypatch.chdir(tmp_path)
+    args = get_opts(["--cluster", "ssh", "--num-workers", "1",
+                     "--host-file", "/dev/null", "--files", "model.conf",
+                     "--", "python", "sub/worker.py", "--epochs", "3"])
+    from dmlc_tpu.tracker.opts import cache_file_set
+
+    fset, cmds = cache_file_set(args)
+    assert fset == {"sub/worker.py", "model.conf"}
+    assert cmds == ["python", "./worker.py", "--epochs", "3"]
+
+    args.auto_file_cache = False
+    fset, cmds = cache_file_set(args)
+    assert fset == {"model.conf"}
+    assert cmds == ["python", "sub/worker.py", "--epochs", "3"]
+
+
+def test_ssh_file_cache_end_to_end(tmp_path, monkeypatch):
+    """ssh-mode localhost job: a script submitted by RELATIVE path is
+    shipped to the job cache dir and runs there via the bootstrap (the
+    transport is faked — no sshd in this container — but the staging,
+    env contract, bootstrap exec, and rendezvous are all real)."""
+    import shutil
+
+    from dmlc_tpu.tracker.opts import get_opts
+
+    workdir = tmp_path / "submitdir"
+    workdir.mkdir()
+    out_file = tmp_path / "ran.txt"
+    (workdir / "worker.py").write_text(
+        "import os, sys\n"
+        "sys.path.insert(0, os.environ['DMLC_TPU_REPO'])\n"
+        "from dmlc_tpu.tracker.client import TrackerClient\n"
+        "c = TrackerClient().start()\n"
+        f"open({str(out_file)!r}, 'a').write(os.getcwd() + '\\n')\n"
+        "c.shutdown()\n"
+    )
+    hosts = tmp_path / "hosts.txt"
+    hosts.write_text("127.0.0.1\n")
+    jobname = f"t{os.getpid()}"
+    cache_dir = f"/tmp/dmlc-cache-{jobname}"
+
+    def fake_copy(host, paths, dest):
+        assert host == "127.0.0.1"
+        os.makedirs(dest, exist_ok=True)
+        for p in paths:
+            shutil.copy(p, dest)
+
+    def fake_ssh(cmd):
+        assert cmd[0] == "ssh"
+        return subprocess.call(["bash", "-c", cmd[-1]])
+
+    monkeypatch.setattr(launch, "_copy_to_host", fake_copy)
+    monkeypatch.setattr(launch, "_ssh_call", fake_ssh)
+    monkeypatch.setenv("DMLC_TPU_REPO", REPO)
+    monkeypatch.chdir(workdir)
+
+    args = get_opts(["--cluster", "ssh", "--num-workers", "2",
+                     "--host-ip", "127.0.0.1",
+                     "--host-file", str(hosts),
+                     "--jobname", jobname,
+                     "--env", f"DMLC_TPU_REPO={REPO}",
+                     "--", "python3", "worker.py"])
+    try:
+        tracker = launch.submit_ssh(args)
+        assert tracker is not None and not tracker.alive()
+        ran_from = out_file.read_text().strip().splitlines()
+        assert len(ran_from) == 2
+        assert all(os.path.realpath(d) == os.path.realpath(cache_dir)
+                   for d in ran_from), ran_from
+        assert os.path.exists(os.path.join(cache_dir, "worker.py"))
+        assert os.path.exists(os.path.join(cache_dir, "bootstrap.py"))
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
+def test_bootstrap_unpacks_archives_and_sets_paths(tmp_path):
+    import zipfile
+
+    cache = tmp_path / "cache"
+    cache.mkdir()
+    with zipfile.ZipFile(cache / "lib.zip", "w") as z:
+        z.writestr("shipped_lib/mod.py", "VALUE = 7\n")
+    probe = cache / "probe.py"
+    probe.write_text(
+        "import os, sys\n"
+        "sys.path.insert(0, '.')\n"
+        "from shipped_lib.mod import VALUE\n"
+        "assert VALUE == 7\n"
+        "assert os.getcwd() == os.environ['DMLC_JOB_CACHE_DIR']\n"
+        "assert os.environ['LD_LIBRARY_PATH'].endswith(os.getcwd())\n"
+        "print('bootstrap-ok')\n"
+    )
+    env = os.environ.copy()
+    env.update({
+        "DMLC_JOB_CLUSTER": "ssh",
+        "DMLC_JOB_CACHE_DIR": str(cache),
+        "DMLC_JOB_ARCHIVES": "lib.zip",
+    })
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "dmlc_tpu", "tracker", "bootstrap.py"),
+         "--", sys.executable, "probe.py"],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert "bootstrap-ok" in r.stdout
+
+
 def test_submit_dispatch_routes_all_clusters():
     from dmlc_tpu.tracker.submit import DISPATCH
 
