@@ -228,11 +228,22 @@ def bench_transformer():
     # remote compute finishes; a scalar VALUE fetch is the only reliable
     # synchronization point, so the clock brackets float(loss) fetches.
     float(loss)
-    t0 = time.perf_counter()
-    for _ in range(N_STEPS):
-        params, opt_state, loss = step(params, opt_state, ids, labels)
-    final_loss = float(loss)  # forces the whole chain
-    dt = time.perf_counter() - t0
+    import contextlib
+
+    from dmlc_tpu import metrics
+
+    trace_dir = os.environ.get("DMLC_BENCH_TRACE")
+    with contextlib.ExitStack() as stack:
+        if trace_dir:  # stack guarantees stop_trace even on a failing step
+            stack.enter_context(metrics.trace(trace_dir))
+            log(f"bench: capturing jax profiler trace to {trace_dir}")
+        t0 = time.perf_counter()
+        for _ in range(N_STEPS):
+            with metrics.annotate("dmlc_train_step"):
+                params, opt_state, loss = step(params, opt_state, ids,
+                                               labels)
+        final_loss = float(loss)  # forces the whole chain
+        dt = time.perf_counter() - t0
     assert jnp.isfinite(final_loss)
     tok_s = B * T * N_STEPS / dt
 

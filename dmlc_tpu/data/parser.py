@@ -84,12 +84,18 @@ class TextParserBase(Parser):
         raise NotImplementedError
 
     def parse_next(self) -> Optional[List[RowBlockContainer]]:
+        from .. import metrics
+
         chunk = self._source.next_chunk()
         if chunk is None:
             return None
         self._bytes_read += len(chunk)
         out = RowBlockContainer()
-        self.parse_chunk(chunk, out)
+        with metrics.timed("parser", "parse"):
+            self.parse_chunk(chunk, out)
+        metrics.inc("parser", "bytes", len(chunk))
+        metrics.inc("parser", "blocks")
+        metrics.inc("parser", "rows", out.size)
         return [out]
 
     def before_first(self) -> None:

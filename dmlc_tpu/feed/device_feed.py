@@ -155,16 +155,24 @@ class DeviceFeed:
 
         import jax
 
+        from .. import metrics
+
         self._t0 = time.perf_counter()
         try:
             while not self._stop.is_set():
-                host = self._assemble()
+                with metrics.timed("feed", "assemble"):
+                    host = self._assemble()
                 if host is None:
                     self._queue.put(None)
                     return
-                dev = {k: jax.device_put(v, self.sharding)
-                       for k, v in host.items()}
-                self._bytes += sum(v.nbytes for v in host.values())
+                with metrics.annotate("dmlc_feed_batch"), \
+                        metrics.timed("feed", "device_put"):
+                    dev = {k: jax.device_put(v, self.sharding)
+                           for k, v in host.items()}
+                nbytes = sum(v.nbytes for v in host.values())
+                self._bytes += nbytes
+                metrics.inc("feed", "batches")
+                metrics.inc("feed", "bytes_to_device", nbytes)
                 if self._bytes - self._last_log >= self._log_every:
                     dt = time.perf_counter() - self._t0
                     from ..logging import info
@@ -174,7 +182,9 @@ class DeviceFeed:
                         f"{self._bytes / 1e6 / dt:.2f} MB/sec"
                     )
                     self._last_log = self._bytes
-                self._queue.put(dev)
+                # a full queue means the consumer is the bottleneck
+                with metrics.timed("feed", "producer_stall"):
+                    self._queue.put(dev)
         except BaseException as e:  # surface on the consumer side
             self._queue.put(_ProducerError(e))
 
@@ -203,8 +213,12 @@ class DeviceFeed:
         self._stop.clear()
         self._thread = threading.Thread(target=self._produce, daemon=True)
         self._thread.start()
+        from .. import metrics
+
         while True:
-            item = self._queue.get()
+            # an empty queue means the producer is the bottleneck
+            with metrics.timed("feed", "consumer_stall"):
+                item = self._queue.get()
             if item is None:
                 return
             if isinstance(item, _ProducerError):

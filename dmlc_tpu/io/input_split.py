@@ -156,6 +156,7 @@ class InputSplitBase(InputSplit):
         self._overflow = b""
         self._pending: Optional[ChunkCursor] = None
         self._served: Optional[ChunkCursor] = None
+        self._rec_count = 0  # flushed to metrics in batches (hot loop)
         # free-list of full-size chunk buffers (the reference recycles
         # chunks through ThreadedIter, threadediter.h Recycle); buffers are
         # fixed-size and never resized, so stale Blob views see reused
@@ -517,16 +518,20 @@ class InputSplitBase(InputSplit):
     def _load_cursor(self) -> Optional[ChunkCursor]:
         """Chunk::Load with geometric growth (input_split_base.cc:241-258)."""
         if self._mmap_ok:
-            return self._load_cursor_mmap()
-        size = self._chunk_bytes
-        while True:
-            cur = self._read_cursor(size)
-            if cur is None:
-                return None
-            if cur is self._GROW:
+            cur = self._load_cursor_mmap()
+        else:
+            size = self._chunk_bytes
+            while True:
+                cur = self._read_cursor(size)
+                if cur is None or cur is not self._GROW:
+                    break
                 size *= 2
-                continue
-            return cur
+        if cur is not None:
+            from .. import metrics
+
+            metrics.inc("input_split", "chunks")
+            metrics.inc("input_split", "bytes", cur.end - cur.start)
+        return cur
 
     # back-compat bytes API (copies; the cursor path is the hot one)
     def read_chunk(self, max_size: int):
@@ -577,13 +582,24 @@ class InputSplitBase(InputSplit):
             if self._pending is not None:
                 rec = self.extract_next_record(self._pending)
                 if rec is not None:
+                    self._rec_count += 1
+                    if self._rec_count >= 4096:  # batched: hot loop
+                        self._flush_record_count()
                     return rec
                 self.recycle_chunk(self._pending)
                 self._pending = None
             cur = self._load_cursor()
             if cur is None:
+                self._flush_record_count()
                 return None
             self._pending = cur
+
+    def _flush_record_count(self) -> None:
+        if self._rec_count:
+            from .. import metrics
+
+            metrics.inc("input_split", "records", self._rec_count)
+            self._rec_count = 0
 
     def hint_chunk_size(self, chunk_size: int) -> None:
         # rounded up to the alignment unit: the reference stores chunks as
@@ -595,6 +611,7 @@ class InputSplitBase(InputSplit):
         return self._file_offset[-1]
 
     def close(self) -> None:
+        self._flush_record_count()
         if self._fs is not None:
             self._fs.close()
             self._fs = None
@@ -1024,10 +1041,14 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
             if self._pending is not None:
                 rec = self.extract_next_record(self._pending)
                 if rec is not None:
+                    self._rec_count += 1
+                    if self._rec_count >= 4096:
+                        self._flush_record_count()
                     return rec
                 self._pending = None
             data = self.next_batch_bytes(self._batch_size)
             if data is None:
+                self._flush_record_count()
                 return None
             self._pending = ChunkCursor(data)
 

@@ -37,15 +37,22 @@ class ThreadedInputSplit(InputSplit):
 
     # ---- InputSplit interface ------------------------------------------
     def next_record(self) -> Optional[memoryview]:
+        base = self._base
         while True:
             if self._chunk is not None:
-                rec = self._base.extract_next_record(self._chunk)
+                rec = base.extract_next_record(self._chunk)
                 if rec is not None:
+                    # the base's batched record counter; only this
+                    # (consumer) thread touches it on the threaded path
+                    base._rec_count += 1
+                    if base._rec_count >= 4096:
+                        base._flush_record_count()
                     return rec
                 self._iter.recycle(self._chunk)
                 self._chunk = None
             ok, cur = self._iter.next()
             if not ok:
+                base._flush_record_count()
                 return None
             self._chunk = cur
 

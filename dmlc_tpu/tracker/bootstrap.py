@@ -36,7 +36,10 @@ def unpack_archives(names, workdir):
                 z.extractall(workdir)
         elif ".tar" in name or name.endswith(".tgz"):
             with tarfile.open(path) as t:
-                t.extractall(workdir)
+                try:
+                    t.extractall(workdir, filter="data")  # no path traversal
+                except TypeError:  # Python < 3.12: no filter= kwarg
+                    t.extractall(workdir)
 
 
 def main(argv):

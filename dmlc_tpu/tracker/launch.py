@@ -268,28 +268,54 @@ def _stage_cache(args, hosts: List[str]):
     cache dir on every host; the remote command becomes
     ``python3 ./bootstrap.py <rewritten command>`` running from there.
 
-    Returns (remote_command, remote_dir, extra_env); a no-op (original
-    command, --sync-dst-dir, {}) when nothing needs shipping.
+    Returns (remote_command, remote_dir, extra_env, staged_hosts); a
+    no-op (original command, --sync-dst-dir, {}, hosts) when nothing
+    needs shipping.  Hosts where staging fails are excluded (with a
+    warning) rather than aborting — host failure is the GangScheduler
+    blacklist's job; only all-hosts-failed raises.
     """
     from .opts import cache_file_set
 
     fset, rewritten = cache_file_set(args)
-    archives = [a for a in getattr(args, "archives", [])
-                if os.path.exists(a)]
+    archives = list(getattr(args, "archives", []))
+    for a in archives:
+        if not os.path.exists(a):
+            raise FileNotFoundError(f"--archives {a!r} does not exist")
     if not fset and not archives:
-        return list(args.command), args.sync_dst_dir, {}
+        return list(args.command), args.sync_dst_dir, {}, hosts
     dest = args.sync_dst_dir or "/tmp/dmlc-cache-{}".format(
         args.jobname or os.getpid())
     bootstrap = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "bootstrap.py")
     paths = sorted(fset) + archives + [bootstrap]
+    # the cache dir is flat: ANY staged basename collision (files,
+    # archives, or the launcher's own bootstrap.py) is a silent clobber
+    by_base: Dict[str, str] = {}
+    for p in paths:
+        base = os.path.basename(p)
+        if base in by_base and by_base[base] != p:
+            raise ValueError(
+                f"staged files {by_base[base]!r} and {p!r} collide on "
+                f"basename {base!r} in the flat job cache dir")
+        by_base[base] = p
+    # a dead host must not abort the submission — that is exactly what
+    # GangScheduler's blacklist exists for; stage where we can and hand
+    # the scheduler only the staged hosts
+    ok_hosts = []
     for h in hosts:
-        _copy_to_host(h, paths, dest)
+        try:
+            _copy_to_host(h, paths, dest)
+            ok_hosts.append(h)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("staging to %s failed, excluding host: %s", h, e)
+    if not ok_hosts:
+        raise RuntimeError(f"file-cache staging failed on every host: {hosts}")
     extra_env = {"DMLC_JOB_CACHE_DIR": dest}
     if archives:
         extra_env["DMLC_JOB_ARCHIVES"] = ":".join(
             os.path.basename(a) for a in archives)
-    return ["python3", "./bootstrap.py", "--"] + rewritten, dest, extra_env
+    return (["python3", "./bootstrap.py", "--"] + rewritten, dest,
+            extra_env, ok_hosts)
 
 
 def submit_ssh(args):
@@ -298,7 +324,7 @@ def submit_ssh(args):
     if args.sync_dst_dir:
         for h in hosts:  # whole-workdir sync (reference ssh.py:13-21)
             _copy_to_host(h, [os.getcwd() + "/"], args.sync_dst_dir)
-    command, remote_dir, cache_env = _stage_cache(args, hosts)
+    command, remote_dir, cache_env, hosts = _stage_cache(args, hosts)
     sched = GangScheduler(hosts, _make_ssh_runner(command, remote_dir),
                           max_attempts=args.max_attempts)
     return _submit_gang(args, sched, "ssh", cache_env)
@@ -312,7 +338,7 @@ def submit_tpu_vm(args):
     placed round-robin with attempt counters and failing-host blacklist.
     """
     hosts = read_host_file(args.host_file)
-    command, remote_dir, cache_env = _stage_cache(args, hosts)
+    command, remote_dir, cache_env, hosts = _stage_cache(args, hosts)
     sched = GangScheduler(hosts, _make_ssh_runner(command, remote_dir),
                           max_attempts=args.max_attempts)
     return _submit_gang(args, sched, "tpu-vm", cache_env)

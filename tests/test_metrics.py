@@ -1,0 +1,86 @@
+"""Per-stage metrics counters (SURVEY §5 tracing/profiling rebuild)."""
+
+import numpy as np
+import pytest
+
+from dmlc_tpu import metrics
+from dmlc_tpu.parallel import build_mesh
+
+
+@pytest.fixture(autouse=True)
+def fresh_metrics():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def test_counters_and_timers():
+    metrics.inc("stage", "things", 3)
+    metrics.inc("stage", "things", 2)
+    with metrics.timed("stage", "work"):
+        pass
+    snap = metrics.snapshot()
+    assert snap["stage"]["things"] == 5
+    assert snap["stage"]["work_secs"] >= 0
+    # snapshot is a copy: mutating it does not affect live counters
+    snap["stage"]["things"] = 0
+    assert metrics.snapshot()["stage"]["things"] == 5
+    metrics.reset()
+    assert metrics.snapshot() == {}
+
+
+def test_input_split_and_parser_counters(tmp_path):
+    from dmlc_tpu.data import create_row_iter
+    from dmlc_tpu.io import input_split
+    from dmlc_tpu.io.recordio import RecordIOWriter
+    from dmlc_tpu.io.stream import Stream
+
+    path = str(tmp_path / "m.rec")
+    with Stream.create(path, "w") as s:
+        w = RecordIOWriter(s)
+        for i in range(300):
+            w.write_record(bytes([i % 251]) * 32)
+
+    split = input_split.create(path, 0, 1, "recordio")
+    n = 0
+    while split.next_record() is not None:
+        n += 1
+    split.close()
+    snap = metrics.snapshot()["input_split"]
+    assert snap["records"] == n == 300
+    assert snap["chunks"] >= 1
+    assert snap["bytes"] > 300 * 32  # payload + headers
+
+    # parser counters on the libsvm path
+    lib = tmp_path / "m.libsvm"
+    lib.write_text("".join(f"{i % 2} 0:{i}.0\n" for i in range(64)))
+    it = create_row_iter(str(lib), 0, 1, "libsvm")
+    rows = sum(blk.size for blk in it)
+    psnap = metrics.snapshot()["parser"]
+    assert psnap["rows"] == rows == 64
+    assert psnap["blocks"] >= 1
+    assert psnap["bytes"] > 0
+    assert "parse_secs" in psnap
+
+
+def test_feed_counters(tmp_path):
+    from dmlc_tpu.feed import libsvm_feed
+
+    lib = tmp_path / "f.libsvm"
+    lib.write_text("".join(f"{i % 2} 0:{i}.0 3:1.5\n" for i in range(64)))
+    mesh = build_mesh(1, dp=1, sp=1, tp=1, pp=1, ep=1)
+    feed = libsvm_feed(str(lib), mesh, batch_size=4, max_nnz=4)
+    batches = list(feed)
+    snap = metrics.snapshot()["feed"]
+    assert snap["batches"] == len(batches) > 0
+    assert snap["bytes_to_device"] > 0
+    assert "device_put_secs" in snap and "consumer_stall_secs" in snap
+
+
+def test_annotate_is_usable_under_jit():
+    import jax
+    import jax.numpy as jnp
+
+    with metrics.annotate("test_span"):
+        x = jax.jit(lambda a: a * 2)(jnp.ones(4))
+    np.testing.assert_allclose(np.asarray(x), 2.0)
