@@ -168,7 +168,7 @@ def _attention(x, p, positions, axes: ShardAxes):
         from ..ops import flash_attention as _flash
 
         if (jax.default_backend() == "tpu"
-                and _flash.supports(q.shape, k.shape, 128, 128)):
+                and _flash.supports(q.shape, k.shape)):
             # single-chip MXU hot path: O(T) memory instead of the
             # oracle's materialized [B,H,T,T] score matrix
             o = _flash.flash_attention(q, k, v, causal=True)

@@ -3,12 +3,13 @@
 This is the MXU hot loop of both the single-chip flagship model and ring
 attention (parallel/ring_attention.py).  The forward computes one Q block
 against one KV shard with an online softmax, returning the partial
-(pv, m, l) triple the ring combiner folds across ranks.  Q/K/V tiles
-live in VMEM, the KV loop is a fori_loop with f32 carries, and the
-global position offsets are scalar-prefetch arguments so the SAME
-compiled kernel serves every ring step (offsets are traced values
-there).  Causal steps skip fully-masked KV blocks via a dynamic loop
-bound, halving attention compute at large T.
+(pv, m, l) triple the ring combiner folds across ranks.  The KV/Q walk
+lives in the pallas GRID (see the kernel structure note below), with
+f32 accumulators in the revisited output blocks; the global position
+offsets are scalar-prefetch arguments so the SAME compiled kernel
+serves every ring step (offsets are traced values there).  Causal
+steps skip fully-masked KV blocks via a predicated no-op visit,
+halving attention compute at large T.
 
 The standalone `flash_attention` entry is fully differentiable with
 FlashAttention-style backward kernels (dkv + dq passes over saved
@@ -37,39 +38,50 @@ _NEG_BIG = -1e30
 _POS_BIG = 1e30
 
 
-def _causal_hi(qoff, kvoff, qi, block_q, block_k, nk):
-    """Number of KV blocks a causal Q block [qi] must visit (traced)."""
-    last_q = qoff + (qi + 1) * block_q - 1          # last global q position
-    need = (last_q - kvoff) // block_k + 1
-    return jnp.clip(need, 0, nk)
+# Kernel structure note (performance-critical): the KV/Q walk lives in
+# the GRID, not in an in-kernel fori_loop.  A loop whose trip count
+# depends on program_id lowers to an unpipelined while loop in Mosaic —
+# measured 10-20× slower than the pipelined grid at flagship shapes —
+# and keeping whole sequences resident in VMEM overflows it past
+# T≈4k.  With the step in the grid, accumulators live in the revisited
+# output blocks (init on the first step, finalize implicitly on the
+# last), per-step VMEM is O(block), and causally-skipped blocks cost
+# one predicated no-op visit (pl.when) instead of compute.
 
 
 def _kernel(qoff_ref, kvoff_ref, kvend_ref, q_ref, k_ref, v_ref,
-            pv_ref, m_ref, l_ref, *, block_k: int, causal: bool,
-            kv_padded: bool, scale: float):
+            pv_ref, m_ref, l_ref, *, block_q: int, block_k: int,
+            causal: bool, kv_padded: bool, scale: float):
     from jax.experimental import pallas as pl
 
-    q = q_ref[0]                      # [block_q, D]
-    block_q, d = q.shape
-    tk = k_ref.shape[1]
-    nk = tk // block_k
     qi = pl.program_id(1)
-    q_pos = qoff_ref[0] + qi * block_q + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
+    j = pl.program_id(2)
 
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
-    m0 = jnp.full((block_q,), _NEG_BIG, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        pv_ref[0] = jnp.zeros_like(pv_ref[0])
+        m_ref[0] = jnp.full_like(m_ref[0], _NEG_BIG)
+        l_ref[0] = jnp.zeros_like(l_ref[0])
 
-    def body(j, carry):
-        acc, m, l = carry
-        kb = k_ref[0, pl.ds(j * block_k, block_k)]      # [block_k, D]
-        vb = v_ref[0, pl.ds(j * block_k, block_k)]
+    if causal:
+        # visit only KV blocks intersecting the visible (past) region
+        last_q = qoff_ref[0] + (qi + 1) * block_q - 1
+        visible = kvoff_ref[0] + j * block_k <= last_q
+    else:
+        visible = True
+
+    @pl.when(visible)
+    def _step():
+        q = q_ref[0]                      # [block_q, D]
+        kb = k_ref[0]                     # [block_k, D]
+        vb = v_ref[0]
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [block_q, block_k]
         keep = None
         if causal or kv_padded:
+            q_pos = qoff_ref[0] + qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
             k_pos = kvoff_ref[0] + j * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
         if causal:
@@ -80,39 +92,30 @@ def _kernel(qoff_ref, kvoff_ref, kvend_ref, q_ref, k_ref, v_ref,
             keep = in_range if keep is None else keep & in_range
         if keep is not None:
             s = jnp.where(keep, s, _NEG_BIG)
+        m_old = m_ref[0][:, 0]
+        l_old = l_ref[0][:, 0]
         bm = jnp.max(s, axis=1)
-        m_new = jnp.maximum(m, bm)
+        m_new = jnp.maximum(m_old, bm)
         p = jnp.exp(s - m_new[:, None])
         if keep is not None:
             p = jnp.where(keep, p, 0.0)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=1)
+        corr = jnp.exp(m_old - m_new)
+        l_new = l_old * corr + jnp.sum(p, axis=1)
         pv = jax.lax.dot_general(
             p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        acc_new = acc * corr[:, None] + pv
-        return acc_new, m_new, l_new
-
-    if causal:
-        # skip KV blocks that are entirely in the masked future
-        nk_hi = _causal_hi(qoff_ref[0], kvoff_ref[0], qi, block_q,
-                           block_k, nk)
-    else:
-        nk_hi = nk
-    acc, m, l = lax.fori_loop(0, nk_hi, body, (acc0, m0, l0))
-    pv_ref[0] = acc
-    # m/l are per-row scalars; Mosaic requires the minor (lane) block dim
-    # to divide 128 or equal the array dim, so they are stored broadcast
-    # over an 8-lane minor axis (callers slice lane 0)
-    m_ref[0] = jnp.broadcast_to(m[:, None], (block_q, 8))
-    l_ref[0] = jnp.broadcast_to(l[:, None], (block_q, 8))
+        pv_ref[0] = pv_ref[0] * corr[:, None] + pv
+        # m/l are per-row scalars stored broadcast over an 8-lane minor
+        # axis (Mosaic lane tiling); callers slice lane 0
+        m_ref[0] = jnp.broadcast_to(m_new[:, None], (block_q, 8))
+        l_ref[0] = jnp.broadcast_to(l_new[:, None], (block_q, 8))
 
 
-def supports(q_shape: Tuple[int, ...], k_shape: Tuple[int, ...],
-             block_q: int, block_k: int) -> bool:
+def supports(q_shape: Tuple[int, ...], k_shape: Tuple[int, ...]) -> bool:
     """Kernel applicability gate: lane dim multiple of 128, seq dims big
     enough to tile.  Unaligned seq lengths are handled by the kernel's
-    pad-and-mask path, so they no longer disqualify."""
+    pad-and-mask path and block sizes are clamped internally, so neither
+    disqualifies."""
     _, tq, _, d = q_shape
     tk = k_shape[1]
     return d % 128 == 0 and tq >= 8 and tk >= 8
@@ -174,7 +177,7 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 
 def block_attend_flash(q, k, v, *, scale: float, causal: bool,
                        q_offset, kv_offset,
-                       block_q: int = 128, block_k: int = 128,
+                       block_q: int = 512, block_k: int = 512,
                        interpret: bool = False):
     """Partial attention of q against one KV shard (the ring step).
 
@@ -225,21 +228,21 @@ def _flash_forward(static, q, k, v, qoff, kvoff):
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(bh, tq_p // block_q),
+        grid=(bh, tq_p // block_q, tk_p // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bi, qi, *_: (bi, qi, 0)),
-            pl.BlockSpec((1, tk_p, d), lambda bi, qi, *_: (bi, 0, 0)),
-            pl.BlockSpec((1, tk_p, d), lambda bi, qi, *_: (bi, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bi, qi, kj, *_: (bi, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bi, qi, kj, *_: (bi, kj, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bi, qi, kj, *_: (bi, kj, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bi, qi, *_: (bi, qi, 0)),
-            pl.BlockSpec((1, block_q, 8), lambda bi, qi, *_: (bi, qi, 0)),
-            pl.BlockSpec((1, block_q, 8), lambda bi, qi, *_: (bi, qi, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bi, qi, kj, *_: (bi, qi, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda bi, qi, kj, *_: (bi, qi, 0)),
+            pl.BlockSpec((1, block_q, 8), lambda bi, qi, kj, *_: (bi, qi, 0)),
         ],
     )
     pv, m, l = pl.pallas_call(
-        functools.partial(_kernel, block_k=block_k, causal=causal,
-                          kv_padded=kv_padded, scale=scale),
+        functools.partial(_kernel, block_q=block_q, block_k=block_k,
+                          causal=causal, kv_padded=kv_padded, scale=scale),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((bh, tq_p, d), jnp.float32),
@@ -267,27 +270,31 @@ def _flash_forward(static, q, k, v, qoff, kvoff):
 
 def _bwd_dkv_kernel(kvend_ref, q_ref, do_ref, k_ref, v_ref, lse_ref,
                     delta_ref, dk_ref, dv_ref, *, block_q: int,
-                    causal: bool, kv_padded: bool, scale: float):
+                    block_k: int, causal: bool, kv_padded: bool,
+                    scale: float):
     from jax.experimental import pallas as pl
 
-    kb = k_ref[0]                     # [block_k, D]
-    vb = v_ref[0]
-    block_k, d = kb.shape
-    tq = q_ref.shape[1]
-    nq = tq // block_q
-    j = pl.program_id(1)
-    k_pos = j * block_k + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
+    j = pl.program_id(1)   # KV block (the accumulator's home)
+    qi = pl.program_id(2)  # Q step (innermost: pipelined)
 
-    dk0 = jnp.zeros((block_k, d), jnp.float32)
-    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    @pl.when(qi == 0)
+    def _init():
+        dk_ref[0] = jnp.zeros_like(dk_ref[0])
+        dv_ref[0] = jnp.zeros_like(dv_ref[0])
 
-    def body(qi, carry):
-        dk, dv = carry
-        qb = q_ref[0, pl.ds(qi * block_q, block_q)]       # [block_q, D]
-        dob = do_ref[0, pl.ds(qi * block_q, block_q)]
-        lse = lse_ref[0, pl.ds(qi * block_q, block_q), 0]  # [block_q]
-        dlt = delta_ref[0, pl.ds(qi * block_q, block_q), 0]
+    if causal:
+        visible = (qi + 1) * block_q - 1 >= j * block_k
+    else:
+        visible = True
+
+    @pl.when(visible)
+    def _step():
+        kb = k_ref[0]                     # [block_k, D]
+        vb = v_ref[0]
+        qb = q_ref[0]                     # [block_q, D]
+        dob = do_ref[0]
+        lse = lse_ref[0][:, 0]            # [block_q]
+        dlt = delta_ref[0][:, 0]
         s = jax.lax.dot_general(
             qb, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale    # [bq, bk]
@@ -296,55 +303,53 @@ def _bwd_dkv_kernel(kvend_ref, q_ref, do_ref, k_ref, v_ref, lse_ref,
         if causal:
             q_pos = qi * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
             keep = q_pos >= k_pos
         if kv_padded:
-            in_range = k_pos < kvend_ref[0]
+            kp = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            in_range = kp < kvend_ref[0]
             keep = in_range if keep is None else keep & in_range
         if keep is not None:
             p = jnp.where(keep, p, 0.0)
-        dv_new = dv + jax.lax.dot_general(
+        dv_ref[0] += jax.lax.dot_general(
             p, dob.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bk, D]
         dp = jax.lax.dot_general(
             dob, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bq, bk]
         ds = p * (dp - dlt[:, None])
-        dk_new = dk + scale * jax.lax.dot_general(
+        dk_ref[0] += scale * jax.lax.dot_general(
             ds, qb.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bk, D]
-        return dk_new, dv_new
-
-    if causal:
-        # Q blocks strictly before this KV block are fully masked
-        qi_lo = jnp.clip((j * block_k) // block_q, 0, nq)
-    else:
-        qi_lo = 0
-    dk, dv = lax.fori_loop(qi_lo, nq, body, (dk0, dv0))
-    dk_ref[0] = dk
-    dv_ref[0] = dv
 
 
 def _bwd_dq_kernel(kvend_ref, q_ref, do_ref, k_ref, v_ref, lse_ref,
-                   delta_ref, dq_ref, *, block_k: int, causal: bool,
-                   kv_padded: bool, scale: float):
+                   delta_ref, dq_ref, *, block_q: int, block_k: int,
+                   causal: bool, kv_padded: bool, scale: float):
     from jax.experimental import pallas as pl
 
-    qb = q_ref[0]                      # [block_q, D]
-    block_q, d = qb.shape
-    tk = k_ref.shape[1]
-    nk = tk // block_k
-    qi = pl.program_id(1)
-    lse = lse_ref[0, :, 0]             # [block_q]
-    dlt = delta_ref[0, :, 0]
-    dob = do_ref[0]
-    q_pos = qi * block_q + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
+    qi = pl.program_id(1)  # Q block (the accumulator's home)
+    j = pl.program_id(2)   # KV step (innermost: pipelined)
 
-    dq0 = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        dq_ref[0] = jnp.zeros_like(dq_ref[0])
 
-    def body(j, dq):
-        kb = k_ref[0, pl.ds(j * block_k, block_k)]
-        vb = v_ref[0, pl.ds(j * block_k, block_k)]
+    if causal:
+        visible = (qi + 1) * block_q - 1 >= j * block_k
+    else:
+        visible = True
+
+    @pl.when(visible)
+    def _step():
+        qb = q_ref[0]                      # [block_q, D]
+        dob = do_ref[0]
+        kb = k_ref[0]
+        vb = v_ref[0]
+        lse = lse_ref[0][:, 0]             # [block_q]
+        dlt = delta_ref[0][:, 0]
         s = jax.lax.dot_general(
             qb, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -354,6 +359,8 @@ def _bwd_dq_kernel(kvend_ref, q_ref, do_ref, k_ref, v_ref, lse_ref,
             k_pos = j * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
         if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
             keep = q_pos >= k_pos
         if kv_padded:
             in_range = k_pos < kvend_ref[0]
@@ -364,16 +371,9 @@ def _bwd_dq_kernel(kvend_ref, q_ref, do_ref, k_ref, v_ref, lse_ref,
             dob, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - dlt[:, None])
-        return dq + scale * jax.lax.dot_general(
+        dq_ref[0] += scale * jax.lax.dot_general(
             ds, kb.astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-
-    if causal:
-        nk_hi = _causal_hi(0, 0, qi, block_q, block_k, nk)
-    else:
-        nk_hi = nk
-    dq = lax.fori_loop(0, nk_hi, body, dq0)
-    dq_ref[0] = dq
 
 
 def _flash_backward(static, q, k, v, o, lse, do):
@@ -414,21 +414,19 @@ def _flash_backward(static, q, k, v, o, lse, do):
     delta8 = jnp.broadcast_to(delta_p[:, :, None], (bh, tq_p, 8))
     kvend = jnp.asarray([tk], jnp.int32)
 
-    full_q = pl.BlockSpec((1, tq_p, d), lambda bi, i, *_: (bi, 0, 0))
-    full_k = pl.BlockSpec((1, tk_p, d), lambda bi, i, *_: (bi, 0, 0))
-    full_s = pl.BlockSpec((1, tq_p, 8), lambda bi, i, *_: (bi, 0, 0))
-    blk_q = pl.BlockSpec((1, block_q, d), lambda bi, i, *_: (bi, i, 0))
-    blk_k = pl.BlockSpec((1, block_k, d), lambda bi, i, *_: (bi, i, 0))
-    blk_s = pl.BlockSpec((1, block_q, 8), lambda bi, i, *_: (bi, i, 0))
-
+    # dkv grid (bh, kv, q): accumulators live in the kv-indexed output
+    # blocks, revisited across the innermost q steps
+    q_of_q = pl.BlockSpec((1, block_q, d), lambda bi, kj, qi, *_: (bi, qi, 0))
+    k_of_kv = pl.BlockSpec((1, block_k, d), lambda bi, kj, qi, *_: (bi, kj, 0))
+    s_of_q = pl.BlockSpec((1, block_q, 8), lambda bi, kj, qi, *_: (bi, qi, 0))
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, block_q=block_q, causal=causal,
-                          kv_padded=kv_padded, scale=scale),
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, block_k=block_k,
+                          causal=causal, kv_padded=kv_padded, scale=scale),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(bh, tk_p // block_k),
-            in_specs=[full_q, full_q, blk_k, blk_k, full_s, full_s],
-            out_specs=[blk_k, blk_k],
+            grid=(bh, tk_p // block_k, tq_p // block_q),
+            in_specs=[q_of_q, q_of_q, k_of_kv, k_of_kv, s_of_q, s_of_q],
+            out_specs=[k_of_kv, k_of_kv],
         ),
         out_shape=[
             jax.ShapeDtypeStruct((bh, tk_p, d), jnp.float32),
@@ -437,14 +435,19 @@ def _flash_backward(static, q, k, v, o, lse, do):
         interpret=interpret,
     )(kvend, qt, dot, kt, vt, lse8, delta8)
 
+    # dq grid (bh, q, kv): accumulator in the q-indexed output block
+    q_of_q2 = pl.BlockSpec((1, block_q, d), lambda bi, qi, kj, *_: (bi, qi, 0))
+    k_of_kv2 = pl.BlockSpec((1, block_k, d), lambda bi, qi, kj, *_: (bi, kj, 0))
+    s_of_q2 = pl.BlockSpec((1, block_q, 8), lambda bi, qi, kj, *_: (bi, qi, 0))
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, block_k=block_k, causal=causal,
-                          kv_padded=kv_padded, scale=scale),
+        functools.partial(_bwd_dq_kernel, block_q=block_q, block_k=block_k,
+                          causal=causal, kv_padded=kv_padded, scale=scale),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(bh, tq_p // block_q),
-            in_specs=[blk_q, blk_q, full_k, full_k, blk_s, blk_s],
-            out_specs=blk_q,
+            grid=(bh, tq_p // block_q, tk_p // block_k),
+            in_specs=[q_of_q2, q_of_q2, k_of_kv2, k_of_kv2, s_of_q2,
+                      s_of_q2],
+            out_specs=q_of_q2,
         ),
         out_shape=jax.ShapeDtypeStruct((bh, tq_p, d), jnp.float32),
         interpret=interpret,
@@ -489,7 +492,7 @@ _flash_attn.defvjp(_flash_attn_fwd, _flash_attn_bwd)
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: Optional[float] = None,
-                    block_q: int = 128, block_k: int = 128,
+                    block_q: int = 512, block_k: int = 512,
                     interpret: bool = False):
     """Standalone exact attention via the flash kernels (single device).
 

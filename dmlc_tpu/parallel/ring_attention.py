@@ -69,7 +69,7 @@ def ring_attention(
     if impl == "auto":
         use_flash = (
             jax.default_backend() == "tpu"
-            and _flash.supports(q.shape, k.shape, 128, 128)
+            and _flash.supports(q.shape, k.shape)
         )
     elif impl == "flash":
         use_flash = True

@@ -322,8 +322,17 @@ def submit_ssh(args):
     """ssh backend (reference ssh.py:37-86), via GangScheduler for retry."""
     hosts = read_host_file(args.host_file)
     if args.sync_dst_dir:
-        for h in hosts:  # whole-workdir sync (reference ssh.py:13-21)
-            _copy_to_host(h, [os.getcwd() + "/"], args.sync_dst_dir)
+        synced = []  # whole-workdir sync (reference ssh.py:13-21); a dead
+        for h in hosts:  # host is excluded, not fatal (blacklist's job)
+            try:
+                _copy_to_host(h, [os.getcwd() + "/"], args.sync_dst_dir)
+                synced.append(h)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("workdir sync to %s failed, excluding: %s",
+                               h, e)
+        if not synced:
+            raise RuntimeError(f"workdir sync failed on every host: {hosts}")
+        hosts = synced
     command, remote_dir, cache_env, hosts = _stage_cache(args, hosts)
     sched = GangScheduler(hosts, _make_ssh_runner(command, remote_dir),
                           max_attempts=args.max_attempts)

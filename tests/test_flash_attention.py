@@ -162,11 +162,11 @@ def test_block_attend_flash_gradients_with_offsets():
 
 
 def test_supports_gate():
-    assert supports((1, 64, 2, 128), (1, 64, 2, 128), 128, 128)
-    assert not supports((1, 64, 2, 96), (1, 64, 2, 96), 128, 128)  # lane
+    assert supports((1, 64, 2, 128), (1, 64, 2, 128))
+    assert not supports((1, 64, 2, 96), (1, 64, 2, 96))  # lane
     # unaligned seq lengths are padded-and-masked in-kernel, so supported
-    assert supports((1, 200, 2, 128), (1, 200, 2, 128), 128, 128)
-    assert not supports((1, 4, 2, 128), (1, 4, 2, 128), 128, 128)  # tiny
+    assert supports((1, 200, 2, 128), (1, 200, 2, 128))
+    assert not supports((1, 4, 2, 128), (1, 4, 2, 128))  # tiny
 
 
 def test_flash_under_jit_with_traced_offsets():
