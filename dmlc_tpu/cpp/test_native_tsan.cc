@@ -1,0 +1,87 @@
+// ThreadSanitizer stress driver for the native parse fanout
+// (dmlc_native.cc parse_sparse_mt / dmlc_parse_csv std::thread workers).
+//
+// The reference had no sanitizer coverage at all (SURVEY.md §5 race
+// detection); this driver runs the multi-threaded parsers concurrently
+// from several caller threads — the exact shape of the Python-side use,
+// where ctypes releases the GIL so parses genuinely overlap — under
+// -fsanitize=thread.  Built and run by scripts/ci.sh stage 4.
+//
+//   g++ -O1 -g -std=c++17 -fsanitize=thread dmlc_native.cc \
+//       test_native_tsan.cc -o test_native_tsan -pthread
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+long dmlc_parse_libsvm(const char* buf, long n, float* labels,
+                       float* weights, uint64_t* offsets, uint32_t* index,
+                       float* value, long max_rows, long max_nnz,
+                       int nthread, long* n_rows, long* n_nnz,
+                       int* has_weight);
+long dmlc_parse_csv(const char* buf, long n, char delim, int nthread,
+                    float* out, long max_vals, long* n_rows, long* n_cols);
+}
+
+static std::string make_libsvm(int rows) {
+  std::string s;
+  char line[256];
+  for (int i = 0; i < rows; ++i) {
+    snprintf(line, sizeof line, "%d 0:%d.5 3:%d 7:0.25\n", i % 2, i, i * 2);
+    s += line;
+  }
+  return s;
+}
+
+static std::string make_csv(int rows) {
+  std::string s;
+  char line[128];
+  for (int i = 0; i < rows; ++i) {
+    snprintf(line, sizeof line, "%d,%d.5,%d\n", i, i, i * 3);
+    s += line;
+  }
+  return s;
+}
+
+int main() {
+  const std::string svm = make_libsvm(5000);
+  const std::string csv = make_csv(5000);
+  std::vector<std::thread> callers;
+  std::vector<int> fails(8, 0);
+  for (int c = 0; c < 8; ++c) {
+    callers.emplace_back([&, c]() {
+      for (int rep = 0; rep < 5; ++rep) {
+        // libsvm with an internal 4-thread fanout
+        std::vector<float> labels(6000), weights(6000), value(30000);
+        std::vector<uint64_t> offsets(6001);
+        std::vector<uint32_t> index(30000);
+        long n_rows = 0, n_nnz = 0;
+        int has_w = 0;
+        long rc = dmlc_parse_libsvm(
+            svm.data(), (long)svm.size(), labels.data(), weights.data(),
+            offsets.data(), index.data(), value.data(), 6000, 30000, 4,
+            &n_rows, &n_nnz, &has_w);
+        if (rc != 0 || n_rows != 5000 || n_nnz != 15000) fails[c] = 1;
+        // csv with an internal 4-thread fanout
+        std::vector<float> out(20000);
+        long cr = 0, cc = 0;
+        rc = dmlc_parse_csv(csv.data(), (long)csv.size(), ',', 4,
+                            out.data(), 20000, &cr, &cc);
+        if (rc != 0 || cr != 5000 || cc != 3) fails[c] = 1;
+        if (out[3] != 1.0f || out[4] != 1.5f) fails[c] = 1;
+      }
+    });
+  }
+  for (auto& t : callers) t.join();
+  for (int f : fails)
+    if (f) {
+      fprintf(stderr, "FAIL: parse mismatch under concurrency\n");
+      return 1;
+    }
+  printf("tsan stress OK\n");
+  return 0;
+}

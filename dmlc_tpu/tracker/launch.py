@@ -255,6 +255,23 @@ def _copy_to_host(host: str, paths: Sequence[str], dest: str) -> None:
          *paths, f"{hostname}:{dest}/"])
 
 
+def _copy_to_hosts_excluding(hosts: List[str], paths: Sequence[str],
+                             dest: str, what: str) -> List[str]:
+    """Ship ``paths`` to every host; a failing host is EXCLUDED with a
+    warning rather than fatal (host failure is the GangScheduler
+    blacklist's job).  Raises only when every host fails."""
+    ok = []
+    for h in hosts:
+        try:
+            _copy_to_host(h, paths, dest)
+            ok.append(h)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("%s to %s failed, excluding host: %s", what, h, e)
+    if not ok:
+        raise RuntimeError(f"{what} failed on every host: {hosts}")
+    return ok
+
+
 def _make_ssh_runner(command: Sequence[str], sync_dst_dir=None):
     def runner(host, role, task_id, env):
         cmd = build_ssh_cmd(host, command, env, sync_dst_dir)
@@ -298,18 +315,8 @@ def _stage_cache(args, hosts: List[str]):
                 f"staged files {by_base[base]!r} and {p!r} collide on "
                 f"basename {base!r} in the flat job cache dir")
         by_base[base] = p
-    # a dead host must not abort the submission — that is exactly what
-    # GangScheduler's blacklist exists for; stage where we can and hand
-    # the scheduler only the staged hosts
-    ok_hosts = []
-    for h in hosts:
-        try:
-            _copy_to_host(h, paths, dest)
-            ok_hosts.append(h)
-        except Exception as e:  # noqa: BLE001
-            logger.warning("staging to %s failed, excluding host: %s", h, e)
-    if not ok_hosts:
-        raise RuntimeError(f"file-cache staging failed on every host: {hosts}")
+    ok_hosts = _copy_to_hosts_excluding(hosts, paths, dest,
+                                        "file-cache staging")
     extra_env = {"DMLC_JOB_CACHE_DIR": dest}
     if archives:
         extra_env["DMLC_JOB_ARCHIVES"] = ":".join(
@@ -321,18 +328,9 @@ def _stage_cache(args, hosts: List[str]):
 def submit_ssh(args):
     """ssh backend (reference ssh.py:37-86), via GangScheduler for retry."""
     hosts = read_host_file(args.host_file)
-    if args.sync_dst_dir:
-        synced = []  # whole-workdir sync (reference ssh.py:13-21); a dead
-        for h in hosts:  # host is excluded, not fatal (blacklist's job)
-            try:
-                _copy_to_host(h, [os.getcwd() + "/"], args.sync_dst_dir)
-                synced.append(h)
-            except Exception as e:  # noqa: BLE001
-                logger.warning("workdir sync to %s failed, excluding: %s",
-                               h, e)
-        if not synced:
-            raise RuntimeError(f"workdir sync failed on every host: {hosts}")
-        hosts = synced
+    if args.sync_dst_dir:  # whole-workdir sync (reference ssh.py:13-21)
+        hosts = _copy_to_hosts_excluding(
+            hosts, [os.getcwd() + "/"], args.sync_dst_dir, "workdir sync")
     command, remote_dir, cache_env, hosts = _stage_cache(args, hosts)
     sched = GangScheduler(hosts, _make_ssh_runner(command, remote_dir),
                           max_attempts=args.max_attempts)

@@ -7,6 +7,9 @@
 #   2. full pytest with the native library (when it built)
 #   3. data-layer/recordio/input-split tests again with
 #      DMLC_TPU_DISABLE_NATIVE=1, proving the fallback paths
+#   4. ThreadSanitizer stress on the native parse fanout (skipped only
+#      when the tsan runtime itself is absent; a compile failure of our
+#      sources is a hard CI failure)
 #
 # Usage: scripts/ci.sh [pytest-args...]
 set -u
@@ -41,4 +44,25 @@ DMLC_TPU_DISABLE_NATIVE=1 python -m pytest -x -q \
     tests/test_data_layer.py tests/test_recordio.py \
     tests/test_input_split.py tests/test_feed.py "$@" || exit 1
 
-echo "== CI OK (native=$NATIVE_OK) =="
+echo "== stage 4: ThreadSanitizer stress on the native parse fanout =="
+TSAN_OK=skipped
+if command -v g++ >/dev/null 2>&1; then
+    TSAN_DIR=$(mktemp -d)
+    # probe the tsan RUNTIME with a trivial program; only its absence
+    # may skip the stage — a compile failure of OUR sources must fail CI
+    echo 'int main(){return 0;}' > "$TSAN_DIR/probe.cc"
+    if g++ -fsanitize=thread "$TSAN_DIR/probe.cc" -o "$TSAN_DIR/probe" \
+           -pthread 2>/dev/null && "$TSAN_DIR/probe"; then
+        g++ -O1 -g -std=c++17 -fsanitize=thread \
+            dmlc_tpu/cpp/dmlc_native.cc dmlc_tpu/cpp/test_native_tsan.cc \
+            -o "$TSAN_DIR/test_native_tsan" -pthread \
+            || { echo "FAIL: tsan build of native sources broke"; exit 1; }
+        "$TSAN_DIR/test_native_tsan" \
+            || { echo "FAIL: ThreadSanitizer reported races"; exit 1; }
+        TSAN_OK=1
+    else
+        echo "tsan runtime unavailable; skipping"
+    fi
+fi
+
+echo "== CI OK (native=$NATIVE_OK tsan=$TSAN_OK) =="

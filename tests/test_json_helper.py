@@ -1,0 +1,77 @@
+"""Declarative JSON binding (reference json.h JSONObjectReadHelper)."""
+
+import pytest
+
+from dmlc_tpu.base import DMLCError
+from dmlc_tpu.json_helper import JSONObjectReadHelper
+
+
+def make_helper():
+    h = JSONObjectReadHelper()
+    h.declare_field("name", str)
+    h.declare_field("lr", float)
+    h.declare_field("steps", int)
+    h.declare_field("tags", list, required=False, default=[])
+    return h
+
+
+def test_read_valid():
+    out = make_helper().read_object(
+        '{"name": "sgd", "lr": 0.1, "steps": 10, "tags": ["a"]}')
+    assert out == {"name": "sgd", "lr": 0.1, "steps": 10, "tags": ["a"]}
+
+
+def test_optional_default_and_int_to_float():
+    out = make_helper().read_object('{"name": "x", "lr": 1, "steps": 2}')
+    assert out["lr"] == 1.0 and isinstance(out["lr"], float)
+    assert out["tags"] == []
+    # defaults are copied, not shared
+    out["tags"].append("mutate")
+    assert make_helper().read_object(
+        '{"name": "x", "lr": 1, "steps": 2}')["tags"] == []
+
+
+def test_missing_required_and_unknown_keys():
+    with pytest.raises(DMLCError, match="missing required"):
+        make_helper().read_object('{"name": "x", "lr": 1}')
+    with pytest.raises(DMLCError, match="unknown JSON keys"):
+        make_helper().read_object(
+            '{"name": "x", "lr": 1, "steps": 2, "zzz": 0}')
+    # non-strict mode tolerates unknown keys (kAllowUnknown analog)
+    h = JSONObjectReadHelper(strict=False)
+    h.declare_field("name", str)
+    assert h.read_object('{"name": "x", "zzz": 1}') == {"name": "x"}
+
+
+def test_type_errors():
+    with pytest.raises(DMLCError, match="expected str"):
+        make_helper().read_object('{"name": 3, "lr": 1, "steps": 2}')
+    with pytest.raises(DMLCError, match="expected int, got bool"):
+        make_helper().read_object('{"name": "x", "lr": 1, "steps": true}')
+    with pytest.raises(DMLCError, match="invalid JSON"):
+        make_helper().read_object("{nope")
+    with pytest.raises(DMLCError, match="expected a JSON object"):
+        make_helper().read_object("[1,2]")
+
+
+def test_nested_helper_and_read_into():
+    inner = JSONObjectReadHelper()
+    inner.declare_field("dim", int)
+    outer = JSONObjectReadHelper()
+    outer.declare_field("model", inner)
+    outer.declare_field("epochs", int)
+
+    class Cfg:
+        pass
+
+    cfg = outer.read_into(Cfg(), '{"model": {"dim": 8}, "epochs": 3}')
+    assert cfg.model == {"dim": 8}
+    assert cfg.epochs == 3
+
+
+def test_write_round_trip():
+    h = make_helper()
+    text = h.write_object({"name": "sgd", "lr": 0.5, "steps": 7,
+                           "tags": ["x"]})
+    assert h.read_object(text) == {"name": "sgd", "lr": 0.5, "steps": 7,
+                                   "tags": ["x"]}
