@@ -102,14 +102,14 @@ class JSONObjectReadHelper:
     def write_object(self, obj: Any, *, indent: Optional[int] = None) -> str:
         """Serialize declared fields of an object/dict back to JSON."""
         get = obj.get if isinstance(obj, dict) else \
-            lambda n, d=None: getattr(obj, n, d)
+            lambda n, d=_MISSING: getattr(obj, n, d)
         out = {}
         for name, (type_, required, default) in self._fields.items():
             v = get(name, _MISSING)
             if v is _MISSING:
                 if required:
                     raise DMLCError(f"missing field {name!r} on write")
-                v = default
+                continue  # absent optional: omit — read restores default
             if isinstance(type_, JSONObjectReadHelper):
                 v = json.loads(type_.write_object(v))
             out[name] = v

@@ -12,10 +12,13 @@ with VMA checking, so psum/pvary transposes produce correct synced
 gradients automatically) and the unsharded single-chip oracle
 (ShardAxes()) — tests assert the two losses are bit-close.
 
-MoE gating is full-softmax (dense dispatch): every ep shard computes its
-local experts for all tokens and the weighted combine psums over
-(ep, tp).  Top-k routing with all_to_all token exchange is the planned
-fast path; dense dispatch is exact and keeps shapes static for XLA.
+MoE has two dispatch modes, both static-shaped for XLA: dense soft
+gating (moe_topk=0 — every ep shard computes its local experts for all
+tokens; exact, the correctness oracle) and top-k capacity routing
+(moe_topk=k — each shard scatters only the (token, choice) pairs whose
+expert it owns into [X_local, capacity, E] slots, so expert compute is
+k/X of dense and sharded with no token exchange; overflow drops, the
+standard static-shape trade).  Both combine with one psum over (ep, tp).
 """
 
 from __future__ import annotations
@@ -56,6 +59,8 @@ class TransformerConfig:
     microbatches: int = 2      # pipeline schedule M
     dtype: str = "float32"     # bf16 for real runs; f32 for CPU tests
     remat: bool = False        # checkpoint each block (trade FLOPs for HBM)
+    moe_topk: int = 0          # 0 = dense soft gating; k>0 = routed top-k
+    moe_capacity_factor: float = 1.25  # slots per expert vs perfect balance
 
     @property
     def jdtype(self):
@@ -180,8 +185,11 @@ def _attention(x, p, positions, axes: ShardAxes):
     return y
 
 
-def _moe_ffn(x, p, axes: ShardAxes):
-    """Soft-gated MoE; experts sharded over (ep, tp), combined in one psum."""
+def _moe_dense_ffn(x, p, axes: ShardAxes):
+    """Soft-gated MoE; experts sharded over (ep, tp), combined in one psum.
+
+    Exact (every expert sees every token) — the correctness oracle for
+    the routed path and the default for small expert counts."""
     n_local = p["w_in"].shape[0]
     gate_logits = jnp.einsum("bte,ex->btx", x, p["gate"])  # [B,T,X_global]
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
@@ -202,24 +210,90 @@ def _moe_ffn(x, p, axes: ShardAxes):
     return y
 
 
-def _block(x, p, positions, axes: ShardAxes):
+def _moe_topk_ffn(x, p, axes: ShardAxes, cfg: "TransformerConfig"):
+    """Top-k routed MoE (Switch/GShard-style capacity dispatch).
+
+    TPU-first: every shape is static.  Tokens are replicated across the
+    ep axis (dp/sp own the token sharding), so routing is LOCAL: each
+    shard scatters only the (token, choice) pairs whose expert it owns
+    into a [X_local, capacity, E] buffer (capacity =
+    ceil(k·n·capacity_factor / X_global); overflow tokens are dropped —
+    the standard trade for static shapes), runs its expert FFNs, and the
+    weighted combine psums over (ep, tp) — every choice contributes on
+    exactly the shard owning its expert, so expert compute is k/X of the
+    dense path and perfectly sharded with NO token exchange.
+    """
+    b, t, e = x.shape
+    n = b * t
+    k = cfg.moe_topk
+    xf = x.reshape(n, e)
+    gate_logits = jnp.einsum("ne,ex->nx", xf, p["gate"])
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    n_expert = probs.shape[-1]                       # X_global
+    topv, topi = lax.top_k(probs, k)                 # [n, k]
+    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+
+    x_l = p["w_in"].shape[0]                         # local experts
+    off = (lax.axis_index(axes.ep) * x_l if axes.ep is not None else 0)
+    capacity = -(-(k * n * cfg.moe_capacity_factor) // n_expert)
+    capacity = max(int(capacity), 1)
+
+    # local routing: (token, choice) pairs owned by this shard's experts
+    flat_e = topi.reshape(-1)                        # [n·k], token-major
+    local = (flat_e >= off) & (flat_e < off + x_l)
+    le = jnp.clip(flat_e - off, 0, x_l - 1)
+    # slot position within each local expert (capacity dispatch)
+    oh = jax.nn.one_hot(le, x_l, dtype=jnp.int32) * local[:, None]
+    pos = jnp.sum((jnp.cumsum(oh, axis=0) - 1) * oh, axis=-1)  # [n·k]
+    keep = (local & (pos < capacity))
+    pos_c = jnp.minimum(pos, capacity - 1)
+
+    # dispatch: [X_local, C, E] — owned tokens scattered unweighted
+    xk = jnp.repeat(xf, k, axis=0) * keep[:, None].astype(xf.dtype)
+    buf = jnp.zeros((x_l, capacity, e), xf.dtype)
+    buf = buf.at[le, pos_c].add(xk)
+
+    def one_expert(w_in, w_gate, w_out, xe):
+        return swiglu_ffn(xe, w_in, w_gate, w_out, axes, reduce=False)
+
+    out = jax.vmap(one_expert)(p["w_in"], p["w_gate"], p["w_out"], buf)
+
+    # combine: gather each owned (token, choice)'s output, weight, sum;
+    # remote choices contribute on their owning shard via the psum
+    picked = out[le, pos_c]                          # [n·k, E]
+    w = (topv.reshape(-1) * keep.astype(jnp.float32)).astype(picked.dtype)
+    y = jnp.sum((picked * w[:, None]).reshape(n, k, e), axis=1)
+    y = y.reshape(b, t, e)
+    reduce_axes = tuple(a for a in (axes.ep, axes.tp) if a is not None)
+    if reduce_axes:
+        y = lax.psum(y, reduce_axes)
+    return y.astype(x.dtype)
+
+
+def _moe_ffn(x, p, axes: ShardAxes, cfg: "TransformerConfig"):
+    if cfg.moe_topk > 0 and cfg.n_experts > 1:
+        return _moe_topk_ffn(x, p, axes, cfg)
+    return _moe_dense_ffn(x, p, axes)
+
+
+def _block(x, p, positions, axes: ShardAxes, cfg: "TransformerConfig"):
     x = x + _attention(rms_norm(x, p["ln1"]), p, positions, axes)
-    x = x + _moe_ffn(rms_norm(x, p["ln2"]), p, axes)
+    x = x + _moe_ffn(rms_norm(x, p["ln2"]), p, axes, cfg)
     return x
 
 
 def _stage_fn(stage_params, x, positions, axes: ShardAxes,
-              remat: bool = False):
+              cfg: "TransformerConfig", remat: bool = False):
     """Apply this stage's L/S blocks via scan over the layer dim."""
     blk = _block
     if remat:
         # rematerialize each block on the backward pass: only the block
         # inputs (residual stream) are saved, so activation memory is
         # O(L·B·T·E) instead of O(L·B·T·(E+F+hd...))
-        blk = jax.checkpoint(_block, static_argnums=(3,))
+        blk = jax.checkpoint(_block, static_argnums=(3, 4))
 
     def body(h, layer_p):
-        return blk(h, layer_p, positions, axes), None
+        return blk(h, layer_p, positions, axes, cfg), None
 
     out, _ = lax.scan(body, x, stage_params)
     return out
@@ -245,7 +319,7 @@ def forward_local(params, ids, labels, cfg: TransformerConfig, axes: ShardAxes):
         assert b % m == 0, f"batch {b} must divide microbatches {m}"
         xmb = x.reshape(m, b // m, t_local, cfg.d_model)
         out = pipeline_spmd(
-            lambda p_, h: _stage_fn(p_, h, positions, axes, cfg.remat),
+            lambda p_, h: _stage_fn(p_, h, positions, axes, cfg, cfg.remat),
             stage_params,
             xmb,
             axis_name=axes.pp,
@@ -255,7 +329,7 @@ def forward_local(params, ids, labels, cfg: TransformerConfig, axes: ShardAxes):
         n_stages = blocks["ln1"].shape[0]
         for s in range(n_stages):
             stage_params = jax.tree.map(lambda a: a[s], blocks)
-            x = _stage_fn(stage_params, x, positions, axes, cfg.remat)
+            x = _stage_fn(stage_params, x, positions, axes, cfg, cfg.remat)
 
     x = rms_norm(x, params["ln_f"])
     logits = jnp.einsum("bte,ev->btv", x, params["unembed"])

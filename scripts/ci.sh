@@ -18,6 +18,10 @@ cd "$(dirname "$0")/.."
 # into fallback-only runs; only stage 3 sets it, explicitly.
 unset DMLC_TPU_DISABLE_NATIVE
 
+echo "== stage 0: syntax gate =="
+python -m compileall -q dmlc_tpu tests scripts bench.py __graft_entry__.py \
+    || { echo "FAIL: syntax errors"; exit 1; }
+
 echo "== stage 1: native build =="
 NATIVE_OK=0
 if command -v g++ >/dev/null 2>&1; then
@@ -48,6 +52,7 @@ echo "== stage 4: ThreadSanitizer stress on the native parse fanout =="
 TSAN_OK=skipped
 if command -v g++ >/dev/null 2>&1; then
     TSAN_DIR=$(mktemp -d)
+    trap 'rm -rf "$TSAN_DIR"' EXIT
     # probe the tsan RUNTIME with a trivial program; only its absence
     # may skip the stage — a compile failure of OUR sources must fail CI
     echo 'int main(){return 0;}' > "$TSAN_DIR/probe.cc"

@@ -69,6 +69,16 @@ def test_nested_helper_and_read_into():
     assert cfg.epochs == 3
 
 
+def test_write_omits_absent_optional():
+    h = make_helper()
+    text = h.write_object({"name": "x", "lr": 1.0, "steps": 2})
+    assert "tags" not in text
+    # and the round trip restores the declared default
+    assert h.read_object(text)["tags"] == []
+    with pytest.raises(DMLCError, match="missing field"):
+        h.write_object({"name": "x"})
+
+
 def test_write_round_trip():
     h = make_helper()
     text = h.write_object({"name": "sgd", "lr": 0.5, "steps": 7,
