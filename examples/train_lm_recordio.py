@@ -1,0 +1,104 @@
+"""Train the transformer LM from RecordIO token shards — the full TPU
+spine in one script (BASELINE configs #2/#5 shape): InputSplit →
+device feed → sharded model → metrics.
+
+  python examples/train_lm_recordio.py <shards.rec> [steps]
+
+Each RecordIO record holds a fixed-length sequence of int32 token ids.
+The packed device feed streams records into HBM; the model trains with
+whatever mesh the local devices support (1 chip → trivial mesh; under a
+multi-chip runtime the same code shards over dp).  Run
+`python examples/train_lm_recordio.py --make-data out.rec` first to
+generate a synthetic shard.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SEQ = 128
+VOCAB = 512
+
+
+def make_data(path, n_records=2048, seed=0):
+    from dmlc_tpu.io.recordio import RecordIOWriter
+    from dmlc_tpu.io.stream import Stream
+
+    rng = np.random.default_rng(seed)
+    with Stream.create(path, "w") as s:
+        w = RecordIOWriter(s)
+        for _ in range(n_records):
+            # a learnable distribution: arithmetic sequences mod VOCAB.
+            # SEQ+1 tokens per record so ids/labels split without the
+            # wrap-around garbage target a plain roll would create
+            start, step = rng.integers(0, VOCAB), rng.integers(1, 7)
+            ids = (start + step * np.arange(SEQ + 1)) % VOCAB
+            w.write_record(ids.astype(np.int32).tobytes())
+    print(f"wrote {n_records} records to {path}")
+
+
+def main():
+    if len(sys.argv) < 2:
+        print("usage: train_lm_recordio.py (<shards.rec> [steps] | "
+              "--make-data <out.rec>)", file=sys.stderr)
+        sys.exit(2)
+    if sys.argv[1] == "--make-data":
+        make_data(sys.argv[2])
+        return
+    uri = sys.argv[1]
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 30
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dmlc_tpu import metrics
+    from dmlc_tpu.feed import recordio_feed
+    from dmlc_tpu.models import (TransformerConfig, init_params,
+                                 make_train_step)
+    from dmlc_tpu.parallel import build_mesh
+
+    n_dev = len(jax.devices())
+    mesh = build_mesh(n_dev, dp=n_dev, sp=1, tp=1, pp=1, ep=1)
+    cfg = TransformerConfig(
+        vocab=VOCAB, d_model=256, n_heads=4, head_dim=64, d_ff=512,
+        n_layers=4, n_experts=1, microbatches=1,
+        dtype="bfloat16" if jax.devices()[0].platform == "tpu"
+        else "float32",
+        remat=True)
+    params = init_params(jax.random.PRNGKey(0), cfg, n_stages=1)
+    step, init_state = make_train_step(
+        mesh, cfg, optimizer=optax.adamw(3e-4))
+    opt_state = init_state(params)
+
+    per_part = 8  # records per partition per batch
+    feed = recordio_feed(uri, mesh, batch_records=per_part,
+                         max_bytes=(SEQ + 1) * 4)
+    done = 0
+    while done < steps:
+        for batch in feed:
+            with metrics.annotate("train_step"):
+                data = jnp.asarray(batch["data"])
+                toks = jax.lax.bitcast_convert_type(
+                    data.reshape(-1, SEQ + 1, 4), jnp.int32
+                ).reshape(-1, SEQ + 1)
+                ids, labels = toks[:, :-1], toks[:, 1:]
+                params, opt_state, loss = step(params, opt_state, ids,
+                                               labels)
+            done += 1
+            if done % 10 == 0 or done == 1:
+                print(f"step {done}: loss {float(loss):.4f}", flush=True)
+            if done >= steps:
+                break
+    snap = metrics.snapshot()
+    fed = snap.get("feed", {})
+    print(f"final loss {float(loss):.4f}; feed moved "
+          f"{fed.get('bytes_to_device', 0) / 1e6:.1f} MB in "
+          f"{int(fed.get('batches', 0))} batches")
+
+
+if __name__ == "__main__":
+    main()

@@ -19,7 +19,8 @@ cd "$(dirname "$0")/.."
 unset DMLC_TPU_DISABLE_NATIVE
 
 echo "== stage 0: syntax gate =="
-python -m compileall -q dmlc_tpu tests scripts bench.py __graft_entry__.py \
+python -m compileall -q dmlc_tpu tests scripts examples bin \
+    bench.py __graft_entry__.py \
     || { echo "FAIL: syntax errors"; exit 1; }
 
 echo "== stage 1: native build =="

@@ -149,6 +149,26 @@ def test_train_libsvm_end_to_end(tmp_path):
     assert "epoch 1 loss" in r.stderr
 
 
+def test_train_csv_end_to_end(tmp_path):
+    """BASELINE config #3 shape: CSV tabular allreduce SGD, 2 workers."""
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(200, 4))
+    y = X @ [1.0, -2.0, 0.5, 1.5]
+    data = tmp_path / "tab.csv"
+    with open(data, "w") as f:
+        for yi, xi in zip(y, X):
+            f.write(",".join([f"{yi:.4f}"] + [f"{v:.4f}" for v in xi]) + "\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "dmlc-submit"),
+         "--cluster", "local", "--num-workers", "2", "--host-ip",
+         "127.0.0.1", "--", sys.executable,
+         os.path.join(REPO, "examples", "train_csv.py"), str(data), "3"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-3000:]
+    assert "epoch 2 mse" in r.stderr
+
+
 def test_cache_file_set_rewrites_command(tmp_path, monkeypatch):
     from dmlc_tpu.tracker.opts import get_opts
 
