@@ -59,6 +59,13 @@ class TransformerConfig:
     microbatches: int = 2      # pipeline schedule M
     dtype: str = "float32"     # bf16 for real runs; f32 for CPU tests
     remat: bool = False        # checkpoint each block (trade FLOPs for HBM)
+    # remat_policy: "full" recomputes everything; "save_flash" keeps the
+    # flash kernels' (o, lse) residuals — o is [B,T,H,hd] bf16 plus lse
+    # [B,H,T] f32 PER LAYER — so the backward skips re-running the
+    # forward attention kernel (+1-2% MFU on the single-chip flash path;
+    # the sp-sharded ring path has no tagged residuals and falls back to
+    # full remat regardless)
+    remat_policy: str = "save_flash"
     moe_topk: int = 0          # 0 = dense soft gating; k>0 = routed top-k
     moe_capacity_factor: float = 1.25  # slots per expert vs perfect balance
 
@@ -289,8 +296,18 @@ def _stage_fn(stage_params, x, positions, axes: ShardAxes,
     if remat:
         # rematerialize each block on the backward pass: only the block
         # inputs (residual stream) are saved, so activation memory is
-        # O(L·B·T·E) instead of O(L·B·T·(E+F+hd...))
-        blk = jax.checkpoint(_block, static_argnums=(3, 4))
+        # O(L·B·T·E) instead of O(L·B·T·(E+F+hd...)); the save_flash
+        # policy additionally keeps the attention kernels' residuals
+        if cfg.remat_policy == "save_flash":
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "flash_o", "flash_lse")
+        elif cfg.remat_policy == "full":
+            policy = None
+        else:
+            raise ValueError(
+                f"unknown remat_policy {cfg.remat_policy!r}; "
+                "expected 'full' or 'save_flash'")
+        blk = jax.checkpoint(_block, static_argnums=(3, 4), policy=policy)
 
     def body(h, layer_p):
         return blk(h, layer_p, positions, axes, cfg), None

@@ -469,12 +469,18 @@ def _flash_attn(static, q, k, v):
 
 
 def _flash_attn_impl(static, q, k, v):
+    from jax.ad_checkpoint import checkpoint_name
+
     zero = jnp.zeros(1, jnp.int32)
     pv, m, l = _flash_forward(static, q, k, v, zero, zero)
     lsafe = jnp.maximum(l, 1e-20)                         # [B,H,Tq]
     o = (pv / jnp.transpose(lsafe, (0, 2, 1))[..., None]).astype(q.dtype)
     lse = m + jnp.log(lsafe)
-    return o, lse
+    # named for remat policies: saving (o, lse) lets jax.checkpoint skip
+    # re-running the forward kernel in the backward pass (they are the
+    # custom_vjp residuals) — see models.TransformerConfig.remat_policy
+    return (checkpoint_name(o, "flash_o"),
+            checkpoint_name(lse, "flash_lse"))
 
 
 def _flash_attn_fwd(static, q, k, v):
