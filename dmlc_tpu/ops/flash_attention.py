@@ -432,6 +432,12 @@ def _flash_backward(static, q, k, v, o, lse, do):
             jax.ShapeDtypeStruct((bh, tk_p, d), jnp.float32),
             jax.ShapeDtypeStruct((bh, tk_p, d), jnp.float32),
         ],
+        # bh and the accumulator's home dim are independent; only the
+        # innermost (accumulating) dim is order-dependent — measured
+        # ~15% faster than leaving the semantics unspecified.  (The fwd
+        # kernel regresses badly with the same hint, so it stays plain.)
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(kvend, qt, dot, kt, vt, lse8, delta8)
 
@@ -450,6 +456,8 @@ def _flash_backward(static, q, k, v, o, lse, do):
             out_specs=q_of_q2,
         ),
         out_shape=jax.ShapeDtypeStruct((bh, tq_p, d), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(kvend, qt, dot, kt, vt, lse8, delta8)
 
