@@ -1,8 +1,12 @@
 """Train the transformer LM from RecordIO token shards — the full TPU
 spine in one script (BASELINE configs #2/#5 shape): InputSplit →
-device feed → sharded model → metrics.
+device feed → sharded model → checkpoint/resume → metrics.
 
-  python examples/train_lm_recordio.py <shards.rec> [steps]
+  python examples/train_lm_recordio.py <shards.rec> [steps] [ckpt_dir]
+
+With a checkpoint dir the run resumes from the latest step-numbered
+checkpoint (CheckpointManager over the Stream/URI layer, so the same
+path works with gs://) and saves every 20 steps.
 
 Each RecordIO record holds a fixed-length sequence of int32 token ids.
 The packed device feed streams records into HBM; the model trains with
@@ -42,14 +46,15 @@ def make_data(path, n_records=2048, seed=0):
 
 def main():
     if len(sys.argv) < 2:
-        print("usage: train_lm_recordio.py (<shards.rec> [steps] | "
-              "--make-data <out.rec>)", file=sys.stderr)
+        print("usage: train_lm_recordio.py (<shards.rec> [steps] "
+              "[ckpt_dir] | --make-data <out.rec>)", file=sys.stderr)
         sys.exit(2)
     if sys.argv[1] == "--make-data":
         make_data(sys.argv[2])
         return
     uri = sys.argv[1]
     steps = int(sys.argv[2]) if len(sys.argv) > 2 else 30
+    ckpt_dir = sys.argv[3] if len(sys.argv) > 3 else None
 
     import jax
     import jax.numpy as jnp
@@ -74,12 +79,31 @@ def main():
         mesh, cfg, optimizer=optax.adamw(3e-4))
     opt_state = init_state(params)
 
+    manager = start_at = None
+    if ckpt_dir:
+        from dmlc_tpu.checkpoint import CheckpointManager
+
+        manager = CheckpointManager(ckpt_dir, max_to_keep=2)
+        # faithful resume: params AND optimizer moments/step count travel
+        # together (restoring params alone would reset AdamW's state)
+        start_at, restored = manager.restore_latest(
+            {"params": params, "opt": opt_state}, mesh=mesh)
+        if start_at is not None:
+            params, opt_state = restored["params"], restored["opt"]
+            print(f"resumed from step {start_at}", flush=True)
+
     per_part = 8  # records per partition per batch
     feed = recordio_feed(uri, mesh, batch_records=per_part,
                          max_bytes=(SEQ + 1) * 4)
     done = 0
+    # data fast-forward: this feed is deterministic, so replaying
+    # start_at batches puts the stream exactly where the saved run was
+    skip = start_at or 0
     while done < steps:
         for batch in feed:
+            if skip > 0:
+                skip -= 1
+                continue
             with metrics.annotate("train_step"):
                 data = jnp.asarray(batch["data"])
                 toks = jax.lax.bitcast_convert_type(
@@ -91,8 +115,14 @@ def main():
             done += 1
             if done % 10 == 0 or done == 1:
                 print(f"step {done}: loss {float(loss):.4f}", flush=True)
+            if manager is not None and done % 20 == 0:
+                manager.save((start_at or 0) + done,
+                             {"params": params, "opt": opt_state})
             if done >= steps:
                 break
+    if manager is not None:
+        manager.save((start_at or 0) + done,
+                     {"params": params, "opt": opt_state})
     snap = metrics.snapshot()
     fed = snap.get("feed", {})
     print(f"final loss {float(loss):.4f}; feed moved "
