@@ -79,6 +79,13 @@ def ring_attention(
     else:
         raise ValueError(f"unknown ring_attention impl {impl!r}")
 
+    if n == 1 and use_flash:
+        # degenerate ring (sp axis of size 1 — e.g. dp-only meshes): the
+        # standalone kernel path is strictly better — kernel backward
+        # (no T×T lax recompute) and save_flash remat policy both apply
+        return _flash.flash_attention(q, k, v, causal=causal, scale=scale,
+                                      interpret=interpret)
+
     q_pos = jnp.arange(t_local)  # local positions; global = blk*t_local + pos
     acc0 = jnp.zeros((b, t_local, h, d), jnp.float32)
     m0 = jnp.full((b, h, t_local), _NEG_BIG, jnp.float32)

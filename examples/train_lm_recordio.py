@@ -98,6 +98,8 @@ def main():
     done = 0
     # data fast-forward: this feed is deterministic, so replaying
     # start_at batches puts the stream exactly where the saved run was
+    # (a demo-grade skip — it pays full pipeline + transfer cost per
+    # discarded batch; production resumes would skip at the host side)
     skip = start_at or 0
     while done < steps:
         for batch in feed:
@@ -120,7 +122,7 @@ def main():
                              {"params": params, "opt": opt_state})
             if done >= steps:
                 break
-    if manager is not None:
+    if manager is not None and done % 20 != 0:  # periodic save already hit
         manager.save((start_at or 0) + done,
                      {"params": params, "opt": opt_state})
     snap = metrics.snapshot()
