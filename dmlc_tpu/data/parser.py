@@ -12,13 +12,13 @@ from __future__ import annotations
 
 from typing import Callable, List, Optional
 
-from ..base import DMLCError, check
+from ..base import DMLCError
 from ..common import get_time
 from ..concurrency import ThreadedIter
 from ..io import input_split as isplit
 from ..io.uri import URISpec
 from ..registry import Registry
-from .row_block import RowBlock, RowBlockContainer
+from .row_block import RowBlockContainer
 
 __all__ = [
     "Parser",

@@ -13,7 +13,7 @@ import os
 from typing import Optional
 
 from .. import logging as log
-from ..base import DMLCError, check
+from ..base import check
 from ..concurrency import ThreadedIter
 from ..io.stream import FileStream
 from ..io.uri import URISpec

@@ -20,7 +20,7 @@ from __future__ import annotations
 import functools
 import threading
 from queue import Queue
-from typing import Callable, Dict, Iterator, Optional
+from typing import Dict, Iterator, Optional
 
 import numpy as np
 

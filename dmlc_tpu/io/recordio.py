@@ -20,9 +20,9 @@ load unchanged.
 from __future__ import annotations
 
 import struct
-from typing import Iterator, Optional, Tuple
+from typing import Iterator, Optional
 
-from ..base import DMLCError, check
+from ..base import check
 from .stream import Stream
 
 __all__ = [

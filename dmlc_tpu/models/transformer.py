@@ -24,11 +24,9 @@ standard static-shape trade).  Both combine with one psum over (ep, tp).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 

@@ -19,7 +19,7 @@ env-var defaults) matches the reference.
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List, Optional, Sequence, Tuple, Type
+from typing import Any, Dict, List, Optional, Type
 
 from .base import ParamError, get_env
 

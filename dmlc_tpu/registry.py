@@ -8,7 +8,7 @@ map to plain imports.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Generic, List, Optional, TypeVar
+from typing import Callable, Dict, Generic, List, Optional, TypeVar
 
 from .base import DMLCError
 

@@ -28,7 +28,6 @@ the same uint64-length + raw-data layout.
 
 from __future__ import annotations
 
-import struct
 from typing import Any, Tuple, Union
 
 import numpy as np

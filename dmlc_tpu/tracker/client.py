@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import os
 import socket
-import threading
 from typing import Dict, Optional
 
 import numpy as np
