@@ -140,18 +140,35 @@ def process_rank_world() -> tuple:
 def initialize_distributed(coordinator: Optional[str] = None) -> None:
     """Bring up jax.distributed using the DMLC env contract.
 
-    DMLC_TRACKER_URI/PORT (reference tracker.py:182-183) name the
-    coordinator; rank/world come from process_rank_world().  No-op when
-    single-process.
+    The coordinator is named by DMLC_JAX_COORD_URI/PORT, which the tracker
+    allocates alongside its own socket (rendezvous.py submit_job) — NOT by
+    DMLC_TRACKER_PORT: that port is the rabit tracker's already-bound
+    listener (reference tracker.py:182-183), so rank 0 could never host
+    the gRPC coordinator service there.  Rank/world come from
+    process_rank_world() (DMLC_TASK_ID / DMLC_NUM_WORKER).  No-op when
+    single-process or when jax.distributed is already up.
     """
     import os
 
     rank, world = process_rank_world()
     if world <= 1:
         return
+    if jax.distributed.is_initialized():
+        return
     if coordinator is None:
-        uri = os.environ.get("DMLC_TRACKER_URI", "127.0.0.1")
-        port = os.environ.get("DMLC_TRACKER_PORT", "9091")
+        uri = (os.environ.get("DMLC_JAX_COORD_URI")
+               or os.environ.get("DMLC_TRACKER_URI", "127.0.0.1"))
+        # no tracker-port fallback on purpose (see docstring), and no
+        # made-up default either: tracker_host:<guess> can never be right
+        # on multi-host jobs, so dialing it would trade a clear error for
+        # a multi-minute gRPC hang
+        port = os.environ.get("DMLC_JAX_COORD_PORT")
+        if port is None:
+            raise RuntimeError(
+                "DMLC_JAX_COORD_PORT is not set — this process was not "
+                "launched by a tracker that allocates the jax.distributed "
+                "coordinator (dmlc-submit does); pass "
+                "coordinator='host:port' explicitly")
         coordinator = f"{uri}:{port}"
     jax.distributed.initialize(
         coordinator_address=coordinator, num_processes=world, process_id=rank

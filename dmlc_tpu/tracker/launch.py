@@ -86,10 +86,10 @@ def _await_job(tracker, failures, threads):
     while True:
         if failures:
             raise RuntimeError(f"tasks failed: {failures}")
-        if tracker is not None:
-            if not tracker.alive():
-                break
-        elif all(not t.is_alive() for t in threads):
+        if tracker is not None and getattr(tracker, "error", None) is not None:
+            raise RuntimeError(f"tracker failed: {tracker.error}")
+        tracker_done = tracker is None or not tracker.alive()
+        if tracker_done and all(not t.is_alive() for t in threads):
             break
         time.sleep(0.05)
     if failures:
@@ -103,6 +103,7 @@ def submit_local(args):
     """Threads × subprocess with per-task retry (reference local.py:12-72)."""
     failures = []
     threads = []
+    procs: List[subprocess.Popen] = []
 
     def fun_submit(n_workers, n_servers, envs):
         def run_task(role, task_id):
@@ -111,7 +112,9 @@ def submit_local(args):
                 env.update(task_env(envs, role, task_id, attempt, "local",
                                     args.extra_env,
                                     resource_envs(args, role)))
-                ret = subprocess.call(args.command, env=env)
+                p = subprocess.Popen(args.command, env=env)
+                procs.append(p)
+                ret = p.wait()
                 if ret == 0:
                     return
                 logger.warning("%s %d attempt %d exited %d", role, task_id,
@@ -123,9 +126,28 @@ def submit_local(args):
             t.start()
             threads.append(t)
 
-    tracker = submit_job(args.num_workers, args.num_servers, fun_submit,
-                         host_ip=args.host_ip or "127.0.0.1", join=False)
-    return _await_job(tracker, failures, threads)
+    try:
+        tracker = submit_job(args.num_workers, args.num_servers, fun_submit,
+                             host_ip=args.host_ip or "127.0.0.1",
+                             pscmd=_pscmd(args), join=False)
+        return _await_job(tracker, failures, threads)
+    except Exception:
+        # an aborting job must not orphan still-running task processes
+        # (e.g. workers blocking on a scheduler that died at startup)
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        raise
+
+
+def _pscmd(args) -> Optional[str]:
+    """PS jobs run the user command as the scheduler too (DMLC_ROLE=
+    scheduler), the reference local.py/ssh.py pscmd contract."""
+    import shlex
+
+    if args.num_servers > 0:
+        return shlex.join(args.command)
+    return None
 
 
 # ---------------------------------------------------------------------------
@@ -193,6 +215,18 @@ class GangScheduler:
                 raise RuntimeError("all hosts blacklisted")
             return live[idx % len(live)]
 
+    def _pick_host_for(self, role: str, task_id: int, attempt: int) -> str:
+        # worker 0 stays on live[0] across retries: its host is exported
+        # to the whole job as DMLC_JAX_COORD_URI before placement, so
+        # moving it on a transient failure would strand the
+        # jax.distributed coordinator address.  (Blacklisting hosts[0]
+        # still shifts it — the coordinator URI then goes stale, the one
+        # unrecoverable corner of pre-announced coordination.)  Other
+        # tasks rotate hosts on retry.
+        if role == "worker" and task_id == 0:
+            return self._pick_host(0)
+        return self._pick_host(task_id + attempt)
+
     def _record(self, host: str, ok: bool) -> None:
         with self._lock:
             if ok:
@@ -205,7 +239,7 @@ class GangScheduler:
     def run_task(self, role: str, task_id: int, envs: Dict[str, str],
                  cluster: str, extra_env=None) -> None:
         for attempt in range(self.max_attempts):
-            host = self._pick_host(task_id + attempt)
+            host = self._pick_host_for(role, task_id, attempt)
             env = task_env(envs, role, task_id, attempt, cluster, extra_env)
             env["DMLC_NODE_HOST"] = host
             ret = self.runner(host, role, task_id, env)
@@ -334,7 +368,7 @@ def submit_ssh(args):
     command, remote_dir, cache_env, hosts = _stage_cache(args, hosts)
     sched = GangScheduler(hosts, _make_ssh_runner(command, remote_dir),
                           max_attempts=args.max_attempts)
-    return _submit_gang(args, sched, "ssh", cache_env)
+    return _submit_gang(args, sched, "ssh", cache_env, coord_host=hosts[0])
 
 
 def submit_tpu_vm(args):
@@ -348,16 +382,22 @@ def submit_tpu_vm(args):
     command, remote_dir, cache_env, hosts = _stage_cache(args, hosts)
     sched = GangScheduler(hosts, _make_ssh_runner(command, remote_dir),
                           max_attempts=args.max_attempts)
-    return _submit_gang(args, sched, "tpu-vm", cache_env)
+    return _submit_gang(args, sched, "tpu-vm", cache_env, coord_host=hosts[0])
 
 
 def _submit_gang(args, sched: "GangScheduler", cluster: str,
-                 cache_env: Optional[Dict[str, str]] = None):
+                 cache_env: Optional[Dict[str, str]] = None,
+                 coord_host: Optional[str] = None):
     failures = []
     threads = []
     extra = dict(args.extra_env)
     if cache_env:
         extra.update(cache_env)
+    if coord_host and "DMLC_JAX_COORD_URI" not in extra:
+        # task 0 (attempt 0) lands on hosts[0] (GangScheduler._pick_host),
+        # so the jax.distributed coordinator service lives there, not on
+        # the tracker machine
+        extra["DMLC_JAX_COORD_URI"] = coord_host.partition(":")[0]
 
     def fun_submit(n_workers, n_servers, envs):
         def run():
@@ -371,7 +411,8 @@ def _submit_gang(args, sched: "GangScheduler", cluster: str,
         threads.append(t)
 
     tracker = submit_job(args.num_workers, args.num_servers, fun_submit,
-                         host_ip=args.host_ip or "auto", join=False)
+                         host_ip=args.host_ip or "auto",
+                         pscmd=_pscmd(args), join=False)
     return _await_job(tracker, failures, threads)
 
 
@@ -432,7 +473,8 @@ def submit_mpi(args):
         threads.extend(_reap_procs(procs, failures))
 
     tracker = submit_job(args.num_workers, args.num_servers, fun_submit,
-                         host_ip=args.host_ip or "auto", join=False)
+                         host_ip=args.host_ip or "auto",
+                         pscmd=_pscmd(args), join=False)
     return _await_job(tracker, failures, threads)
 
 
@@ -469,7 +511,7 @@ def submit_sge(args):
             subprocess.check_call(cmd + [path])
 
     return submit_job(args.num_workers, args.num_servers, fun_submit,
-                      host_ip=args.host_ip or "auto")
+                      host_ip=args.host_ip or "auto", pscmd=_pscmd(args))
 
 
 def build_slurm_cmd(args, envs: Dict[str, str], role: str,
@@ -503,5 +545,6 @@ def submit_slurm(args):
         threads.extend(_reap_procs(procs, failures))
 
     tracker = submit_job(args.num_workers, args.num_servers, fun_submit,
-                         host_ip=args.host_ip or "auto", join=False)
+                         host_ip=args.host_ip or "auto",
+                         pscmd=_pscmd(args), join=False)
     return _await_job(tracker, failures, threads)

@@ -277,6 +277,15 @@ class RabitTracker:
             pass
 
 
+def free_port(host_ip: str = "127.0.0.1") -> int:
+    """Find a currently-free TCP port on ``host_ip`` without holding it."""
+    probe = socket.socket()
+    probe.bind((host_ip, 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    return port
+
+
 class PSTracker:
     """Parameter-server scheduler bootstrap (tracker.py:336-386 analog):
     runs the scheduler process locally with the PS env contract."""
@@ -286,17 +295,10 @@ class PSTracker:
         self.host_ip = host_ip
         self.cmd = cmd
         self.thread = None
+        self.error: Optional[BaseException] = None
+        self.port = free_port(host_ip)
         if cmd is None:
-            # find a free port for the scheduler without holding it
-            probe = socket.socket()
-            probe.bind((host_ip, 0))
-            self.port = probe.getsockname()[1]
-            probe.close()
             return
-        probe = socket.socket()
-        probe.bind((host_ip, 0))
-        self.port = probe.getsockname()[1]
-        probe.close()
         env = os.environ.copy()
         env.update(envs)
         env.update({
@@ -306,7 +308,14 @@ class PSTracker:
         })
 
         def run():
-            subprocess.check_call(self.cmd, shell=True, env=env)
+            # a dead scheduler must abort the job fast, not leave every
+            # worker hanging on DMLC_PS_ROOT_PORT — record the failure
+            # for _await_job/join instead of losing it in a daemon thread
+            try:
+                subprocess.check_call(self.cmd, shell=True, env=env)
+            except BaseException as e:
+                self.error = e
+                logger.error("PS scheduler died: %s", e)
 
         self.thread = threading.Thread(target=run, daemon=True)
         self.thread.start()
@@ -317,9 +326,15 @@ class PSTracker:
             "DMLC_PS_ROOT_PORT": str(self.port),
         }
 
+    def alive(self) -> bool:
+        return self.thread is not None and self.thread.is_alive()
+
     def join(self) -> None:
         if self.thread is not None:
             self.thread.join()
+        if self.error is not None:
+            raise RuntimeError(
+                f"PS scheduler failed: {self.error}") from self.error
 
 
 def submit_job(n_workers: int, n_servers: int, fun_submit, host_ip: str = "auto",
@@ -333,7 +348,17 @@ def submit_job(n_workers: int, n_servers: int, fun_submit, host_ip: str = "auto"
         host_ip = os.environ.get("DMLC_TRACKER_URI") or _default_host_ip()
     envs = {"DMLC_NUM_WORKER": str(n_workers),
             "DMLC_NUM_SERVER": str(n_servers)}
-    rabit = None
+    # The jax.distributed coordinator is a gRPC service that rank 0 of the
+    # JOB must host — it cannot share DMLC_TRACKER_PORT, which is the rabit
+    # tracker's own listener in THIS process.  The tracker owns port
+    # assignment, so it hands out a distinct free port; the URI defaults to
+    # the tracker host (right for local jobs; gang backends override it
+    # with the host where task 0 is placed).  The freeness probe runs on
+    # THIS machine — for remote coordinators it is only a sane default;
+    # override with --env DMLC_JAX_COORD_PORT=... if it collides there.
+    envs["DMLC_JAX_COORD_URI"] = host_ip
+    envs["DMLC_JAX_COORD_PORT"] = str(free_port(host_ip))
+    rabit = ps = None
     if n_servers == 0:
         rabit = RabitTracker(host_ip, n_workers)
         envs.update(rabit.worker_envs())
@@ -344,7 +369,11 @@ def submit_job(n_workers: int, n_servers: int, fun_submit, host_ip: str = "auto"
     fun_submit(n_workers, n_servers, envs)
     if join and rabit is not None:
         rabit.join()
-    return rabit
+    if join and ps is not None:
+        ps.join()  # raises if the scheduler died — sge has no _await_job
+    # PS path returns the PSTracker so callers (_await_job) can watch the
+    # scheduler's liveness/error the same way they watch the rabit tracker
+    return rabit if rabit is not None else ps
 
 
 def _default_host_ip() -> str:

@@ -65,6 +65,13 @@ def main():
     from dmlc_tpu.models import (TransformerConfig, init_params,
                                  make_train_step)
     from dmlc_tpu.parallel import build_mesh
+    from dmlc_tpu.parallel.collectives import initialize_distributed
+
+    # under dmlc-submit with world > 1 this joins every launched process
+    # into one jax.distributed job (coordinator allocated by the tracker,
+    # DMLC_JAX_COORD_URI/PORT) so jax.devices() below spans the whole pod;
+    # no-op single-process
+    initialize_distributed()
 
     n_dev = len(jax.devices())
     mesh = build_mesh(n_dev, dp=n_dev, sp=1, tp=1, pp=1, ep=1)
