@@ -36,7 +36,8 @@ namespace {
 constexpr int32_t kMagic = 0xff99;
 constexpr long kMaxFrame = 0x7fffffffL;  // int32 length frames: < 2 GiB
 constexpr int kBrokerRetries = 50;       // ~10 s of peer-dial retries
-constexpr long kChunk = 256 << 10;       // streaming chunk (multiple of 8)
+constexpr long kChunk = 512 << 10;       // streaming chunk (multiple of 8)
+constexpr long kLag = 8;                 // up/down pipeline window (chunks)
 
 thread_local std::string g_init_error;
 
@@ -386,24 +387,43 @@ static int tree_allreduce_bytes(DmlcComm* c, void* data, long count,
   std::vector<int> kids = c->children();
   char* p = static_cast<char*>(data);
   if (!size_handshake(c, kids, nbytes)) return -1;
-  // upward: per chunk, fold every child's contribution then forward
-  for (long off = 0; off < nbytes; off += kChunk) {
-    const long n = std::min(kChunk, nbytes - off);
-    for (int ch : kids) {
-      if (!c->links[ch].recv_all(tmp.data(), n)) return -1;
-      if (fold_bytes(p + off, tmp.data(), n / esize, dtype, op) != 0)
-        return -2;
+  // Fused up/down pipeline with a kLag-chunk window.  The two-phase
+  // version (full upward pass, then full downward pass) made the root
+  // store-and-forward the entire payload between phases, so large
+  // payloads paid two serialized traversals — the round-3 64 MB
+  // regression.  Here chunk ci climbs the tree while chunk ci-kLag,
+  // already reduced at the root, streams back down; the window keeps
+  // kLag×kChunk bytes in flight per direction, hiding the root
+  // round-trip without threads.
+  //
+  // Deadlock-freedom (blocking sockets): every rank forwards upward
+  // chunk ci before waiting on downward chunk ci-kLag.  A blocked-send
+  // cycle would need a child simultaneously ahead of its parent (to
+  // fill the parent's upward recv buffer) and behind it (to fill its
+  // own downward recv buffer) — the two conditions contradict, so one
+  // side of any would-be cycle always drains.
+  const long nchunks = (nbytes + kChunk - 1) / kChunk;
+  for (long ci = 0; ci < nchunks + kLag; ++ci) {
+    if (ci < nchunks) {
+      const long off = ci * kChunk;
+      const long n = std::min(kChunk, nbytes - off);
+      for (int ch : kids) {
+        if (!c->links[ch].recv_all(tmp.data(), n)) return -1;
+        if (fold_bytes(p + off, tmp.data(), n / esize, dtype, op) != 0)
+          return -2;
+      }
+      if (c->parent >= 0 && !c->links[c->parent].send_all(p + off, n))
+        return -1;
     }
-    if (c->parent >= 0 && !c->links[c->parent].send_all(p + off, n))
-      return -1;
-  }
-  // downward: stream the reduced chunks back out
-  for (long off = 0; off < nbytes; off += kChunk) {
-    const long n = std::min(kChunk, nbytes - off);
-    if (c->parent >= 0 && !c->links[c->parent].recv_all(p + off, n))
-      return -1;
-    for (int ch : kids)
-      if (!c->links[ch].send_all(p + off, n)) return -1;
+    const long dj = ci - kLag;
+    if (dj >= 0 && dj < nchunks) {
+      const long off = dj * kChunk;
+      const long n = std::min(kChunk, nbytes - off);
+      if (c->parent >= 0 && !c->links[c->parent].recv_all(p + off, n))
+        return -1;
+      for (int ch : kids)
+        if (!c->links[ch].send_all(p + off, n)) return -1;
+    }
   }
   return 0;
 }

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Regenerate performance prose FROM benchmark artifacts.
+
+Round 3 ended with three documents quoting three different numbers for
+the same metric (README vs BASELINE.md vs BENCH_collective.json).  This
+script makes drift structurally impossible: the blocks between
+``<!-- perf:auto --> / <!-- /perf:auto -->`` markers in README.md and
+BASELINE.md are owned by this script and rewritten verbatim from
+
+  - the newest ``BENCH_r*.json`` (driver artifact), or a bench.py JSON
+    line passed as argv[1]
+  - ``BENCH_collective.json`` (scripts/bench_collective.py output)
+
+Run after every bench refresh:  python scripts/update_perf_docs.py
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_bench():
+    """Newest driver artifact's parsed bench line, or argv[1] JSON."""
+    if len(sys.argv) > 1:
+        with open(sys.argv[1]) as f:
+            text = f.read().strip()
+        # accept either a raw bench.py line or a BENCH_r*.json wrapper
+        obj = json.loads(text.splitlines()[-1])
+        return obj.get("parsed", obj)
+    paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    if not paths:
+        raise SystemExit("no BENCH_r*.json artifact found")
+    with open(paths[-1]) as f:
+        return json.load(f)["parsed"]
+
+
+def load_collective():
+    with open(os.path.join(REPO, "BENCH_collective.json")) as f:
+        return json.load(f)
+
+
+def fmt_bench_lines(bench, coll):
+    x = bench.get("extra_metrics", {})
+    read_gbps = bench["value"] / 1e3
+    lines = [
+        f"- RecordIO InputSplit read: **{read_gbps:.1f} GB/s**, "
+        f"{bench['vs_baseline']:.1f}× the reference C++ on the same machine "
+        "and file (which our writer produced — every run re-proves "
+        "bit-exact format compatibility).",
+    ]
+    if "indexed_shuffled_vs_baseline" in x:
+        lines.append(
+            f"- Shuffled IndexedRecordIO: "
+            f"{x['indexed_shuffled_vs_baseline']:.2f}× the reference "
+            f"({x['indexed_shuffled_read_MBps'] / 1e3:.1f} GB/s).")
+    if "transformer_mfu_pct" in x:
+        lm = (f"- Flagship 1B bf16 LM, full AdamW step: "
+              f"**{x['transformer_tokens_per_s'] / 1e3:.1f}k tokens/s, "
+              f"{x['transformer_mfu_pct']:.1f}% MFU** at T=1024")
+        if "transformer_mfu_long_pct" in x:
+            lm += (f"; **{x['transformer_mfu_long_pct']:.1f}% MFU** at "
+                   "T=8192 (flash kernels, no T×T materialization, "
+                   "save_flash remat policy)")
+        lines.append(lm + ".")
+    if "recordio_feed_padded_MBps" in x:
+        feed = (f"- RecordIO→HBM feed: padded "
+                f"{x['recordio_feed_padded_MBps']:.1f} MB/s, packed "
+                f"{x.get('recordio_feed_to_hbm_MBps', 0):.1f} MB/s against "
+                f"a measured device_put link ceiling of "
+                f"{x.get('device_put_ceiling_MBps', 0):.1f} MB/s on this "
+                "dev chip's tunnel (the feed is link-bound here).")
+        lines.append(feed)
+    big = next((r for r in coll["results"]
+                if r["op"] == "allreduce" and r["bytes"] == 64 << 20), None)
+    mid = next((r for r in coll["results"]
+                if r["op"] == "allreduce" and r["bytes"] == 1 << 20), None)
+    if big and mid:
+        lines.append(
+            f"- Native collective ABI, n={coll['world']} on one core: "
+            f"{mid['aggregate_link_MBps'] / 1e3:.1f} GB/s aggregate link "
+            f"throughput at 1 MB; at 64 MB the fused up/down tree pipeline "
+            f"moves {big['aggregate_link_MBps'] / 1e3:.1f} GB/s aggregate = "
+            f"**{coll['allreduce_64MB_link_vs_loopback']:.2f}× the host's "
+            f"single-stream loopback line rate** "
+            f"({coll['loopback_MBps'] / 1e3:.1f} GB/s), i.e. transport "
+            "saturation (algbw "
+            f"{big['algbw_MBps']:.0f} MB/s, busbw {big['busbw_MBps']:.0f}).")
+    return lines
+
+
+MARK = re.compile(r"<!-- perf:auto -->.*?<!-- /perf:auto -->", re.S)
+
+
+def rewrite(path, block):
+    with open(path) as f:
+        text = f.read()
+    if not MARK.search(text):
+        raise SystemExit(f"{path}: no <!-- perf:auto --> block")
+    repl = "<!-- perf:auto -->\n" + block + "\n<!-- /perf:auto -->"
+    new = MARK.sub(lambda m: repl, text)  # lambda: no regex-escape mangling
+    with open(path, "w") as f:
+        f.write(new)
+    print(f"updated {path}")
+
+
+def main():
+    bench, coll = load_bench(), load_collective()
+    for key in ("transformer_mfu_long_pct", "indexed_shuffled_vs_baseline"):
+        if key not in bench.get("extra_metrics", {}):
+            print(f"WARNING: {key} missing from bench artifact — its doc "
+                  "line is omitted (data gap, not a retraction)")
+    lines = fmt_bench_lines(bench, coll)
+    block = "\n".join(lines)
+    rewrite(os.path.join(REPO, "README.md"), block)
+    rewrite(os.path.join(REPO, "BASELINE.md"), block)
+
+
+if __name__ == "__main__":
+    main()
