@@ -28,8 +28,12 @@ def load_bench():
     if len(sys.argv) > 1:
         with open(sys.argv[1]) as f:
             text = f.read().strip()
-        # accept either a raw bench.py line or a BENCH_r*.json wrapper
-        obj = json.loads(text.splitlines()[-1])
+        # accept either a BENCH_r*.json wrapper (pretty-printed, has a
+        # "parsed" key) or a raw one-line bench.py output
+        try:
+            obj = json.loads(text)
+        except json.JSONDecodeError:
+            obj = json.loads(text.splitlines()[-1])
         return obj.get("parsed", obj)
     paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
     if not paths:
@@ -57,11 +61,11 @@ def fmt_bench_lines(bench, coll):
             f"- Shuffled IndexedRecordIO: "
             f"{x['indexed_shuffled_vs_baseline']:.2f}× the reference "
             f"({x['indexed_shuffled_read_MBps'] / 1e3:.1f} GB/s).")
-    if "transformer_mfu_pct" in x:
+    if x.get("transformer_mfu_pct") is not None:  # null on unknown chips
         lm = (f"- Flagship 1B bf16 LM, full AdamW step: "
               f"**{x['transformer_tokens_per_s'] / 1e3:.1f}k tokens/s, "
               f"{x['transformer_mfu_pct']:.1f}% MFU** at T=1024")
-        if "transformer_mfu_long_pct" in x:
+        if x.get("transformer_mfu_long_pct") is not None:
             lm += (f"; **{x['transformer_mfu_long_pct']:.1f}% MFU** at "
                    "T=8192 (flash kernels, no T×T materialization, "
                    "save_flash remat policy)")
