@@ -400,6 +400,31 @@ long dmlc_recordio_find_last(const uint8_t* buf, long n, uint32_t magic) {
   return 0;
 }
 
-int dmlc_native_abi_version() { return 2; }
+// Shuffled-batch span gather (indexed_recordio_split.cc:158-211 role):
+// copy n record spans from one mapped file into a packed output buffer.
+// The copy VISITS spans in ascending source offset (order[] is the
+// argsort of offs — sequential page touch restores readahead/cache
+// locality that a shuffled walk destroys) while WRITING each span at
+// dst_off[j], its position in the shuffled batch — so the output keeps
+// the kRandMagic permutation order byte-for-byte.  Returns bytes copied
+// or -1 on bounds violation (src_len guards a corrupt index).
+long dmlc_gather_spans(const char* src, long src_len, char* dst,
+                       const int64_t* offs, const int64_t* lens,
+                       const int64_t* dst_off, const int64_t* order,
+                       long n) {
+  long total = 0;
+  for (long i = 0; i < n; ++i) {
+    const long j = order != nullptr ? static_cast<long>(order[i]) : i;
+    const int64_t off = offs[j], len = lens[j];
+    // overflow-free bounds check: off+len could wrap for a hostile index
+    if (off < 0 || len < 0 || off > src_len || len > src_len - off)
+      return -1;
+    memcpy(dst + dst_off[j], src + off, static_cast<size_t>(len));
+    total += len;
+  }
+  return total;
+}
+
+int dmlc_native_abi_version() { return 3; }
 
 }  // extern "C"

@@ -402,26 +402,31 @@ def recordio_packed_feed(uri: str, mesh, *, buf_bytes: int,
     n_parts = cfg.data_parts
 
     def part_iter(part: int):
+        from .. import native
+
         split = input_split.create(uri, part, n_parts, "recordio")
         try:
-            views: list = []      # np views/copies of pending records
-            pend = 0              # pending payload bytes
+            # pending records live as ONE contiguous payload array + a
+            # length vector — each chunk's payloads are packed by a
+            # single native gather (ascending spans, identity order), so
+            # there is no per-record Python view loop anywhere on this
+            # path (the round-3 reason packed lost to padded)
+            pend_payload = np.empty(0, np.uint8)
+            pend_lens = np.empty(0, np.int64)
+            at_eof = False
 
-            def emit():
-                nonlocal views, pend
-                n = min(len(views), max_records)
-                take, views = views[:n], views[n:]
+            def emit(n: int, ends: np.ndarray):
+                nonlocal pend_payload, pend_lens
                 data = np.zeros(buf_bytes, np.uint8)
-                lens = np.fromiter((v.size for v in take), np.int64,
-                                   count=n)
-                packed = np.concatenate(take) if len(take) > 1 else take[0]
-                m = min(packed.size, buf_bytes)
-                data[:m] = packed[:m]
+                cut = int(ends[n - 1])
+                m = min(cut, buf_bytes)
+                data[:m] = pend_payload[:m]
                 offsets = np.zeros(max_records + 1, np.int64)
-                np.cumsum(lens, out=offsets[1: n + 1])
+                offsets[1: n + 1] = ends[:n]
                 np.minimum(offsets, buf_bytes, out=offsets)
                 offsets[n + 1:] = offsets[n]
-                pend = sum(v.size for v in views)
+                pend_payload = pend_payload[cut:]
+                pend_lens = pend_lens[n:]
                 return {"data": data,
                         "offsets": offsets.astype(np.int32),
                         "count": np.array([n], np.int32)}
@@ -429,18 +434,37 @@ def recordio_packed_feed(uri: str, mesh, *, buf_bytes: int,
             while True:
                 mv = split.next_chunk()
                 if mv is None:
+                    at_eof = True
+                else:
+                    sp = _chunk_spans(mv)
+                    packed = None
+                    if (sp[:, 2] == 0).all():
+                        offs = sp[:, 0].astype(np.int64)
+                        lens = sp[:, 1].astype(np.int64)
+                        packed = native.gather_spans(mv, offs, lens)
+                    if packed is None:  # no native, or escaped-magic recs
+                        views = _chunk_record_views(mv)
+                        lens = np.fromiter((v.size for v in views),
+                                           np.int64, count=len(views))
+                        packed = (np.concatenate(views) if views
+                                  else np.empty(0, np.uint8))
+                    pend_payload = (np.concatenate([pend_payload, packed])
+                                    if pend_payload.size else packed)
+                    pend_lens = (np.concatenate([pend_lens, lens])
+                                 if pend_lens.size else lens)
+                while pend_lens.size:
+                    ends = np.cumsum(pend_lens)
+                    n = int(np.searchsorted(ends, buf_bytes, side="right"))
+                    n = min(n, max_records, pend_lens.size)
+                    if n == 0:
+                        n = 1  # one record larger than buf_bytes: truncate
+                    if (n == pend_lens.size and not at_eof
+                            and int(ends[-1]) <= buf_bytes
+                            and n < max_records):
+                        break  # batch not full yet; read more chunks
+                    yield emit(n, ends)
+                if at_eof:
                     break
-                for v in _chunk_record_views(mv):
-                    if views and (pend + v.size > buf_bytes
-                                  or len(views) >= max_records):
-                        yield emit()
-                    views.append(v)
-                    pend += v.size
-                # chunk buffer may be recycled on the next next_chunk():
-                # materialize leftover views
-                views = [v if v.flags.owndata else v.copy() for v in views]
-            while views:
-                yield emit()
         finally:
             split.close()
 

@@ -892,6 +892,11 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
         for j in range(len(offsets) - 1):
             self._index.append((offsets[j], offsets[j + 1] - offsets[j]))
         self._index.append((offsets[-1], total - offsets[-1]))
+        import numpy as np
+
+        # [N, 2] (offset, length) twin of self._index for vectorized
+        # batch span math on the shuffled hot path
+        self._index_np = np.asarray(self._index, dtype=np.int64)
 
     @property
     def num_index_records(self) -> int:
@@ -986,15 +991,27 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
                 return None
             self._current_index += len(take)
             self._n_overflow = n - len(take)
-            spans = [self._index[j] for j in take]
             if self._mmap_ok:
-                # zero-copy views into the maps, packed by ONE C-level
-                # concatenate — no per-record Python memcpy loop
                 import numpy as np
 
+                from .. import native
+
+                span_np = self._index_np[np.asarray(take, dtype=np.int64)]
+                offs, lens = span_np[:, 0], span_np[:, 1]
+                self._offset_curr = int(offs[-1] + lens[-1])
+                if len(self._files) == 1:
+                    # ONE native call: spans are copied in ascending file
+                    # offset (page locality the shuffle destroyed) but
+                    # written in batch order, so the kRandMagic
+                    # permutation survives byte-for-byte
+                    out = native.gather_spans(self._np_map(0), offs, lens)
+                    if out is not None:
+                        return memoryview(out)
+                # fallback (no native / multi-file): zero-copy views into
+                # the maps, packed by one C-level concatenate
                 file_offset = self._file_offset
                 views = []
-                for off, ln in spans:
+                for off, ln in ((int(o), int(l)) for o, l in span_np):
                     fj = bisect_right(file_offset, off) - 1
                     base = file_offset[fj]
                     if off + ln <= file_offset[fj + 1]:
@@ -1006,8 +1023,8 @@ class IndexedRecordIOSplitter(RecordIOSplitter):
                         views.append(tmp)
                 out = (np.concatenate(views) if len(views) > 1
                        else views[0].copy())
-                self._offset_curr = spans[-1][0] + spans[-1][1]
                 return memoryview(out)
+            spans = [self._index[j] for j in take]
             out = bytearray(sum(ln for _, ln in spans))
             mv = memoryview(out)
             at = 0

@@ -77,6 +77,14 @@ def fmt_bench_lines(bench, coll):
                 f"a measured device_put link ceiling of "
                 f"{x.get('device_put_ceiling_MBps', 0):.1f} MB/s on this "
                 "dev chip's tunnel (the feed is link-bound here).")
+        pe, de = (x.get("feed_packed_shipped_efficiency"),
+                  x.get("feed_padded_shipped_efficiency"))
+        if pe is not None and de is not None:
+            feed += (f" Payload÷shipped bytes: packed {pe:.2f} vs padded "
+                     f"{de:.2f} — on a non-compressing link (real "
+                     "PCIe/DMA) the packed layout wins by that ratio; "
+                     "this tunnel compresses, so the padded zeros travel "
+                     "nearly free here.")
         lines.append(feed)
     big = next((r for r in coll["results"]
                 if r["op"] == "allreduce" and r["bytes"] == 64 << 20), None)
