@@ -25,7 +25,9 @@ def split(s: str, delim: str) -> List[str]:
 
 def hash_combine(seed: int, value: int) -> int:
     """Boost-style hash combine (common.h:39-45), 64-bit wrap."""
-    return (seed ^ (value + 0x9E3779B9 + ((seed << 6) & 0xFFFFFFFFFFFFFFFF) + (seed >> 2))) & 0xFFFFFFFFFFFFFFFF
+    mask = 0xFFFFFFFFFFFFFFFF
+    return (seed ^ (value + 0x9E3779B9 + ((seed << 6) & mask)
+                    + (seed >> 2))) & mask
 
 
 def get_time() -> float:

@@ -191,7 +191,9 @@ def create_row_iter(
         # a completed cache makes the source optional (lazy parser creation;
         # improves on the reference, which constructs the parser eagerly)
         if os.path.exists(spec.cache_file) and os.path.exists(spec.cache_file + ".meta"):
-            return DiskRowIter(_LazyParser(uri, part_index, num_parts, type, extra_args), spec.cache_file)
+            return DiskRowIter(
+                _LazyParser(uri, part_index, num_parts, type, extra_args),
+                spec.cache_file)
         parser = create_parser(uri, part_index, num_parts, type, **extra_args)
         return DiskRowIter(parser, spec.cache_file)
     parser = create_parser(uri, part_index, num_parts, type, **extra_args)

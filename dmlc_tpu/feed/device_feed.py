@@ -242,8 +242,14 @@ class DeviceFeed:
             t.join(timeout=0.05)
         if t is None or not t.is_alive():
             self._thread = None
-        # else: keep _thread set so __iter__'s in-flight guard still
-        # refuses to start a second producer over live shared state
+        else:
+            # keep _thread set so __iter__'s in-flight guard still
+            # refuses to start a second producer over live shared state
+            from ..logging import warning
+
+            warning(
+                "DeviceFeed.close(): producer thread still alive after "
+                "5s (likely a hung device_put); leaking a daemon thread")
 
     @property
     def bytes_fed(self) -> int:

@@ -66,6 +66,9 @@ class TransformerConfig:
     remat_policy: str = "save_flash"
     moe_topk: int = 0          # 0 = dense soft gating; k>0 = routed top-k
     moe_capacity_factor: float = 1.25  # slots per expert vs perfect balance
+    # observe capacity-overflow token drops via a metrics counter (debug
+    # callback per step — off by default: it adds a host sync point)
+    moe_debug_overflow: bool = False
 
     @property
     def jdtype(self):
@@ -252,6 +255,16 @@ def _moe_topk_ffn(x, p, axes: ShardAxes, cfg: "TransformerConfig"):
     pos = jnp.sum((jnp.cumsum(oh, axis=0) - 1) * oh, axis=-1)  # [n·k]
     keep = (local & (pos < capacity))
     pos_c = jnp.minimum(pos, capacity - 1)
+    if cfg.moe_debug_overflow:
+        # dropped-choice fraction on THIS shard: overflowed (token,
+        # choice) pairs silently contribute residual only, so load
+        # imbalance is invisible without this signal (metrics stage
+        # "moe": overflow_fraction_sum / overflow_checks = mean rate)
+        n_local_choices = jnp.sum(local.astype(jnp.float32))
+        n_dropped = n_local_choices - jnp.sum(keep.astype(jnp.float32))
+        jax.debug.callback(
+            _record_moe_overflow,
+            n_dropped / jnp.maximum(n_local_choices, 1.0))
 
     # dispatch: [X_local, C, E] — owned tokens scattered unweighted
     xk = jnp.repeat(xf, k, axis=0) * keep[:, None].astype(xf.dtype)
@@ -273,6 +286,13 @@ def _moe_topk_ffn(x, p, axes: ShardAxes, cfg: "TransformerConfig"):
     if reduce_axes:
         y = lax.psum(y, reduce_axes)
     return y.astype(x.dtype)
+
+
+def _record_moe_overflow(frac) -> None:
+    from .. import metrics
+
+    metrics.inc("moe", "overflow_checks")
+    metrics.inc("moe", "overflow_fraction_sum", float(frac))
 
 
 def _moe_ffn(x, p, axes: ShardAxes, cfg: "TransformerConfig"):

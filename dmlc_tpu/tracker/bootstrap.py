@@ -39,6 +39,18 @@ def unpack_archives(names, workdir):
                 try:
                     t.extractall(workdir, filter="data")  # no path traversal
                 except TypeError:  # Python < 3.12: no filter= kwarg
+                    # manual screen: absolute paths, .. components, and
+                    # links pointing outside the cache dir are rejected —
+                    # a shipped archive must not escape workdir
+                    for m in t.getmembers():
+                        parts = m.name.split("/")
+                        if (m.name.startswith("/") or ".." in parts
+                                or not (m.isfile() or m.isdir())):
+                            # allow-list plain files/dirs: links escape
+                            # the dir, FIFOs/devices hang later readers
+                            raise ValueError(
+                                f"unsafe archive member {m.name!r} in "
+                                f"{name!r}")
                     t.extractall(workdir)
 
 

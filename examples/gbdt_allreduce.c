@@ -1,0 +1,171 @@
+/* Distributed histogram-gradient boosted trees over the dmlc_tpu
+ * collective C ABI — BASELINE config #4: the XGBoost drop-in story
+ * (reference README.md:9 "dmlc-core ... the bricks to build efficient
+ * and scalable distributed machine learning libraries").
+ *
+ * dmlc_comm_allreduce is the ONLY transport: every worker holds a
+ * row-slice of a deterministic synthetic dataset, builds per-node
+ * (grad, hess) histograms locally, allreduces them, and every worker
+ * grows the identical tree from the global histograms — exactly the
+ * rabit allreduce pattern XGBoost's hist updater uses.  Run it under
+ * the real launcher:
+ *
+ *   bin/dmlc-submit --cluster local --num-workers 4 -- ./gbdt_allreduce
+ *
+ * A single-process run produces the same model (up to fp reduction
+ * order), so the multi-worker RMSE must match the world=1 RMSE —
+ * tests/test_collective_abi.py asserts that.
+ */
+#define _POSIX_C_SOURCE 199309L
+#include "dmlc_collective.h"
+
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define N_SAMPLES 2000
+#define N_FEAT 8
+#define N_BIN 16
+#define DEPTH 3
+#define ROUNDS 10
+#define ETA 0.5
+#define LAMBDA 1.0
+#define MAX_LEAVES (1 << DEPTH)
+
+static unsigned long long lcg_state = 0x2545F4914F6CDD1DULL;
+static double lcg_uniform(void) { /* deterministic across platforms */
+  lcg_state = lcg_state * 6364136223846793005ULL + 1442695040888963407ULL;
+  return (double)((lcg_state >> 11) & ((1ULL << 53) - 1)) / (double)(1ULL << 53);
+}
+
+typedef struct {
+  int feat, bin;        /* split: go left if xbin[feat] <= bin */
+  double weight;        /* leaf value (only at leaves) */
+  int is_leaf;
+} Node;
+
+int main(void) {
+  DmlcComm* c = dmlc_comm_init();
+  if (c == NULL) {
+    fprintf(stderr, "gbdt: init failed: %s\n", dmlc_comm_last_error(NULL));
+    return 1;
+  }
+  const int rank = dmlc_comm_rank(c), world = dmlc_comm_world_size(c);
+
+  /* Every worker generates the FULL dataset deterministically and works
+   * on its row slice — the global model is a pure function of the
+   * allreduced histograms. */
+  static double x[N_SAMPLES][N_FEAT];
+  static int xbin[N_SAMPLES][N_FEAT];
+  static double y[N_SAMPLES], pred[N_SAMPLES];
+  for (int i = 0; i < N_SAMPLES; ++i) {
+    for (int f = 0; f < N_FEAT; ++f) {
+      x[i][f] = lcg_uniform();
+      xbin[i][f] = (int)(x[i][f] * N_BIN);
+      if (xbin[i][f] >= N_BIN) xbin[i][f] = N_BIN - 1;
+    }
+    y[i] = (x[i][0] > 0.5 ? 2.0 : -1.0) + (x[i][1] > 0.3 ? x[i][2] : 0.0) +
+           0.25 * x[i][3] + 0.01 * (lcg_uniform() - 0.5);
+    pred[i] = 0.0;
+  }
+  const int lo = rank * N_SAMPLES / world, hi = (rank + 1) * N_SAMPLES / world;
+
+  static Node tree[ROUNDS][2 * MAX_LEAVES]; /* heap layout, root at 1 */
+  static int node_of[N_SAMPLES];
+
+  for (int r = 0; r < ROUNDS; ++r) {
+    Node* t = tree[r];
+    for (int i = 0; i < 2 * MAX_LEAVES; ++i) {
+      t[i].is_leaf = 0; t[i].weight = 0.0; t[i].feat = -1; t[i].bin = -1;
+    }
+    for (int i = 0; i < N_SAMPLES; ++i) node_of[i] = 1;
+    int level_begin = 1, level_count = 1;
+    for (int depth = 0; depth <= DEPTH; ++depth) {
+      /* one histogram buffer for the whole level: [node][feat][bin][2] */
+      static double hist[MAX_LEAVES * N_FEAT * N_BIN * 2];
+      const long hn = (long)level_count * N_FEAT * N_BIN * 2;
+      memset(hist, 0, hn * sizeof(double));
+      for (int i = lo; i < hi; ++i) {
+        const int nd = node_of[i];
+        if (nd < level_begin || nd >= level_begin + level_count) continue;
+        const double g = pred[i] - y[i], h = 1.0; /* squared loss */
+        double* base = hist + (long)(nd - level_begin) * N_FEAT * N_BIN * 2;
+        for (int f = 0; f < N_FEAT; ++f) {
+          double* cell = base + ((long)f * N_BIN + xbin[i][f]) * 2;
+          cell[0] += g; cell[1] += h;
+        }
+      }
+      /* THE transport: global histograms via the tree allreduce */
+      if (dmlc_comm_allreduce(c, hist, hn, DMLC_F64, DMLC_SUM) != 0) {
+        fprintf(stderr, "gbdt FAIL rank=%d: allreduce: %s\n", rank,
+                dmlc_comm_last_error(c));
+        return 1;
+      }
+      /* grow every node of this level from the SAME global histograms */
+      for (int n = 0; n < level_count; ++n) {
+        const int nd = level_begin + n;
+        double* base = hist + (long)n * N_FEAT * N_BIN * 2;
+        double gt = 0.0, ht = 0.0;
+        for (int b = 0; b < N_BIN; ++b) { /* feature 0 covers all rows */
+          gt += base[(long)b * 2]; ht += base[(long)b * 2 + 1];
+        }
+        const double parent_score = gt * gt / (ht + LAMBDA);
+        double best_gain = 1e-9; int best_f = -1, best_b = -1;
+        for (int f = 0; f < N_FEAT; ++f) {
+          double gl = 0.0, hl = 0.0;
+          for (int b = 0; b < N_BIN - 1; ++b) {
+            gl += base[((long)f * N_BIN + b) * 2];
+            hl += base[((long)f * N_BIN + b) * 2 + 1];
+            const double gr = gt - gl, hr = ht - hl;
+            if (hl < 1.0 || hr < 1.0) continue;
+            const double gain = gl * gl / (hl + LAMBDA) +
+                                gr * gr / (hr + LAMBDA) - parent_score;
+            if (gain > best_gain) { best_gain = gain; best_f = f; best_b = b; }
+          }
+        }
+        if (depth == DEPTH || best_f < 0 || ht <= 0.0) {
+          t[nd].is_leaf = 1;
+          t[nd].weight = (ht + LAMBDA) > 0 ? -gt / (ht + LAMBDA) : 0.0;
+        } else {
+          t[nd].feat = best_f; t[nd].bin = best_b;
+        }
+      }
+      /* route samples one level down (every rank routes its slice) */
+      int next_begin = level_begin * 2, next_count = 0;
+      for (int i = lo; i < hi; ++i) {
+        const int nd = node_of[i];
+        if (nd < level_begin || nd >= level_begin + level_count) continue;
+        if (t[nd].is_leaf) continue;
+        node_of[i] = 2 * nd + (xbin[i][t[nd].feat] <= t[nd].bin ? 0 : 1);
+      }
+      next_count = level_count * 2;
+      level_begin = next_begin; level_count = next_count;
+      if (level_begin >= 2 * MAX_LEAVES) break;
+    }
+    /* apply the round's tree to this rank's slice */
+    for (int i = lo; i < hi; ++i) {
+      int nd = 1;
+      while (!t[nd].is_leaf) nd = 2 * nd + (xbin[i][t[nd].feat] <= t[nd].bin ? 0 : 1);
+      pred[i] += ETA * t[nd].weight;
+    }
+  }
+
+  /* global RMSE via the same transport */
+  double acc[2] = {0.0, 0.0};
+  for (int i = lo; i < hi; ++i) {
+    const double e = pred[i] - y[i];
+    acc[0] += e * e; acc[1] += 1.0;
+  }
+  if (dmlc_comm_allreduce(c, acc, 2, DMLC_F64, DMLC_SUM) != 0) {
+    fprintf(stderr, "gbdt FAIL rank=%d: final allreduce\n", rank);
+    return 1;
+  }
+  const double rmse = sqrt(acc[0] / acc[1]);
+  char msg[128];
+  snprintf(msg, sizeof msg, "rank %d/%d: gbdt rmse=%.6f", rank, world, rmse);
+  dmlc_comm_log(c, msg);
+  if (rank == 0) printf("gbdt rmse=%.6f n=%.0f\n", rmse, acc[1]);
+  dmlc_comm_shutdown(c);
+  return 0;
+}

@@ -110,6 +110,12 @@ def main():
     skip = start_at or 0
     while done < steps:
         for batch in feed:
+            # epoch-tail short batch: its zero-padded rows would train on
+            # all-zero tokens (garbage targets).  Dropped BEFORE the
+            # resume fast-forward so never-trained batches don't consume
+            # `skip` — step count stays equal to trained-batch count
+            if np.any(np.asarray(batch["length"]) == 0):
+                continue
             if skip > 0:
                 skip -= 1
                 continue

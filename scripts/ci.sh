@@ -23,6 +23,9 @@ python -m compileall -q dmlc_tpu tests scripts examples bin \
     bench.py __graft_entry__.py \
     || { echo "FAIL: syntax errors"; exit 1; }
 
+echo "== stage 0.5: lint gate (scripts/lint.py) =="
+python scripts/lint.py || { echo "FAIL: lint findings"; exit 1; }
+
 echo "== stage 1: native build =="
 NATIVE_OK=0
 if command -v g++ >/dev/null 2>&1; then

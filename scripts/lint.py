@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Static style/correctness gate (reference scripts/lint.py role).
+
+The reference repo gated CI on pylint + cpplint (.travis.yml:8-16); this
+image ships no third-party linter, so the same role is filled with an
+AST walk over every repo Python file checking the high-value classes:
+
+  * unused imports          (dead weight; masks real dependencies)
+  * bare ``except:``        (swallows KeyboardInterrupt/SystemExit)
+  * mutable default args    (shared-state bugs)
+  * tabs / trailing whitespace
+  * lines over 100 columns
+
+Exit 0 clean, 1 with findings (one per line: path:line: message).
+Usage: python scripts/lint.py [paths...]
+"""
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_ROOTS = ["dmlc_tpu", "tests", "scripts", "examples", "bench.py",
+                 "__graft_entry__.py", "bin/dmlc-submit"]
+MAX_COLS = 100
+
+
+def py_files(roots):
+    for root in roots:
+        path = os.path.join(REPO, root)
+        if os.path.isfile(path):
+            yield path
+        else:
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for f in filenames:
+                    if f.endswith(".py"):
+                        yield os.path.join(dirpath, f)
+
+
+class ImportCollector(ast.NodeVisitor):
+    def __init__(self):
+        self.imports = []   # (local_name, lineno, statement_desc)
+        self.used = set()
+
+    def visit_Import(self, node):
+        for a in node.names:
+            local = a.asname or a.name.split(".")[0]
+            self.imports.append((local, node.lineno, a.name))
+
+    def visit_ImportFrom(self, node):
+        if node.module == "__future__":  # directives, not bindings
+            return
+        for a in node.names:
+            if a.name == "*":
+                continue
+            local = a.asname or a.name
+            self.imports.append((local, node.lineno, a.name))
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+
+def check_file(path):
+    findings = []
+    rel = os.path.relpath(path, REPO)
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    for i, line in enumerate(src.splitlines(), 1):
+        if "\t" in line:
+            findings.append(f"{rel}:{i}: tab character")
+        if line != line.rstrip():
+            findings.append(f"{rel}:{i}: trailing whitespace")
+        if len(line) > MAX_COLS:
+            findings.append(f"{rel}:{i}: line longer than {MAX_COLS} cols")
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        findings.append(f"{rel}:{e.lineno}: syntax error: {e.msg}")
+        return findings
+
+    # unused imports — skip __init__.py (re-export surface by design)
+    if os.path.basename(path) != "__init__.py":
+        col = ImportCollector()
+        col.visit(tree)
+        exported = set()
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "__all__"
+                            for t in node.targets)
+                    and isinstance(node.value, (ast.List, ast.Tuple))):
+                exported |= {e.value for e in node.value.elts
+                             if isinstance(e, ast.Constant)}
+        for local, lineno, what in col.imports:
+            if local not in col.used and local not in exported:
+                findings.append(f"{rel}:{lineno}: unused import {what!r}")
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            findings.append(f"{rel}:{node.lineno}: bare except")
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in list(node.args.defaults) + [
+                    d for d in node.args.kw_defaults if d is not None]:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    findings.append(
+                        f"{rel}:{d.lineno}: mutable default argument")
+    return findings
+
+
+def main():
+    roots = sys.argv[1:] or DEFAULT_ROOTS
+    all_findings = []
+    n = 0
+    for path in py_files(roots):
+        n += 1
+        all_findings += check_file(path)
+    for f in all_findings:
+        print(f)
+    print(f"lint: {n} files, {len(all_findings)} findings",
+          file=sys.stderr)
+    return 1 if all_findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,7 +7,6 @@ import pytest
 
 from dmlc_tpu.base import DMLCError
 from dmlc_tpu.data import (
-    BasicRowIter,
     CSVParserParam,
     RowBlockContainer,
     create_parser,

@@ -4,9 +4,7 @@
 import numpy as np
 import pytest
 
-import jax
-
-from dmlc_tpu.feed import (DeviceFeed, libsvm_feed, pack_rowblock,
+from dmlc_tpu.feed import (libsvm_feed, pack_rowblock,
                            recordio_feed, recordio_packed_feed)
 from dmlc_tpu.parallel import build_mesh
 

@@ -304,7 +304,6 @@ def test_gcs_exception_in_with_block_aborts(gcs_server):
 
 def test_gcs_read_api_retries_transient_500(gcs_server, monkeypatch):
     # one-shot 500 on a GET: _api retries and succeeds
-    from dmlc_tpu.io import gcs_filesys
 
     with Stream.create("gs://bkt/retry/read.bin", "w") as s:
         s.write(b"abcdef")

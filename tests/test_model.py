@@ -5,7 +5,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from dmlc_tpu.models import (
     TransformerConfig,
@@ -74,7 +74,7 @@ TOPK_CFG = TransformerConfig(
 def test_topk_moe_matches_masked_dense_oracle():
     """With ample capacity, top-k routing must equal the dense combine
     with probs zeroed outside the top-k and renormalized."""
-    from dmlc_tpu.models.transformer import _moe_dense_ffn, _moe_topk_ffn
+    from dmlc_tpu.models.transformer import _moe_topk_ffn
     from dmlc_tpu.ops.core import ShardAxes
 
     cfg = TOPK_CFG
@@ -122,6 +122,29 @@ def test_topk_moe_sharded_matches_oracle():
     )
     got = float(jax.jit(fn)(params, ids, labels))
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_topk_moe_overflow_counter():
+    """moe_debug_overflow=True must record the dropped-choice fraction
+    in the metrics stage 'moe' (silent drops are undiagnosable)."""
+    from dmlc_tpu import metrics
+
+    cfg = TransformerConfig(
+        vocab=64, d_model=32, n_heads=4, head_dim=8, d_ff=32,
+        n_layers=1, n_experts=4, microbatches=1, moe_topk=2,
+        moe_capacity_factor=0.25,  # force overflow
+        moe_debug_overflow=True)
+    params = init_params(jax.random.PRNGKey(0), cfg, n_stages=1)
+    ids, labels = _data(jax.random.PRNGKey(9), b=4, t=16)
+    before = metrics.snapshot().get("moe", {})
+    float(unsharded_loss(params, ids, labels, cfg))
+    after = metrics.snapshot().get("moe", {})
+    checks = after.get("overflow_checks", 0) - before.get(
+        "overflow_checks", 0)
+    frac = after.get("overflow_fraction_sum", 0.0) - before.get(
+        "overflow_fraction_sum", 0.0)
+    assert checks >= 1
+    assert frac > 0.0  # capacity 0.25 must actually drop choices
 
 
 def test_topk_moe_train_step_learns():

@@ -19,11 +19,9 @@ from dmlc_tpu.parallel import (
     broadcast,
     build_mesh,
     factorize_devices,
-    mesh as mesh_mod,
     pipeline,
     ppermute_ring,
     reduce_scatter,
-    ring_attention,
     ring_attention_reference,
     ulysses_attention,
 )
