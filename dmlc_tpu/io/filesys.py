@@ -6,8 +6,9 @@ protocol->singleton dispatch in src/io.cc:31-60. Protocols are pluggable via
 "compile with DMLC_USE_X=1" FATAL of the reference.
 
 TPU-native mapping (SURVEY.md §2.4): local + GCS play the roles of the
-reference's local + S3; HDFS/Azure are optional and absent here by default,
-but the dispatch architecture makes them drop-in.
+reference's local + S3; hdfs:// is served over WebHDFS REST and azure://
+over the Blob REST API (both stdlib-only — see their modules), and the
+dispatch stays pluggable for anything else.
 """
 
 from __future__ import annotations
@@ -125,19 +126,28 @@ def _init_builtin() -> None:
         register_filesystem("gs://", lambda u: GCSFileSystem())
     except ImportError:  # optional backend not present
         pass
-    register_filesystem("hdfs://", _unsupported_protocol(
-        "hdfs://",
-        "the TPU-native substrate uses gs:// in the HDFS/S3 role "
-        "(SURVEY.md §2.4 mapping); copy the data to GCS, or plug in a "
-        "backend via dmlc_tpu.io.filesys.register_filesystem('hdfs://', ...)"))
+    try:
+        from .hdfs_filesys import WebHDFSFileSystem
+
+        register_filesystem("hdfs://", WebHDFSFileSystem)
+    except ImportError:
+        register_filesystem("hdfs://", _unsupported_protocol(
+            "hdfs://",
+            "the WebHDFS backend failed to import; copy the data to gs:// "
+            "or plug in a backend via register_filesystem('hdfs://', ...)"))
     register_filesystem("s3://", _unsupported_protocol(
         "s3://",
         "use gs:// (the S3-role backend here) or an S3-compatible proxy "
         "over https://; custom backends plug in via register_filesystem"))
-    register_filesystem("azure://", _unsupported_protocol(
-        "azure://",
-        "not built (optional in the reference too); plug in a backend via "
-        "register_filesystem('azure://', ...)"))
+    try:
+        from .azure_filesys import AzureFileSystem
+
+        register_filesystem("azure://", lambda u: AzureFileSystem())
+    except ImportError:
+        register_filesystem("azure://", _unsupported_protocol(
+            "azure://",
+            "the Azure backend failed to import; plug in a backend via "
+            "register_filesystem('azure://', ...)"))
 
 
 _init_builtin()

@@ -514,6 +514,67 @@ def submit_sge(args):
                       host_ip=args.host_ip or "auto", pscmd=_pscmd(args))
 
 
+def build_mesos_cmd(args, envs: Dict[str, str], role: str,
+                    task_id: int) -> List[str]:
+    """One mesos-execute invocation per task (the reference's
+    non-pymesos path, tracker/dmlc_tracker/mesos.py:30-57): command is
+    run from the current workdir, env ships as a JSON dict, and
+    cpus/mem come from the worker/server resource opts."""
+    import json
+    import shlex
+    import uuid
+
+    master = args.mesos_master or os.environ.get("MESOS_MASTER")
+    if not master:
+        raise RuntimeError("no mesos master: set --mesos-master or "
+                           "MESOS_MASTER")
+    if ":" not in master:
+        master += ":5050"
+    env = task_env(envs, role, task_id, 0, "mesos", args.extra_env,
+                   resource_envs(args, role))
+    # ship the scheduler-discovery whitelist the reference ships
+    for k in ("OMP_NUM_THREADS", "KMP_AFFINITY", "LD_LIBRARY_PATH"):
+        if k in os.environ:
+            env.setdefault(k, os.environ[k])
+    if role == "server":
+        cores, mem = args.server_cores, args.server_memory_mb
+    else:
+        cores, mem = args.worker_cores, args.worker_memory_mb
+    prog = f"cd {shlex.quote(os.getcwd())} && " \
+           + " ".join(shlex.quote(c) for c in args.command)
+    return ["mesos-execute", f"--master={master}",
+            f"--name=dmlc-{role}-{task_id}-{uuid.uuid4().hex[:8]}",
+            f"--command={prog}",
+            f"--env={json.dumps({k: str(v) for k, v in env.items()})}",
+            f"--resources=cpus:{cores};mem:{mem}"]
+
+
+def submit_mesos(args):
+    """mesos backend: per-task mesos-execute, gated on the binary being
+    on PATH (pymesos is not bundled; reference mesos.py falls back to
+    mesos-execute the same way)."""
+    import shutil
+
+    if shutil.which("mesos-execute") is None:
+        raise RuntimeError(
+            "mesos-execute not found on PATH (pymesos is not bundled); "
+            "install Mesos CLI tools or use --cluster ssh/tpu-vm")
+    failures = []
+    threads = []
+
+    def fun_submit(n_workers, n_servers, envs):
+        procs = [subprocess.Popen(build_mesos_cmd(args, envs, role, tid),
+                                  stdout=subprocess.DEVNULL,
+                                  stderr=subprocess.STDOUT)
+                 for role, tid in _roles(n_workers, n_servers)]
+        threads.extend(_reap_procs(procs, failures))
+
+    tracker = submit_job(args.num_workers, args.num_servers, fun_submit,
+                         host_ip=args.host_ip or "auto",
+                         pscmd=_pscmd(args), join=False)
+    return _await_job(tracker, failures, threads)
+
+
 def build_slurm_cmd(args, envs: Dict[str, str], role: str,
                     n_tasks: int) -> List[str]:
     cmd = ["srun", "-n", str(n_tasks)]

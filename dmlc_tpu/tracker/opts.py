@@ -48,6 +48,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sge-log-dir", default=None)
     p.add_argument("--slurm-worker-nodes", default=None, type=int)
     p.add_argument("--slurm-server-nodes", default=None, type=int)
+    p.add_argument("--mesos-master", default=None,
+                   help="mesos master host[:port]; defaults to "
+                        "$MESOS_MASTER (reference mesos.py:97-100)")
     p.add_argument("--sync-dst-dir", default=None,
                    help="rsync the working dir to this path on each host first")
     p.add_argument("--auto-file-cache", action=argparse.BooleanOptionalAction,

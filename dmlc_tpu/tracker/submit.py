@@ -19,22 +19,15 @@ def _submit_yarn(args):
     )
 
 
-def _submit_mesos(args):
-    raise SystemExit(
-        "mesos backend requires pymesos, which is not bundled; use "
-        "--cluster ssh or tpu-vm"
-    )
-
-
 DISPATCH = {
     "local": launch.submit_local,
     "ssh": launch.submit_ssh,
     "mpi": launch.submit_mpi,
     "sge": launch.submit_sge,
     "slurm": launch.submit_slurm,
+    "mesos": launch.submit_mesos,
     "tpu-vm": launch.submit_tpu_vm,
     "yarn": _submit_yarn,
-    "mesos": _submit_mesos,
 }
 
 

@@ -417,3 +417,55 @@ def test_submit_dispatch_routes_all_clusters():
     for c in ["local", "ssh", "mpi", "sge", "slurm", "tpu-vm", "yarn",
               "mesos"]:
         assert c in DISPATCH
+
+
+FAKE_MESOS_EXECUTE = '''#!/usr/bin/env python3
+"""Fake mesos-execute: runs --command locally with --env applied, the
+way a mesos agent would, so the whole tracker rendezvous is exercised."""
+import json
+import os
+import subprocess
+import sys
+
+opts = dict(a.split("=", 1) for a in sys.argv[1:] if a.startswith("--"))
+assert "--master" in opts and ":" in opts["--master"], opts
+assert "cpus:" in opts["--resources"] and "mem:" in opts["--resources"]
+env = os.environ.copy()
+env.update(json.loads(opts["--env"]))
+sys.exit(subprocess.call(opts["--command"], shell=True, env=env))
+'''
+
+
+def test_mesos_submit_end_to_end(tmp_path):
+    """mesos backend against a fake mesos-execute on PATH: per-task
+    launch with env JSON + resources, full rendezvous to completion
+    (reference role: tracker/dmlc_tracker/mesos.py:30-91)."""
+    fake = tmp_path / "mesos-execute"
+    fake.write_text(FAKE_MESOS_EXECUTE)
+    fake.chmod(0o755)
+    old_path = os.environ["PATH"]
+    os.environ["PATH"] = f"{tmp_path}:{old_path}"
+    try:
+        args = get_opts([
+            "--cluster", "mesos", "--num-workers", "2", "--host-ip",
+            "127.0.0.1", "--mesos-master", "127.0.0.1",
+            "--", sys.executable,
+            os.path.join(REPO, "examples", "allreduce_worker.py"),
+        ])
+        tracker = launch.submit_mesos(args)
+        assert tracker is not None and not tracker.alive()
+        tracker.close()
+    finally:
+        os.environ["PATH"] = old_path
+
+
+def test_mesos_requires_binary(tmp_path):
+    args = get_opts(["--cluster", "mesos", "--num-workers", "1",
+                     "--mesos-master", "m", "--", "true"])
+    old_path = os.environ["PATH"]
+    os.environ["PATH"] = str(tmp_path)  # empty dir: no mesos-execute
+    try:
+        with pytest.raises(RuntimeError, match="mesos-execute"):
+            launch.submit_mesos(args)
+    finally:
+        os.environ["PATH"] = old_path
