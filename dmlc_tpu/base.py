@@ -32,7 +32,16 @@ __all__ = [
 
 
 class DMLCError(RuntimeError):
-    """Exception for all fatal checks (analog of ``dmlc::Error``, logging.h:26)."""
+    """Exception for all fatal checks (analog of ``dmlc::Error``, logging.h:26).
+
+    ``status`` carries a machine-readable code (e.g. an HTTP status) so
+    callers can dispatch on it instead of matching message text — the
+    filesystem backends use this to map 404s to FileNotFoundError.
+    """
+
+    def __init__(self, *args, status: Optional[int] = None):
+        super().__init__(*args)
+        self.status = status
 
 
 class ParamError(ValueError, DMLCError):

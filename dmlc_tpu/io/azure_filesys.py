@@ -136,7 +136,8 @@ def _request(url: str, method: str = "GET", data: Optional[bytes] = None,
                 time.sleep(0.25 * (2 ** i))
                 continue
             raise DMLCError(f"Azure {method} {url.split('?')[0]} failed: "
-                            f"HTTP {e.code} {e.read()[:300]!r}") from e
+                            f"HTTP {e.code} {e.read()[:300]!r}",
+                            status=e.code) from e
         except urllib.error.URLError as e:
             if i + 1 < attempts:
                 last = str(e.reason)
@@ -173,15 +174,29 @@ class AzureReadStream(HttpReadStream):
 
 
 class AzureWriteStream(Stream):
-    """Buffered whole-object write committed on close via Put Blob.
+    """Buffered block-blob writer, committed atomically at close.
 
-    Single-shot (no block-list chaining): the blob becomes visible only
-    at close, which preserves the no-partial-object property of the GCS
-    writer without the resumable-session machinery."""
+    Small objects (≤ one block, DMLC_AZURE_BLOCK_MB, default 64) go up as
+    a single Put Blob.  Anything larger is staged as Put Block calls with
+    deterministic zero-padded block ids flushed from write() — so memory
+    stays bounded at one block and objects beyond the single-Put-Blob
+    service cap upload fine — and committed with one Put Block List in
+    close().  Either way the blob only becomes visible at close
+    (uncommitted blocks are invisible and garbage-collected by the
+    service after 7 days), preserving the GCS writer's
+    no-partial-object property."""
 
     def __init__(self, url: str):
+        mb = int(os.environ.get("DMLC_AZURE_BLOCK_MB", "64"))
+        self._block = max(mb << 20, 1 << 20)
         self._url = url
         self._buf = bytearray()
+        self._block_ids: List[str] = []
+        # per-stream prefix: Azure scopes uncommitted blocks per BLOB, so
+        # two concurrent writers staging the same ids would interleave
+        # into a corrupt commit; a random prefix isolates them while
+        # keeping within-stream retries idempotent
+        self._id_prefix = os.urandom(6).hex()
         self._closed = False
 
     def read(self, size: int) -> bytes:
@@ -190,15 +205,44 @@ class AzureWriteStream(Stream):
     def write(self, data: bytes) -> int:
         check(not self._closed, "write on closed AzureWriteStream")
         self._buf += data
+        while len(self._buf) >= self._block:
+            self._stage_block(self._block)
         return len(data)
+
+    def _stage_block(self, n: int) -> None:
+        # ids must be equal-length and unique within the blob; prefix +
+        # index makes each id deterministic within this stream, so a
+        # transient-retry resend of the same block is idempotent
+        raw = f"{self._id_prefix}{len(self._block_ids):010d}".encode()
+        bid = base64.b64encode(raw).decode()
+        body = bytes(self._buf[:n])
+        del self._buf[:n]
+        _request(f"{self._url}?comp=block&blockid="
+                 + urllib.parse.quote(bid),
+                 "PUT", data=body,
+                 headers={"Content-Type": "application/octet-stream"},
+                 ok=(201,))
+        self._block_ids.append(bid)
 
     def close(self) -> None:
         if self._closed:
             return
         self._closed = True
-        _request(self._url, "PUT", data=bytes(self._buf),
-                 headers={"x-ms-blob-type": "BlockBlob",
-                          "Content-Type": "application/octet-stream"},
+        if not self._block_ids:
+            # single-shot Put Blob: one round trip, no commit step
+            _request(self._url, "PUT", data=bytes(self._buf),
+                     headers={"x-ms-blob-type": "BlockBlob",
+                              "Content-Type": "application/octet-stream"},
+                     ok=(201,))
+            return
+        if self._buf:
+            self._stage_block(len(self._buf))
+        xml = ("<?xml version='1.0' encoding='utf-8'?><BlockList>"
+               + "".join(f"<Latest>{b}</Latest>" for b in self._block_ids)
+               + "</BlockList>")
+        _request(f"{self._url}?comp=blocklist", "PUT",
+                 data=xml.encode("utf-8"),
+                 headers={"Content-Type": "application/xml"},
                  ok=(201,))
 
 
@@ -213,7 +257,7 @@ class AzureFileSystem(FileSystem):
         try:
             resp = _request(self._blob_url(path), "HEAD")
         except DMLCError as e:
-            if "HTTP 404" in str(e):
+            if e.status == 404:
                 if self.list_directory(path):
                     return FileInfo(path=path, size=0, type="directory")
                 raise FileNotFoundError(path.str_uri()) from e

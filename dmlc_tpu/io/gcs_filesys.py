@@ -60,9 +60,13 @@ class GCSError(DMLCError):
 
     def __init__(self, msg: str, *, code: Optional[int] = None,
                  transient: bool = False):
-        super().__init__(msg)
-        self.code = code
+        super().__init__(msg, status=code)
         self.transient = transient
+
+    @property
+    def code(self) -> Optional[int]:
+        """Alias of ``status`` (kept for existing callers)."""
+        return self.status
 
 
 _TRANSIENT_HTTP = {408, 429, 500, 502, 503, 504}
@@ -249,7 +253,7 @@ class GCSFileSystem(FileSystem):
         try:
             resp = _api(self._object_url(path))
         except DMLCError as e:
-            if "HTTP 404" in str(e):
+            if e.status == 404:
                 # GCS has no real directories: a prefix with objects under
                 # it acts as one (needed so InputSplit can shard a
                 # directory of objects, input_split.py directory branch)

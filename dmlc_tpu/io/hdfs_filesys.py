@@ -17,12 +17,28 @@ singleton map, matching the reference's per-namenode connection reuse.
 Endpoint resolution: ``DMLC_WEBHDFS_ENDPOINT`` (e.g. a test emulator or
 a gateway) wins; otherwise ``http://<uri-host>:<DMLC_WEBHDFS_PORT>`` —
 the URI's own port, if any, is the RPC port and is NOT used for HTTP.
+
+Auth limitation (vs the reference's JVM path): this backend speaks
+simple auth only — ``user.name=<DMLC_HDFS_USER>`` query params, no
+Kerberos/SPNEGO and no delegation tokens — so a secured cluster rejects
+it with 401 (surfaced with guidance).  The workaround for secured
+deployments is an authenticating HTTP gateway (Knox/HttpFS):
+``DMLC_WEBHDFS_ENDPOINT`` accepts ``https://`` URLs and the gateway
+holds the Kerberos credentials.
+
+Durability: writes go to a hidden ``.<name>.tmp.<pid>.<nonce>`` sibling
+and are RENAMEd into place at close(), so concurrent readers never
+observe a torn partial file (the no-partial-object property of the
+GCS/Azure writers; plain CREATE+APPEND would expose every intermediate
+length), and directory scans skip the dot-prefixed temp by the Hadoop
+hidden-file convention.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -75,10 +91,16 @@ def _request(url: str, method: str, data: Optional[bytes] = None,
             if e.code == 307 and e.headers.get("Location"):
                 url = e.headers["Location"]
                 continue
+            if e.code in ok:  # e.g. DELETE of an already-absent path
+                return e
             body = e.read()[:300]
+            hint = (" (cluster requires authentication: this backend "
+                    "speaks simple auth only — point "
+                    "DMLC_WEBHDFS_ENDPOINT at an authenticating gateway "
+                    "such as Knox/HttpFS)") if e.code == 401 else ""
             raise DMLCError(
                 f"WebHDFS {method} {url.split('?')[0]} failed: "
-                f"HTTP {e.code} {body!r}") from e
+                f"HTTP {e.code} {body!r}{hint}", status=e.code) from e
         if resp.status == 307 and resp.headers.get("Location"):
             url = resp.headers["Location"]
             continue
@@ -102,7 +124,8 @@ def _probe_redirect(url: str, method: str) -> Optional[str]:
         if e.code == 307 and e.headers.get("Location"):
             return e.headers["Location"]
         raise DMLCError(f"WebHDFS {method} {url.split('?')[0]} failed: "
-                        f"HTTP {e.code} {e.read()[:300]!r}") from e
+                        f"HTTP {e.code} {e.read()[:300]!r}",
+                        status=e.code) from e
     if resp.status == 307 and resp.headers.get("Location"):
         return resp.headers["Location"]
     return None
@@ -149,18 +172,28 @@ class WebHdfsReadStream(HttpReadStream):
 
 
 class WebHdfsWriteStream(Stream):
-    """Buffered writer: CREATE commits the first chunk, APPEND the rest.
+    """Buffered writer: CREATE commits the first chunk, APPEND the rest —
+    all against a hidden temp path, RENAMEd to the destination at close.
 
     Chunk size from DMLC_HDFS_WRITE_BUFFER_MB (default 64 — the same
-    knob family as the reference's DMLC_S3_WRITE_BUFFER_MB).  Unlike the
-    GCS resumable session there is no abort/commit handle: WebHDFS
-    CREATE is visible immediately, so close() only flushes the tail."""
+    knob family as the reference's DMLC_S3_WRITE_BUFFER_MB).  WebHDFS
+    CREATE makes a file visible immediately and APPEND grows it in
+    place, so writing the destination directly would expose torn
+    partials to concurrent readers; the temp+RENAME dance restores the
+    no-partial-object property the GCS/Azure writers give for free.
+    HDFS RENAME within a directory is an atomic namenode metadata op."""
 
     def __init__(self, base: str, path: str):
         mb = int(os.environ.get("DMLC_HDFS_WRITE_BUFFER_MB", "64"))
         self._chunk = max(mb << 20, 1 << 20)
         self._base = base
         self._path = path
+        # dot-prefixed basename (Hadoop's hiddenFileFilter convention, so
+        # directory globs / InputSplit never shard the partial as data)
+        # + pid + monotonic nonce (two writers or a crashed predecessor
+        # never collide on the temp name)
+        d, _, name = path.rpartition("/")
+        self._tmp = f"{d}/.{name}.tmp.{os.getpid()}.{_next_nonce()}"
         self._buf = bytearray()
         self._created = False
         self._closed = False
@@ -179,12 +212,12 @@ class WebHdfsWriteStream(Stream):
         body = bytes(self._buf[:n])
         del self._buf[:n]
         if not self._created:
-            url = _op_url(self._base, self._path, "CREATE",
+            url = _op_url(self._base, self._tmp, "CREATE",
                           overwrite="true")
             _write_op(url, "PUT", body, ok=(200, 201))
             self._created = True
         else:
-            url = _op_url(self._base, self._path, "APPEND")
+            url = _op_url(self._base, self._tmp, "APPEND")
             _write_op(url, "POST", body, ok=(200,))
 
     def close(self) -> None:
@@ -194,6 +227,41 @@ class WebHdfsWriteStream(Stream):
         # an empty file still needs its CREATE
         if self._buf or not self._created:
             self._flush(len(self._buf))
+        # RENAME first (the common fresh-destination case commits in one
+        # atomic namenode op).  Only on refusal — WebHDFS RENAME returns
+        # {"boolean": false} when the destination exists — DELETE the old
+        # file and retry, matching CREATE&overwrite=true semantics while
+        # keeping the old version live until the last possible moment.
+        try:
+            if not self._rename():
+                _request(_op_url(self._base, self._path, "DELETE"),
+                         "DELETE", ok=(200, 404))
+                check(self._rename(),
+                      f"WebHDFS RENAME {self._tmp} -> {self._path} "
+                      f"refused by namenode after destination delete")
+        except Exception:
+            # don't strand the temp file next to the data
+            try:
+                _request(_op_url(self._base, self._tmp, "DELETE"),
+                         "DELETE", ok=(200, 404))
+            except DMLCError:
+                pass
+            raise
+
+    def _rename(self) -> bool:
+        resp = _request(_op_url(self._base, self._tmp, "RENAME",
+                                destination=self._path), "PUT", ok=(200,))
+        return bool(json.loads(resp.read()).get("boolean"))
+
+
+_nonce_lock = threading.Lock()
+_nonce = [0]
+
+
+def _next_nonce() -> int:
+    with _nonce_lock:
+        _nonce[0] += 1
+        return _nonce[0]
 
 
 class WebHDFSFileSystem(FileSystem):
@@ -216,7 +284,7 @@ class WebHDFSFileSystem(FileSystem):
         try:
             resp = _request(url, "GET")
         except DMLCError as e:
-            if "HTTP 404" in str(e):
+            if e.status == 404:
                 raise FileNotFoundError(path.str_uri()) from e
             raise
         st = json.loads(resp.read())["FileStatus"]

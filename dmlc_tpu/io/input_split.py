@@ -350,8 +350,16 @@ class InputSplitBase(InputSplit):
                     if recurse
                     else self._filesys.list_directory(info.path)
                 )
+                # skip hidden files ('.'/'_' basenames — the Hadoop
+                # FileInputFormat convention): in-flight writer temps
+                # (.name.tmp.<pid>) and markers like _SUCCESS are not
+                # data.  Deviation from input_split_base.cc:96-175,
+                # which takes every non-empty entry.
                 self._files.extend(
-                    f for f in dfiles if f.size != 0 and f.type == "file"
+                    f for f in dfiles
+                    if f.size != 0 and f.type == "file"
+                    and not f.path.name.rpartition("/")[2].startswith(
+                        (".", "_"))
                 )
             elif info.size != 0:
                 self._files.append(info)

@@ -559,6 +559,10 @@ def submit_mesos(args):
         raise RuntimeError(
             "mesos-execute not found on PATH (pymesos is not bundled); "
             "install Mesos CLI tools or use --cluster ssh/tpu-vm")
+    logger.warning(
+        "mesos-execute mode provides no task stdout/stderr here; a failed "
+        "task reports only its exit code — check the Mesos agent sandbox "
+        "logs for output")
     failures = []
     threads = []
 

@@ -127,8 +127,21 @@ class _FakeNameNode(BaseHTTPRequestHandler):
             self._reply(400)
 
     def do_PUT(self):
+        import json
+
         path, q, on_dn = self._parse()
-        if q.get("op") != "CREATE":
+        op = q.get("op")
+        if op == "RENAME":
+            # namenode metadata op: no datanode redirect; refuses an
+            # existing destination, exactly like real HDFS
+            dst = q["destination"]
+            if path not in self.store or dst in self.store:
+                self._reply(200, json.dumps({"boolean": False}).encode())
+                return
+            self.store[dst] = self.store.pop(path)
+            self._reply(200, json.dumps({"boolean": True}).encode())
+            return
+        if op != "CREATE":
             self._reply(400)
             return
         if not on_dn:
@@ -137,6 +150,16 @@ class _FakeNameNode(BaseHTTPRequestHandler):
         n = int(self.headers.get("Content-Length", 0))
         self.store[path] = bytearray(self.rfile.read(n))
         self._reply(201)
+
+    def do_DELETE(self):
+        import json
+
+        path, q, _on_dn = self._parse()
+        if q.get("op") != "DELETE":
+            self._reply(400)
+            return
+        existed = self.store.pop(path, None) is not None
+        self._reply(200, json.dumps({"boolean": existed}).encode())
 
     def do_POST(self):
         path, q, on_dn = self._parse()
@@ -189,6 +212,34 @@ def test_hdfs_write_read_roundtrip(hdfs_server):
     assert strm.read(16) == payload[123_456:123_472]
 
 
+def test_hdfs_write_is_invisible_until_close(hdfs_server):
+    """The temp+RENAME dance: readers never see a torn partial at the
+    destination path; content appears only (and fully) at close."""
+    fs = FileSystem.get_instance(URI("hdfs://nn/torn"))
+    os.environ["DMLC_HDFS_WRITE_BUFFER_MB"] = "1"  # read at construction
+    try:
+        s = Stream.create("hdfs://nn/torn/out.bin", "w")
+        s.write(b"x" * (2 << 20))  # forces a CREATE flush mid-write
+        with pytest.raises(FileNotFoundError):
+            fs.get_path_info(URI("hdfs://nn/torn/out.bin"))
+        s.close()
+    finally:
+        os.environ.pop("DMLC_HDFS_WRITE_BUFFER_MB")
+    assert fs.get_path_info(URI("hdfs://nn/torn/out.bin")).size == 2 << 20
+    # no temp litter after a clean close
+    names = [e.path.name for e in
+             fs.list_directory(URI("hdfs://nn/torn"))]
+    assert names == ["/torn/out.bin"]
+
+
+def test_hdfs_overwrite_existing_destination(hdfs_server):
+    for payload in (b"first version", b"second, longer version!"):
+        with Stream.create("hdfs://nn/ow/f.bin", "w") as s:
+            s.write(payload)
+        assert Stream.create_for_read(
+            "hdfs://nn/ow/f.bin").read(100) == payload
+
+
 def test_hdfs_stat_and_list(hdfs_server):
     with Stream.create("hdfs://nn/dir/a.txt", "w") as s:
         s.write(b"hello")
@@ -218,12 +269,30 @@ def test_inputsplit_over_hdfs(hdfs_server):
     assert sorted(got) == sorted(lines)
 
 
+def test_inputsplit_directory_skips_hidden_files(hdfs_server):
+    """An in-flight writer temp (or _SUCCESS marker) inside a sharded
+    directory must never be sharded as data — the torn-read hazard the
+    dot-prefixed temp convention exists to prevent."""
+    lines = [f"r{i}" for i in range(40)]
+    with Stream.create("hdfs://nn/hid/part-0.txt", "w") as s:
+        s.write(("\n".join(lines) + "\n").encode())
+    # hidden siblings, directly into the emulator store
+    _FakeNameNode.store["/hid/.part-1.txt.tmp.999.1"] = \
+        bytearray(b"torn partial\n")
+    _FakeNameNode.store["/hid/_SUCCESS"] = bytearray(b"marker\n")
+    sp = input_split.create("hdfs://nn/hid", 0, 1, "text")
+    got = [bytes(r).decode() for r in sp]
+    sp.close()
+    assert sorted(got) == sorted(lines)
+
+
 # ---------------------------------------------------------------------------
 # Azure Blob
 # ---------------------------------------------------------------------------
 
 class _FakeAzure(BaseHTTPRequestHandler):
-    store = {}  # (container, blob) -> bytes
+    store = {}   # (container, blob) -> bytes
+    blocks = {}  # (container, blob) -> {blockid: bytes}, uncommitted
     require_auth = True
 
     def log_message(self, *a):
@@ -320,7 +389,27 @@ class _FakeAzure(BaseHTTPRequestHandler):
         if not self._verify_auth(body_len=n):
             self.rfile.read(n)
             return
-        container, blob, _ = self._key()
+        container, blob, q = self._key()
+        if q.get("comp") == "block":
+            # staged, invisible until a blocklist commit
+            bid = q["blockid"]
+            self.blocks.setdefault((container, blob), {})[bid] = \
+                self.rfile.read(n)
+            self._reply(201)
+            return
+        if q.get("comp") == "blocklist":
+            import xml.etree.ElementTree as ET
+
+            staged = self.blocks.pop((container, blob), {})
+            root = ET.fromstring(self.rfile.read(n))
+            try:
+                body = b"".join(staged[el.text] for el in root)
+            except KeyError:
+                self._reply(400)
+                return
+            self.store[(container, blob)] = body
+            self._reply(201)
+            return
         if self.headers.get("x-ms-blob-type") != "BlockBlob":
             self._reply(400)
             return
@@ -364,6 +453,31 @@ def test_azure_write_read_roundtrip(azure_server):
     assert strm.read(len(payload) + 1) == payload
     strm.seek(99_000)
     assert strm.read(32) == payload[99_000:99_032]
+
+
+def test_azure_block_upload_large_object(azure_server):
+    """Above one block the writer switches to staged Put Block + Put
+    Block List: memory stays bounded, the object is invisible until the
+    commit, and the committed bytes are exact."""
+    import numpy as np
+
+    payload = bytes(np.random.default_rng(3).integers(
+        0, 256, 2_500_000, dtype=np.uint8))
+    os.environ["DMLC_AZURE_BLOCK_MB"] = "1"
+    try:
+        s = Stream.create("azure://cont/big/blob.bin", "w")
+        for lo in range(0, len(payload), 700_000):
+            s.write(payload[lo: lo + 700_000])
+        # blocks are staged but uncommitted: blob must not exist yet
+        fs = FileSystem.get_instance(URI("azure://cont/big"))
+        with pytest.raises(FileNotFoundError):
+            fs.get_path_info(URI("azure://cont/big/blob.bin"))
+        s.close()
+    finally:
+        os.environ.pop("DMLC_AZURE_BLOCK_MB")
+    strm = Stream.create_for_read("azure://cont/big/blob.bin")
+    assert strm.read(len(payload) + 1) == payload
+    assert not _FakeAzure.blocks  # commit consumed the staged blocks
 
 
 def test_azure_signature_rejected_without_key(azure_server):
