@@ -24,9 +24,7 @@ import base64
 import hashlib
 import hmac
 import os
-import urllib.error
 import urllib.parse
-import urllib.request
 import xml.etree.ElementTree as ET
 from email.utils import formatdate
 from typing import List, Optional
@@ -34,6 +32,7 @@ from typing import List, Optional
 from ..base import DMLCError, check
 from .filesys import FileInfo, FileSystem
 from .http_filesys import HttpReadStream
+from .rest import rest_request
 from .stream import SeekStream, Stream
 from .uri import URI
 
@@ -108,48 +107,21 @@ def sign_request(method: str, url: str, headers: dict,
     return out
 
 
-_TRANSIENT_HTTP = {408, 429, 500, 502, 503, 504}
+def _sign(method: str, url: str, headers: dict,
+          data: Optional[bytes]) -> dict:
+    """Per-attempt signer for rest_request: fresh x-ms-date each try."""
+    return sign_request(method, url, headers,
+                        content_length=len(data) if data else 0)
 
 
 def _request(url: str, method: str = "GET", data: Optional[bytes] = None,
              headers: Optional[dict] = None, ok=(200, 201, 206)):
-    """One signed call with transient-error retry.  Every operation this
-    backend issues is idempotent — GET/HEAD, Put Blob (full overwrite),
-    Put Block (fixed block id), Put Block List — so blind resend is safe
-    (unlike GCS resumable chunks, which need committed-range recovery)."""
-    import time
-
-    url = _with_sas(url)
-    attempts = int(os.environ.get("DMLC_AZURE_RETRIES", "4"))
-    last = "no attempts"
-    for i in range(attempts):
-        # re-sign per attempt: x-ms-date must be fresh
-        hdrs = sign_request(method, url, headers or {},
-                            content_length=len(data) if data else 0)
-        req = urllib.request.Request(url, data=data, method=method,
-                                     headers=hdrs)
-        try:
-            resp = urllib.request.urlopen(req, timeout=60)
-        except urllib.error.HTTPError as e:
-            if e.code in _TRANSIENT_HTTP and i + 1 < attempts:
-                last = f"HTTP {e.code}"
-                time.sleep(0.25 * (2 ** i))
-                continue
-            raise DMLCError(f"Azure {method} {url.split('?')[0]} failed: "
-                            f"HTTP {e.code} {e.read()[:300]!r}",
-                            status=e.code) from e
-        except urllib.error.URLError as e:
-            if i + 1 < attempts:
-                last = str(e.reason)
-                time.sleep(0.25 * (2 ** i))
-                continue
-            raise DMLCError(f"Azure {method} {url.split('?')[0]} failed: "
-                            f"{e.reason}") from e
-        check(resp.status in ok,
-              f"Azure {method}: unexpected HTTP {resp.status}")
-        return resp
-    raise DMLCError(f"Azure {method} {url.split('?')[0]} failed after "
-                    f"{attempts} attempts: {last}")
+    """Every operation this backend issues is idempotent — GET/HEAD,
+    Put Blob (full overwrite), Put Block (fixed block id), Put Block
+    List — so the shared blind transient resend is safe (unlike GCS
+    resumable chunks, which need committed-range recovery)."""
+    return rest_request("Azure", _with_sas(url), method, data, headers,
+                        ok, sign=_sign, retries_env="DMLC_AZURE_RETRIES")
 
 
 class AzureReadStream(HttpReadStream):
@@ -198,12 +170,14 @@ class AzureWriteStream(Stream):
         # keeping within-stream retries idempotent
         self._id_prefix = os.urandom(6).hex()
         self._closed = False
+        self._failed = False
 
     def read(self, size: int) -> bytes:
         raise DMLCError("AzureWriteStream is write-only")
 
     def write(self, data: bytes) -> int:
         check(not self._closed, "write on closed AzureWriteStream")
+        check(not self._failed, "write on failed AzureWriteStream")
         self._buf += data
         while len(self._buf) >= self._block:
             self._stage_block(self._block)
@@ -217,17 +191,28 @@ class AzureWriteStream(Stream):
         bid = base64.b64encode(raw).decode()
         body = bytes(self._buf[:n])
         del self._buf[:n]
-        _request(f"{self._url}?comp=block&blockid="
-                 + urllib.parse.quote(bid),
-                 "PUT", data=body,
-                 headers={"Content-Type": "application/octet-stream"},
-                 ok=(201,))
+        try:
+            _request(f"{self._url}?comp=block&blockid="
+                     + urllib.parse.quote(bid),
+                     "PUT", data=body,
+                     headers={"Content-Type": "application/octet-stream"},
+                     ok=(201,))
+        except Exception:
+            # a lost block means the blob can never be committed whole:
+            # poison the stream so the close() in a with-block exit
+            # cannot publish a blob with a hole in it.  The staged
+            # blocks stay uncommitted (invisible) and the service GCs
+            # them after 7 days.
+            self._failed = True
+            raise
         self._block_ids.append(bid)
 
     def close(self) -> None:
         if self._closed:
             return
         self._closed = True
+        if self._failed:
+            return  # nothing was committed; the original error stands
         if not self._block_ids:
             # single-shot Put Blob: one round trip, no commit step
             _request(self._url, "PUT", data=bytes(self._buf),

@@ -5,10 +5,11 @@ protocol->singleton dispatch in src/io.cc:31-60. Protocols are pluggable via
 :func:`register_filesystem`; unknown protocols raise, matching the
 "compile with DMLC_USE_X=1" FATAL of the reference.
 
-TPU-native mapping (SURVEY.md §2.4): local + GCS play the roles of the
-reference's local + S3; hdfs:// is served over WebHDFS REST and azure://
-over the Blob REST API (both stdlib-only — see their modules), and the
-dispatch stays pluggable for anything else.
+TPU-native mapping (SURVEY.md §2.4): local + GCS play the primary
+roles of the reference's local + S3; s3:// itself is served by a SigV4
+REST backend, hdfs:// over WebHDFS REST and azure:// over the Blob REST
+API (all stdlib-only — see their modules), and the dispatch stays
+pluggable for anything else.
 """
 
 from __future__ import annotations
@@ -135,10 +136,15 @@ def _init_builtin() -> None:
             "hdfs://",
             "the WebHDFS backend failed to import; copy the data to gs:// "
             "or plug in a backend via register_filesystem('hdfs://', ...)"))
-    register_filesystem("s3://", _unsupported_protocol(
-        "s3://",
-        "use gs:// (the S3-role backend here) or an S3-compatible proxy "
-        "over https://; custom backends plug in via register_filesystem"))
+    try:
+        from .s3_filesys import S3FileSystem
+
+        register_filesystem("s3://", lambda u: S3FileSystem())
+    except ImportError:
+        register_filesystem("s3://", _unsupported_protocol(
+            "s3://",
+            "the S3 backend failed to import; use gs:// or plug in a "
+            "backend via register_filesystem('s3://', ...)"))
     try:
         from .azure_filesys import AzureFileSystem
 

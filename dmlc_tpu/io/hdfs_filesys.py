@@ -197,12 +197,14 @@ class WebHdfsWriteStream(Stream):
         self._buf = bytearray()
         self._created = False
         self._closed = False
+        self._failed = False
 
     def read(self, size: int) -> bytes:
         raise DMLCError("WebHdfsWriteStream is write-only")
 
     def write(self, data: bytes) -> int:
         check(not self._closed, "write on closed WebHdfsWriteStream")
+        check(not self._failed, "write on failed WebHdfsWriteStream")
         self._buf += data
         while len(self._buf) >= self._chunk:
             self._flush(self._chunk)
@@ -211,28 +213,46 @@ class WebHdfsWriteStream(Stream):
     def _flush(self, n: int) -> None:
         body = bytes(self._buf[:n])
         del self._buf[:n]
-        if not self._created:
-            url = _op_url(self._base, self._tmp, "CREATE",
-                          overwrite="true")
-            _write_op(url, "PUT", body, ok=(200, 201))
-            self._created = True
-        else:
-            url = _op_url(self._base, self._tmp, "APPEND")
-            _write_op(url, "POST", body, ok=(200,))
+        try:
+            if not self._created:
+                url = _op_url(self._base, self._tmp, "CREATE",
+                              overwrite="true")
+                _write_op(url, "PUT", body, ok=(200, 201))
+                self._created = True
+            else:
+                url = _op_url(self._base, self._tmp, "APPEND")
+                _write_op(url, "POST", body, ok=(200,))
+        except Exception:
+            # a lost chunk means the temp can never be renamed whole:
+            # poison the stream so the close() in a with-block exit
+            # cannot publish a truncated file over the destination
+            self._failed = True
+            raise
+
+    def _delete_tmp(self) -> None:
+        try:
+            _request(_op_url(self._base, self._tmp, "DELETE"),
+                     "DELETE", ok=(200, 404))
+        except DMLCError:
+            pass  # best-effort; the dot-prefix keeps it out of scans
 
     def close(self) -> None:
         if self._closed:
             return
         self._closed = True
-        # an empty file still needs its CREATE
-        if self._buf or not self._created:
-            self._flush(len(self._buf))
-        # RENAME first (the common fresh-destination case commits in one
-        # atomic namenode op).  Only on refusal — WebHDFS RENAME returns
-        # {"boolean": false} when the destination exists — DELETE the old
-        # file and retry, matching CREATE&overwrite=true semantics while
-        # keeping the old version live until the last possible moment.
+        if self._failed:
+            self._delete_tmp()
+            return  # the original flush error stands
         try:
+            # an empty file still needs its CREATE
+            if self._buf or not self._created:
+                self._flush(len(self._buf))
+            # RENAME first (the common fresh-destination case commits in
+            # one atomic namenode op).  Only on refusal — WebHDFS RENAME
+            # returns {"boolean": false} when the destination exists —
+            # DELETE the old file and retry, matching
+            # CREATE&overwrite=true semantics while keeping the old
+            # version live until the last possible moment.
             if not self._rename():
                 _request(_op_url(self._base, self._path, "DELETE"),
                          "DELETE", ok=(200, 404))
@@ -240,12 +260,7 @@ class WebHdfsWriteStream(Stream):
                       f"WebHDFS RENAME {self._tmp} -> {self._path} "
                       f"refused by namenode after destination delete")
         except Exception:
-            # don't strand the temp file next to the data
-            try:
-                _request(_op_url(self._base, self._tmp, "DELETE"),
-                         "DELETE", ok=(200, 404))
-            except DMLCError:
-                pass
+            self._delete_tmp()  # don't strand the temp next to the data
             raise
 
     def _rename(self) -> bool:

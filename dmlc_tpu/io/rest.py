@@ -1,0 +1,73 @@
+"""Shared REST request machinery for the cloud filesystem backends.
+
+One retry/backoff loop (transient 408/429/5xx with exponential sleep,
+``DMLCError.status`` carrying the HTTP code on permanent failure) used
+by the Azure and S3 backends; GCS keeps its own loop because its
+resumable-upload protocol treats specific codes (308) as answers and
+tracks transience on its error type, and WebHDFS keeps its own because
+of the namenode 307 redirect dance.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Optional
+
+from ..base import DMLCError, check
+
+__all__ = ["TRANSIENT_HTTP", "rest_request"]
+
+TRANSIENT_HTTP = {408, 429, 500, 502, 503, 504}
+
+Signer = Callable[[str, str, dict, Optional[bytes]], dict]
+
+
+def rest_request(service: str, url: str, method: str = "GET",
+                 data: Optional[bytes] = None,
+                 headers: Optional[dict] = None,
+                 ok=(200, 201, 204, 206),
+                 sign: Optional[Signer] = None,
+                 retries_env: str = "DMLC_REST_RETRIES"):
+    """One signed call with transient-error retry.
+
+    ``sign(method, url, headers, data) -> headers`` runs per attempt, so
+    time-stamped signatures stay fresh across retries.  Callers must only
+    route idempotent operations here (blind resend on a transient error).
+    An HTTPError whose code is listed in ``ok`` is returned, not raised
+    (e.g. DELETE of an already-absent path answering 404).
+    """
+    attempts = int(os.environ.get(retries_env, "4"))
+    last = "no attempts"
+    for i in range(attempts):
+        hdrs = sign(method, url, headers or {}, data) if sign \
+            else dict(headers or {})
+        hdrs.pop("host", None)  # urllib sets Host itself
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers=hdrs)
+        try:
+            resp = urllib.request.urlopen(req, timeout=60)
+        except urllib.error.HTTPError as e:
+            if e.code in ok:
+                return e
+            if e.code in TRANSIENT_HTTP and i + 1 < attempts:
+                last = f"HTTP {e.code}"
+                time.sleep(0.25 * (2 ** i))
+                continue
+            raise DMLCError(
+                f"{service} {method} {url.split('?')[0]} failed: "
+                f"HTTP {e.code} {e.read()[:300]!r}", status=e.code) from e
+        except urllib.error.URLError as e:
+            if i + 1 < attempts:
+                last = str(e.reason)
+                time.sleep(0.25 * (2 ** i))
+                continue
+            raise DMLCError(f"{service} {method} {url.split('?')[0]} "
+                            f"failed: {e.reason}") from e
+        check(resp.status in ok,
+              f"{service} {method}: unexpected HTTP {resp.status}")
+        return resp
+    raise DMLCError(f"{service} {method} {url.split('?')[0]} failed "
+                    f"after {attempts} attempts: {last}")

@@ -36,6 +36,7 @@ class _FakeNameNode(BaseHTTPRequestHandler):
     like a real namenode brokering to a datanode."""
 
     store = {}  # "/abs/path" -> bytearray
+    fail_next_append = [False]  # one-shot: 500 the next APPEND payload
 
     def log_message(self, *a):
         pass
@@ -169,6 +170,12 @@ class _FakeNameNode(BaseHTTPRequestHandler):
         if not on_dn:
             self._redirect_to_dn()
             return
+        if self.fail_next_append[0]:
+            self.fail_next_append[0] = False
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            self._reply(500)
+            return
         if path not in self.store:
             self._reply(404)
             return
@@ -230,6 +237,52 @@ def test_hdfs_write_is_invisible_until_close(hdfs_server):
     names = [e.path.name for e in
              fs.list_directory(URI("hdfs://nn/torn"))]
     assert names == ["/torn/out.bin"]
+
+
+def test_hdfs_failed_flush_poisons_stream(hdfs_server):
+    """A lost chunk must never let close() rename a truncated temp over
+    the destination; the temp is cleaned up and the original error
+    stands (close() raises nothing new)."""
+    from dmlc_tpu.base import DMLCError
+
+    os.environ["DMLC_HDFS_WRITE_BUFFER_MB"] = "1"
+    try:
+        s = Stream.create("hdfs://nn/poison/f.bin", "w")
+        s.write(b"a" * (1 << 20))  # CREATE flush lands
+        _FakeNameNode.fail_next_append[0] = True
+        with pytest.raises(DMLCError):
+            s.write(b"b" * (1 << 20))
+        s.close()  # must not publish, must not raise
+    finally:
+        os.environ.pop("DMLC_HDFS_WRITE_BUFFER_MB")
+    fs = FileSystem.get_instance(URI("hdfs://nn/poison"))
+    with pytest.raises(FileNotFoundError):
+        fs.get_path_info(URI("hdfs://nn/poison/f.bin"))
+    assert not [p for p in _FakeNameNode.store if ".tmp." in p], \
+        "temp litter after failed write"
+
+
+def test_azure_failed_block_poisons_stream(azure_server):
+    from dmlc_tpu.base import DMLCError
+
+    os.environ["DMLC_AZURE_BLOCK_MB"] = "1"
+    os.environ["DMLC_AZURE_RETRIES"] = "1"
+    try:
+        s = Stream.create("azure://cont/poison/b.bin", "w")
+        s.write(b"a" * (1 << 20))  # block 0 stages fine
+        _FakeAzure.fail_next_block[0] = True
+        with pytest.raises(DMLCError):
+            s.write(b"b" * (1 << 20))
+        s.close()  # must not commit a block list with a hole
+    finally:
+        os.environ.pop("DMLC_AZURE_BLOCK_MB")
+        os.environ.pop("DMLC_AZURE_RETRIES")
+    fs = FileSystem.get_instance(URI("azure://cont/poison"))
+    with pytest.raises(FileNotFoundError):
+        fs.get_path_info(URI("azure://cont/poison/b.bin"))
+    # the abandoned staged block is uncommitted server state that real
+    # Azure GCs after 7 days; drop it so later tests see a clean slate
+    _FakeAzure.blocks.clear()
 
 
 def test_hdfs_overwrite_existing_destination(hdfs_server):
@@ -294,6 +347,7 @@ class _FakeAzure(BaseHTTPRequestHandler):
     store = {}   # (container, blob) -> bytes
     blocks = {}  # (container, blob) -> {blockid: bytes}, uncommitted
     require_auth = True
+    fail_next_block = [False]  # one-shot: 500 the next Put Block
 
     def log_message(self, *a):
         pass
@@ -391,6 +445,10 @@ class _FakeAzure(BaseHTTPRequestHandler):
             return
         container, blob, q = self._key()
         if q.get("comp") == "block":
+            if self.fail_next_block[0]:
+                self.fail_next_block[0] = False
+                self._reply(500)
+                return
             # staged, invisible until a blocklist commit
             bid = q["blockid"]
             self.blocks.setdefault((container, blob), {})[bid] = \

@@ -462,15 +462,10 @@ def test_mmap_before_first_rereads_identically(tmp_path):
     assert first == second
 
 
-def test_known_unbuilt_protocols_give_guidance():
+def test_unknown_protocols_give_guidance():
     from dmlc_tpu.io.filesys import FileSystem
     from dmlc_tpu.io.uri import URI
 
-    # hdfs:// and azure:// gained real backends in round 4 (WebHDFS / Blob
-    # REST), so dispatch now resolves them; s3:// is still a guidance stub
-    # and truly unknown protocols get the generic actionable error.
-    with pytest.raises(DMLCError, match="not built into dmlc_tpu"):
-        FileSystem.get_instance(URI("s3://bucket/key"))
     with pytest.raises(DMLCError, match="unknown filesystem protocol"):
         FileSystem.get_instance(URI("xyz://whatever"))
 
@@ -479,5 +474,8 @@ def test_builtin_network_protocols_resolve():
     from dmlc_tpu.io.filesys import FileSystem
     from dmlc_tpu.io.uri import URI
 
-    for proto in ("hdfs://nn/path", "azure://c/b", "http://h/p", "gs://b/k"):
+    # hdfs:// and azure:// gained real backends in round 4 (WebHDFS /
+    # Blob REST) and s3:// in round 5 (SigV4 REST)
+    for proto in ("hdfs://nn/path", "azure://c/b", "http://h/p",
+                  "gs://b/k", "s3://b/k"):
         assert FileSystem.get_instance(URI(proto)) is not None

@@ -1,0 +1,346 @@
+"""S3 backend against a local in-process emulator.
+
+Same hermetic strategy as tests/test_hdfs_azure.py: a stdlib HTTP
+server implements the protocol slice the backend speaks — SigV4
+signature verification by countersigning with the client's own
+x-amz-date, ListObjectsV2 XML, and the multipart upload lifecycle —
+and the SAME Stream/InputSplit code paths run over s3:// URIs.
+"""
+
+import os
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dmlc_tpu.base import DMLCError
+from dmlc_tpu.io import input_split
+from dmlc_tpu.io.filesys import FileSystem
+from dmlc_tpu.io.stream import Stream
+from dmlc_tpu.io.uri import URI
+
+
+def _drop_cached_instances():
+    for key in [k for k in FileSystem._instances if k.startswith("s3://")]:
+        del FileSystem._instances[key]
+
+
+class _FakeS3(BaseHTTPRequestHandler):
+    store = {}      # (bucket, key) -> bytes
+    uploads = {}    # upload_id -> {"target": (bucket, key), parts: {n: bytes}}
+    aborted = []    # upload ids that got AbortMultipartUpload
+    next_upload = [0]
+    require_auth = True
+    fail_next_part = [False]  # one-shot: 500 the next UploadPart
+
+    def log_message(self, *a):
+        pass
+
+    def _reply(self, code, body=b"", headers=()):
+        self.send_response(code)
+        for k, v in headers:
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _verify_auth(self, body=b""):
+        """Countersign with the client's own x-amz-date + signed header
+        set; reject a missing or mismatched SigV4 signature."""
+        import hashlib
+
+        from dmlc_tpu.io.s3_filesys import sign_request
+
+        if not self.require_auth:
+            return True
+        got = self.headers.get("Authorization")
+        if got is None:
+            self.send_error(403, "missing signature")
+            return False
+        signed = got.split("SignedHeaders=")[1].split(",")[0].split(";")
+        hdrs = {k: v for k, v in self.headers.items()
+                if k.lower() in signed and k.lower() != "host"}
+        url = f"http://{self.headers.get('Host')}{self.path}"
+        want = sign_request(
+            self.command, url, hdrs,
+            payload_hash=hashlib.sha256(body).hexdigest(),
+        ).get("Authorization")
+        if got != want:
+            self.send_error(403, "signature mismatch")
+            return False
+        return True
+
+    def _key(self):
+        u = urllib.parse.urlparse(self.path)
+        parts = u.path.lstrip("/").split("/", 1)
+        bucket = parts[0]
+        key = urllib.parse.unquote(parts[1]) if len(parts) > 1 else ""
+        q = {k: v[0] for k, v in
+             urllib.parse.parse_qs(u.query, keep_blank_values=True).items()}
+        return bucket, key, q
+
+    def do_HEAD(self):
+        if not self._verify_auth():
+            return
+        bucket, key, _ = self._key()
+        data = self.store.get((bucket, key))
+        if data is None:
+            self._reply(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+
+    def do_GET(self):
+        if not self._verify_auth():
+            return
+        bucket, key, q = self._key()
+        if q.get("list-type") == "2":
+            prefix = q.get("prefix", "")
+            delim = q.get("delimiter")
+            objs, prefixes = [], set()
+            for (b, k), data in sorted(self.store.items()):
+                if b != bucket or not k.startswith(prefix):
+                    continue
+                rest = k[len(prefix):]
+                if delim and delim in rest:
+                    prefixes.add(prefix + rest.split(delim)[0] + delim)
+                else:
+                    objs.append(f"<Contents><Key>{k}</Key>"
+                                f"<Size>{len(data)}</Size></Contents>")
+            pres = "".join(f"<CommonPrefixes><Prefix>{p}</Prefix>"
+                           f"</CommonPrefixes>" for p in sorted(prefixes))
+            xml = ("<?xml version='1.0'?><ListBucketResult>"
+                   + "".join(objs) + pres + "</ListBucketResult>")
+            self._reply(200, xml.encode())
+            return
+        data = self.store.get((bucket, key))
+        if data is None:
+            self._reply(404)
+            return
+        rng = self.headers.get("Range")
+        if rng:
+            lo, hi = rng.split("=")[1].split("-")
+            self._reply(206, data[int(lo): int(hi) + 1])
+        else:
+            self._reply(200, data)
+
+    def do_POST(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        if not self._verify_auth(body):
+            return
+        bucket, key, q = self._key()
+        if "uploads" in q:
+            self.next_upload[0] += 1
+            uid = f"up-{self.next_upload[0]}"
+            self.uploads[uid] = {"target": (bucket, key), "parts": {}}
+            xml = (f"<?xml version='1.0'?><InitiateMultipartUploadResult>"
+                   f"<UploadId>{uid}</UploadId>"
+                   f"</InitiateMultipartUploadResult>")
+            self._reply(200, xml.encode())
+            return
+        if "uploadId" in q:
+            import xml.etree.ElementTree as ET
+
+            up = self.uploads.pop(q["uploadId"], None)
+            if up is None:
+                self._reply(404)
+                return
+            root = ET.fromstring(body)
+            nums = [int(p.findtext("PartNumber")) for p in root]
+            assert nums == sorted(nums)
+            data = b"".join(up["parts"][i] for i in nums)
+            self.store[up["target"]] = data
+            self._reply(200, b"<CompleteMultipartUploadResult/>")
+            return
+        self._reply(400)
+
+    def do_PUT(self):
+        n = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(n)
+        if not self._verify_auth(body):
+            return
+        bucket, key, q = self._key()
+        if "partNumber" in q:
+            if self.fail_next_part[0]:
+                self.fail_next_part[0] = False
+                self._reply(500)
+                return
+            up = self.uploads.get(q["uploadId"])
+            if up is None:
+                self._reply(404)
+                return
+            num = int(q["partNumber"])
+            up["parts"][num] = body
+            self._reply(200, headers=[("ETag", f'"etag-{num}"')])
+            return
+        self.store[(bucket, key)] = body
+        self._reply(200)
+
+    def do_DELETE(self):
+        if not self._verify_auth():
+            return
+        _bucket, _key, q = self._key()
+        if "uploadId" in q:
+            if self.uploads.pop(q["uploadId"], None) is not None:
+                self.aborted.append(q["uploadId"])
+                self._reply(204)
+            else:
+                self._reply(404)
+            return
+        self._reply(400)
+
+
+@pytest.fixture(scope="module")
+def s3_server():
+    _FakeS3.store.clear()
+    _FakeS3.uploads.clear()
+    del _FakeS3.aborted[:]
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeS3)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    keys = ("DMLC_S3_ENDPOINT", "AWS_ACCESS_KEY_ID",
+            "AWS_SECRET_ACCESS_KEY", "AWS_SESSION_TOKEN", "AWS_REGION")
+    saved = {k: os.environ.get(k) for k in keys}
+    os.environ["DMLC_S3_ENDPOINT"] = f"127.0.0.1:{srv.server_port}"
+    os.environ["AWS_ACCESS_KEY_ID"] = "AKIATEST"
+    os.environ["AWS_SECRET_ACCESS_KEY"] = "test-secret-key"
+    os.environ["AWS_REGION"] = "us-test-1"
+    os.environ.pop("AWS_SESSION_TOKEN", None)
+    _drop_cached_instances()
+    yield srv
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    _drop_cached_instances()
+    srv.shutdown()
+
+
+def test_s3_write_read_roundtrip(s3_server):
+    import numpy as np
+
+    payload = bytes(np.random.default_rng(4).integers(
+        0, 256, 180_000, dtype=np.uint8))
+    with Stream.create("s3://bkt/dir/obj.bin", "w") as s:
+        s.write(payload[:90_000])
+        s.write(payload[90_000:])
+    strm = Stream.create_for_read("s3://bkt/dir/obj.bin")
+    assert strm.read(len(payload) + 1) == payload
+    strm.seek(123_000)
+    assert strm.read(64) == payload[123_000:123_064]
+
+
+def test_s3_multipart_upload(s3_server):
+    """Above one part the writer switches to multipart: the object is
+    invisible until CompleteMultipartUpload and the bytes are exact."""
+    import numpy as np
+
+    payload = bytes(np.random.default_rng(5).integers(
+        0, 256, 2_750_000, dtype=np.uint8))
+    os.environ["DMLC_S3_WRITE_BUFFER_MB"] = "1"
+    # the 5 MiB AWS floor would swallow a 1 MB test part; drop it via
+    # the module's own clamp by patching the env knob only
+    from dmlc_tpu.io import s3_filesys
+
+    orig = s3_filesys.S3WriteStream.__init__
+
+    def patched(self, url):
+        orig(self, url)
+        self._part = 1 << 20
+
+    s3_filesys.S3WriteStream.__init__ = patched
+    try:
+        s = Stream.create("s3://bkt/big/model.bin", "w")
+        for lo in range(0, len(payload), 600_000):
+            s.write(payload[lo: lo + 600_000])
+        fs = FileSystem.get_instance(URI("s3://bkt/big"))
+        with pytest.raises(FileNotFoundError):
+            fs.get_path_info(URI("s3://bkt/big/model.bin"))
+        s.close()
+    finally:
+        s3_filesys.S3WriteStream.__init__ = orig
+        os.environ.pop("DMLC_S3_WRITE_BUFFER_MB")
+    strm = Stream.create_for_read("s3://bkt/big/model.bin")
+    assert strm.read(len(payload) + 1) == payload
+    assert not _FakeS3.uploads  # commit consumed the upload session
+
+
+def test_s3_failed_upload_is_aborted(s3_server):
+    from dmlc_tpu.io import s3_filesys
+
+    orig = s3_filesys.S3WriteStream.__init__
+
+    def patched(self, url):
+        orig(self, url)
+        self._part = 1 << 20
+
+    s3_filesys.S3WriteStream.__init__ = patched
+    os.environ["DMLC_S3_RETRIES"] = "1"  # make the injected 500 fatal
+    try:
+        s = Stream.create("s3://bkt/fail/x.bin", "w")
+        s.write(b"a" * (1 << 20))  # part 1 lands, multipart started
+        _FakeS3.fail_next_part[0] = True
+        with pytest.raises(DMLCError):
+            s.write(b"b" * (1 << 20))
+        # the stream is poisoned: the with-block exit's close() must not
+        # publish an object missing the lost part, and must not raise a
+        # second error that would mask the original one
+        s.close()
+    finally:
+        s3_filesys.S3WriteStream.__init__ = orig
+        os.environ.pop("DMLC_S3_RETRIES")
+    assert _FakeS3.aborted, "failed multipart upload was not aborted"
+    assert not _FakeS3.uploads
+    fs = FileSystem.get_instance(URI("s3://bkt/fail"))
+    with pytest.raises(FileNotFoundError):
+        fs.get_path_info(URI("s3://bkt/fail/x.bin"))
+
+
+def test_s3_signature_rejected_without_key(s3_server):
+    # client and emulator share this process's env, so a WRONG key would
+    # countersign identically; dropping the key makes the client go
+    # anonymous and the server reject the missing signature
+    with Stream.create("s3://bkt/sec/y.bin", "w") as s:
+        s.write(b"payload")
+    key = os.environ.pop("AWS_SECRET_ACCESS_KEY")
+    try:
+        with pytest.raises(DMLCError, match="403"):
+            Stream.create_for_read("s3://bkt/sec/y.bin").read(7)
+    finally:
+        os.environ["AWS_SECRET_ACCESS_KEY"] = key
+
+
+def test_s3_stat_and_list(s3_server):
+    for name, data in [("d/a.bin", b"xx"), ("d/b.bin", b"yyy"),
+                       ("d/sub/c.bin", b"z")]:
+        with Stream.create(f"s3://bkt/{name}", "w") as s:
+            s.write(data)
+    fs = FileSystem.get_instance(URI("s3://bkt/d"))
+    entries = fs.list_directory(URI("s3://bkt/d"))
+    names = {e.path.name: (e.type, e.size) for e in entries}
+    assert names.get("/d/a.bin") == ("file", 2)
+    assert names.get("/d/b.bin") == ("file", 3)
+    assert names.get("/d/sub") == ("directory", 0)
+    rec = fs.list_directory_recursive(URI("s3://bkt/d"))
+    assert sum(e.size for e in rec) == 6
+    assert fs.get_path_info(URI("s3://bkt/d/a.bin")).size == 2
+    assert fs.get_path_info(URI("s3://bkt/d")).type == "directory"
+    with pytest.raises(FileNotFoundError):
+        fs.get_path_info(URI("s3://bkt/nope"))
+
+
+def test_inputsplit_over_s3(s3_server):
+    """The round-trip that makes existing DMLC data URIs work unchanged:
+    s3:// straight into InputSplit sharding."""
+    lines = [f"s3-{i}" for i in range(140)]
+    with Stream.create("s3://bkt/ds/t.txt", "w") as s:
+        s.write(("\n".join(lines) + "\n").encode())
+    got = []
+    for part in range(3):
+        sp = input_split.create("s3://bkt/ds/t.txt", part, 3, "text")
+        got += [bytes(r).decode() for r in sp]
+        sp.close()
+    assert sorted(got) == sorted(lines)
