@@ -194,28 +194,31 @@ class S3WriteStream(Stream):
         return len(data)
 
     def _put_part(self, n: int) -> None:
-        if self._upload_id is None:
-            resp = _request(f"{self._url}?uploads=", "POST", data=b"")
-            self._upload_id = ET.fromstring(resp.read()).findtext(
-                "{*}UploadId") or ""
-            check(self._upload_id, "S3 InitiateMultipartUpload: no UploadId")
-        body = bytes(self._buf[:n])
-        del self._buf[:n]
+        # ANY failure in here — init, part PUT, or a bogus no-ETag
+        # reply — loses bytes the object can never get back: poison the
+        # stream so the close() in a with-block exit cannot publish a
+        # truncated (single-shot branch) or holed (commit branch)
+        # object, and abort the upload
         try:
+            if self._upload_id is None:
+                resp = _request(f"{self._url}?uploads=", "POST", data=b"")
+                self._upload_id = ET.fromstring(resp.read()).findtext(
+                    "{*}UploadId") or ""
+                check(self._upload_id,
+                      "S3 InitiateMultipartUpload: no UploadId")
+            body = bytes(self._buf[:n])
+            del self._buf[:n]
             resp = _request(
                 f"{self._url}?partNumber={len(self._etags) + 1}"
                 f"&uploadId={urllib.parse.quote(self._upload_id)}",
                 "PUT", data=body)
+            etag = resp.headers.get("ETag", "")
+            check(bool(etag), "S3 UploadPart: no ETag in response")
+            self._etags.append(etag)
         except Exception:
-            # a lost part means the object can never be committed whole:
-            # poison the stream so the close() in a with-block exit
-            # cannot publish a corrupt object, and abort the upload
             self._failed = True
             self._abort()
             raise
-        etag = resp.headers.get("ETag", "")
-        check(bool(etag), "S3 UploadPart: no ETag in response")
-        self._etags.append(etag)
 
     def _abort(self) -> None:
         if self._upload_id is None:

@@ -62,6 +62,8 @@ class TrackerClient:
     # ---- rendezvous ----------------------------------------------------
     def start(self, world_size: int = -1, cmd: str = "start") -> "TrackerClient":
         """Rendezvous: obtain rank + topology, establish peer links."""
+        if self._listener is not None:  # recover: drop the old accept port
+            self._listener.close()
         self._listener = socket.socket()
         self._listener.bind(("0.0.0.0", 0))
         self._listener.listen(16)

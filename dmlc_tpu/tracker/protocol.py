@@ -47,13 +47,21 @@ class FrameSocket:
     def send_int(self, v: int) -> None:
         self.sock.sendall(_INT.pack(v))
 
+    # strings on this protocol are hostnames/jobids/log lines; a length
+    # outside this bound is a corrupt or hostile frame, and reading it
+    # as a buffer size would stall the tracker mid-allocation
+    MAX_STR = 1 << 20
+
     def send_str(self, s: str) -> None:
         data = s.encode()
         self.send_int(len(data))
         self.sock.sendall(data)
 
     def recv_str(self) -> str:
-        return self.recv_all(self.recv_int()).decode()
+        n = self.recv_int()
+        if not 0 <= n <= self.MAX_STR:
+            raise ConnectionError(f"bad string frame length {n}")
+        return self.recv_all(n).decode()
 
     def close(self) -> None:
         try:

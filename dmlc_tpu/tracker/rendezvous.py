@@ -17,9 +17,20 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..base import DMLCError
 from .protocol import MAGIC, FrameSocket, link_maps, resolve_ip
 
 logger = logging.getLogger("dmlc_tpu.tracker")
+
+
+def _sock_timeout() -> Optional[float]:
+    """Per-connection timeout for worker sockets.  A worker that dies
+    without a FIN (SIGKILL'd host, dropped link) would otherwise leave
+    the tracker blocked forever on a dead recv mid-brokering; the
+    reference tracker (tracker.py:80-135) hangs exactly this way.
+    0 disables (DMLC_TRACKER_TIMEOUT seconds, default 300)."""
+    t = float(os.environ.get("DMLC_TRACKER_TIMEOUT", "300"))
+    return t if t > 0 else None
 
 
 class AcceptRegistry:
@@ -63,6 +74,7 @@ class WorkerEntry:
     """One accepted worker connection (reference SlaveEntry role)."""
 
     def __init__(self, sock: socket.socket, addr):
+        sock.settimeout(_sock_timeout())
         self.sock = FrameSocket(sock)
         self.host = resolve_ip(addr[0])
         magic = self.sock.recv_int()
@@ -121,7 +133,11 @@ class WorkerEntry:
         while True:
             n_held = self.sock.recv_int()
             held = {self.sock.recv_int() for _ in range(n_held)}
-            assert held.issubset(required), (held, required)
+            if not held.issubset(required):
+                raise DMLCError(
+                    f"rank {rank} ({self.host}) reported links "
+                    f"{sorted(held - required)} outside its assigned "
+                    f"peer set {sorted(required)} — protocol violation")
             # dials that stuck during a FAILED earlier round show up in the
             # worker's held set now — charge their quotas exactly once
             confirmed = (held & dialed) - debited
@@ -189,38 +205,75 @@ class RabitTracker:
         parent_map = ring_map = None
         todo: List[int] = []
 
+        def fail(msg: str) -> DMLCError:
+            # protocol violations from REGISTERED workers corrupt the
+            # job's rank/link state: fail the whole tracker loudly (the
+            # reference dies on a bare assert here; we say why) — the
+            # launcher's retry machinery owns restarting the job
+            return DMLCError(f"tracker protocol violation: {msg}")
+
+        def broker(entry: "WorkerEntry", rank: int) -> None:
+            # a worker dying (or going silent past DMLC_TRACKER_TIMEOUT)
+            # mid-brokering leaves the overlay unbuildable: error out so
+            # join()/_await_job abort instead of hanging the whole gang
+            try:
+                entry.assign_rank(rank, registry, tree_map, parent_map,
+                                  ring_map)
+            except socket.timeout as e:
+                raise DMLCError(
+                    f"worker rank {rank} ({entry.host}) went silent "
+                    f"mid-brokering (DMLC_TRACKER_TIMEOUT="
+                    f"{_sock_timeout()}s)") from e
+            except OSError as e:
+                raise DMLCError(
+                    f"worker rank {rank} ({entry.host}) died "
+                    f"mid-brokering: {e}") from e
+
         while len(shutdown) != n_workers:
             fd, addr = self.sock.accept()
             try:
                 w = WorkerEntry(fd, addr)
-            except ConnectionError as e:
-                logger.warning("rejected connection: %s", e)
+                if w.cmd == "print":
+                    logger.info("%s", w.sock.recv_str().strip())
+                    continue
+            except (OSError, UnicodeDecodeError) as e:
+                # pre-registration garbage (port scans, torn handshakes,
+                # bad frames) must not kill the job: reject and serve on
+                logger.warning("rejected connection from %s: %s",
+                               addr[0], e)
                 fd.close()
                 continue
-            if w.cmd == "print":
-                logger.info("%s", w.sock.recv_str().strip())
-                continue
             if w.cmd == "shutdown":
-                assert w.rank >= 0 and w.rank not in shutdown
-                assert w.rank not in registry
+                if w.rank < 0 or w.rank in shutdown:
+                    raise fail(f"shutdown from rank {w.rank} "
+                               f"(already shut down or never assigned)")
+                if w.rank in registry:
+                    raise fail(f"rank {w.rank} shut down while peers "
+                               f"still expect to dial it")
                 shutdown[w.rank] = w
                 logger.debug("shutdown from rank %d", w.rank)
                 continue
-            assert w.cmd in ("start", "recover"), w.cmd
+            if w.cmd not in ("start", "recover"):
+                raise fail(f"unknown command {w.cmd!r} from {w.host}")
             if tree_map is None:
-                assert w.cmd == "start"
+                if w.cmd != "start":
+                    raise fail(f"{w.cmd!r} from {w.host} before any "
+                               f"worker started")
                 if w.world_size > 0:
                     n_workers = w.world_size
                 tree_map, parent_map, ring_map = link_maps(n_workers)
                 todo = list(range(n_workers))
-            else:
-                assert w.world_size in (-1, n_workers)
-            if w.cmd == "recover":
-                assert w.rank >= 0
+            elif w.world_size not in (-1, n_workers):
+                raise fail(f"{w.host} announced world_size "
+                           f"{w.world_size} != {n_workers}")
+            if w.cmd == "recover" and w.rank < 0:
+                raise fail(f"recover without a rank from {w.host}")
 
             rank = w.decide_rank(job_map)
             if rank == -1:
-                assert todo, "no rank slots left"
+                if not todo:
+                    raise fail(f"{w.host} asked for a rank but all "
+                               f"{n_workers} slots are assigned")
                 pending.append(w)
                 if len(pending) == len(todo):
                     pending.sort(key=lambda x: x.host)  # locality
@@ -228,15 +281,14 @@ class RabitTracker:
                         rank = todo.pop(0)
                         if p.jobid != "NULL":
                             job_map[p.jobid] = rank
-                        p.assign_rank(rank, registry, tree_map, parent_map,
-                                      ring_map)
+                        broker(p, rank)
                         logger.debug("assigned rank %d to %s", p.rank, p.host)
                     pending = []
                 if not todo:
                     logger.info("@tracker all %d workers started", n_workers)
                     self.start_time = time.time()
             else:
-                w.assign_rank(rank, registry, tree_map, parent_map, ring_map)
+                broker(w, rank)
                 logger.debug("%s from rank %d", w.cmd, w.rank)
         self.end_time = time.time()
         if self.start_time is not None:

@@ -33,6 +33,7 @@ class _FakeS3(BaseHTTPRequestHandler):
     next_upload = [0]
     require_auth = True
     fail_next_part = [False]  # one-shot: 500 the next UploadPart
+    fail_next_init = [False]  # one-shot: 500 the next ?uploads= POST
 
     def log_message(self, *a):
         pass
@@ -133,6 +134,10 @@ class _FakeS3(BaseHTTPRequestHandler):
             return
         bucket, key, q = self._key()
         if "uploads" in q:
+            if self.fail_next_init[0]:
+                self.fail_next_init[0] = False
+                self._reply(500)
+                return
             self.next_upload[0] += 1
             uid = f"up-{self.next_upload[0]}"
             self.uploads[uid] = {"target": (bucket, key), "parts": {}}
@@ -297,6 +302,35 @@ def test_s3_failed_upload_is_aborted(s3_server):
     fs = FileSystem.get_instance(URI("s3://bkt/fail"))
     with pytest.raises(FileNotFoundError):
         fs.get_path_info(URI("s3://bkt/fail/x.bin"))
+
+
+def test_s3_failed_init_poisons_stream(s3_server):
+    """A failed InitiateMultipartUpload must poison the stream too:
+    close() must NOT fall back to the single-shot PUT branch and publish
+    the partial buffer as a complete object."""
+    from dmlc_tpu.io import s3_filesys
+
+    orig = s3_filesys.S3WriteStream.__init__
+
+    def patched(self, url):
+        orig(self, url)
+        self._part = 1 << 20
+
+    s3_filesys.S3WriteStream.__init__ = patched
+    os.environ["DMLC_S3_RETRIES"] = "1"
+    _FakeS3.fail_next_init[0] = True
+    try:
+        s = Stream.create("s3://bkt/noinit/x.bin", "w")
+        with pytest.raises(DMLCError):
+            s.write(b"c" * (1 << 20))
+        s.close()  # must not single-shot-PUT the partial buffer
+    finally:
+        s3_filesys.S3WriteStream.__init__ = orig
+        os.environ.pop("DMLC_S3_RETRIES")
+        _FakeS3.fail_next_init[0] = False
+    fs = FileSystem.get_instance(URI("s3://bkt/noinit"))
+    with pytest.raises(FileNotFoundError):
+        fs.get_path_info(URI("s3://bkt/noinit/x.bin"))
 
 
 def test_s3_signature_rejected_without_key(s3_server):

@@ -150,3 +150,130 @@ def test_recover_single_worker():
     c.shutdown()
     tracker.join(timeout=10)
     tracker.close()
+
+
+# ---------------------------------------------------------------------------
+# Adversarial behavior (SURVEY.md §4: the reference tracker hangs or dies
+# on a bare assert in every one of these scenarios)
+# ---------------------------------------------------------------------------
+
+def _raw_session(port, rank=-1, world=-1, jobid="NULL", cmd="start"):
+    """Hand-rolled handshake so tests control exactly when the 'worker'
+    stops cooperating."""
+    import socket
+
+    from dmlc_tpu.tracker.protocol import MAGIC, FrameSocket
+
+    fs = FrameSocket(socket.create_connection(("127.0.0.1", port)))
+    fs.send_int(MAGIC)
+    assert fs.recv_int() == MAGIC
+    fs.send_int(rank)
+    fs.send_int(world)
+    fs.send_str(jobid)
+    fs.send_str(cmd)
+    return fs
+
+
+def test_worker_death_mid_brokering_errors_not_hangs():
+    """A worker that closes its socket after the handshake (killed while
+    the tracker brokers its links) must fail the tracker promptly with a
+    diagnosable error — not leave assign_rank blocked forever."""
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+    fs = _raw_session(tracker.port, world=1)
+    fs.recv_int()  # topology starts arriving: brokering is in flight
+    fs.close()     # die mid-brokering
+    with pytest.raises(RuntimeError, match="died mid-brokering"):
+        tracker.join(timeout=15)
+    tracker.close()
+
+
+def test_worker_silence_mid_brokering_times_out(monkeypatch):
+    """A worker that goes silent without closing (SIGSTOP, dead host, no
+    FIN) trips DMLC_TRACKER_TIMEOUT instead of hanging forever."""
+    monkeypatch.setenv("DMLC_TRACKER_TIMEOUT", "1")
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+    fs = _raw_session(tracker.port, world=1)
+    # read the topology but never answer the brokering round
+    for _ in range(6):
+        fs.recv_int()
+    with pytest.raises(RuntimeError, match="went silent"):
+        tracker.join(timeout=15)
+    fs.close()
+    tracker.close()
+
+
+def test_garbage_connections_rejected_job_succeeds():
+    """Pre-registration garbage (port scans, bad magic, hostile frame
+    lengths) must be rejected without poisoning the job: a real worker
+    rendezvous completes on the same tracker afterwards."""
+    import socket
+
+    from dmlc_tpu.tracker.protocol import MAGIC, FrameSocket
+
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+    # bad magic
+    g1 = FrameSocket(socket.create_connection(("127.0.0.1", tracker.port)))
+    g1.send_int(0xDEAD)
+    # valid magic, then a hostile negative string length for the jobid
+    g2 = FrameSocket(socket.create_connection(("127.0.0.1", tracker.port)))
+    g2.send_int(MAGIC)
+    assert g2.recv_int() == MAGIC
+    g2.send_int(-1)
+    g2.send_int(-1)
+    g2.send_int(-7)  # jobid "length"
+    # valid magic, then torn off mid-handshake
+    g3 = FrameSocket(socket.create_connection(("127.0.0.1", tracker.port)))
+    g3.send_int(MAGIC)
+    g3.close()
+
+    c = TrackerClient("127.0.0.1", tracker.port, jobid="legit")
+    c.start()
+    assert c.rank == 0
+    c.shutdown()
+    tracker.join(timeout=15)
+    g1.close()
+    g2.close()
+    tracker.close()
+
+
+def test_extra_worker_beyond_world_size_fails_loudly():
+    """An extra 'start' once all rank slots are assigned is a protocol
+    violation that must surface, not deadlock the batch assignment."""
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+    c = TrackerClient("127.0.0.1", tracker.port, jobid="only")
+    c.start()
+    assert c.rank == 0
+    _raw_session(tracker.port, jobid="extra")  # one worker too many
+    with pytest.raises(RuntimeError, match="slots are assigned"):
+        tracker.join(timeout=15)
+    # no c.shutdown(): the accept loop is dead, nobody would answer the
+    # shutdown handshake — the launcher kills workers of an aborted job
+    tracker.close()
+
+
+def test_worker_death_during_batch_brokering():
+    """n=2: one real client plus one fake that dies right after the
+    batch assignment begins — the survivor must not hang forever and
+    the tracker must error out."""
+    tracker = RabitTracker("127.0.0.1", 2)
+    tracker.start(2)
+    errors = []
+
+    def survivor():
+        try:
+            TrackerClient("127.0.0.1", tracker.port, jobid="sv").start()
+        except Exception as e:
+            errors.append(e)
+
+    t = threading.Thread(target=survivor, daemon=True)
+    t.start()
+    fs = _raw_session(tracker.port, jobid="dier")
+    fs.close()  # both workers are now pending; the dier is already gone
+    with pytest.raises(RuntimeError,
+                       match="mid-brokering|protocol violation"):
+        tracker.join(timeout=15)
+    tracker.close()
