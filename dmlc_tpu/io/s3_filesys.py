@@ -202,10 +202,11 @@ class S3WriteStream(Stream):
         try:
             if self._upload_id is None:
                 resp = _request(f"{self._url}?uploads=", "POST", data=b"")
-                self._upload_id = ET.fromstring(resp.read()).findtext(
-                    "{*}UploadId") or ""
-                check(self._upload_id,
-                      "S3 InitiateMultipartUpload: no UploadId")
+                uid = ET.fromstring(resp.read()).findtext("{*}UploadId")
+                # assign only after validation: _abort() must not fire a
+                # bogus empty-uploadId DELETE when the reply is malformed
+                check(bool(uid), "S3 InitiateMultipartUpload: no UploadId")
+                self._upload_id = uid
             body = bytes(self._buf[:n])
             del self._buf[:n]
             resp = _request(

@@ -244,9 +244,10 @@ class RabitTracker:
                 fd.close()
                 continue
             if w.cmd == "shutdown":
-                if w.rank < 0 or w.rank in shutdown:
+                if w.rank < 0 or w.rank >= n_workers or w.rank in shutdown:
                     raise fail(f"shutdown from rank {w.rank} "
-                               f"(already shut down or never assigned)")
+                               f"(out of range for {n_workers} workers, "
+                               f"already shut down, or never assigned)")
                 if w.rank in registry:
                     raise fail(f"rank {w.rank} shut down while peers "
                                f"still expect to dial it")
@@ -270,6 +271,12 @@ class RabitTracker:
                 raise fail(f"recover without a rank from {w.host}")
 
             rank = w.decide_rank(job_map)
+            # a client-supplied rank must be a real slot — an out-of-range
+            # value would KeyError deep inside the topology send instead
+            # of dying diagnosably here
+            if rank >= n_workers:
+                raise fail(f"{w.cmd!r} from {w.host} announced rank "
+                           f"{rank} >= world size {n_workers}")
             if rank == -1:
                 if not todo:
                     raise fail(f"{w.host} asked for a rank but all "

@@ -93,14 +93,15 @@ def fmt_bench_lines(bench, coll):
     if big and mid:
         lines.append(
             f"- Native collective ABI, n={coll['world']} on one core: "
-            f"{mid['aggregate_link_MBps'] / 1e3:.1f} GB/s aggregate link "
-            f"throughput at 1 MB; at 64 MB the fused up/down tree pipeline "
-            f"moves {big['aggregate_link_MBps'] / 1e3:.1f} GB/s aggregate = "
+            f"allreduce busbw {big['busbw_MBps']:.0f} MB/s at 64 MB / "
+            f"{mid['busbw_MBps']:.0f} MB/s at 1 MB via the same-host "
+            f"shared-memory transport (slice-reduce in user space, the "
+            f"NCCL intra-node move rabit never had) — "
+            f"{big['aggregate_link_MBps'] / 1e3:.1f} GB/s aggregate, "
             f"**{coll['allreduce_64MB_link_vs_loopback']:.2f}× the host's "
-            f"single-stream loopback line rate** "
-            f"({coll['loopback_MBps'] / 1e3:.1f} GB/s), i.e. transport "
-            "saturation (algbw "
-            f"{big['algbw_MBps']:.0f} MB/s, busbw {big['busbw_MBps']:.0f}).")
+            f"TCP loopback line rate** "
+            f"({coll['loopback_MBps'] / 1e3:.1f} GB/s) that the tuned "
+            f"tree/ring TCP fallback (cross-host links) is bounded by.")
     return lines
 
 

@@ -82,9 +82,14 @@ def test_gbdt_allreduce_matches_single_process(gbdt):
 
 
 @pytest.mark.parametrize("world", [1, 2, 5, 8])
-def test_c_driver_collectives_under_local_launcher(driver, world):
+@pytest.mark.parametrize("shm", ["1", "0"])
+def test_c_driver_collectives_under_local_launcher(driver, world, shm):
+    """Both transports: the same-host shared-memory fast path (default
+    on a local gang) and the TCP tree/ring fallback (DMLC_COLL_SHM=0 —
+    what cross-host links ride)."""
     env = os.environ.copy()
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["DMLC_COLL_SHM"] = shm
     r = subprocess.run(
         [sys.executable, "-m", "dmlc_tpu.tracker.submit",
          "--cluster", "local", "--num-workers", str(world), "--", driver],

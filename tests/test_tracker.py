@@ -255,6 +255,29 @@ def test_extra_worker_beyond_world_size_fails_loudly():
     tracker.close()
 
 
+def test_out_of_range_rank_fails_loudly():
+    """A hostile rank beyond world size must neither count toward the
+    shutdown quorum (ending the job early) nor KeyError deep in the
+    topology send — both are named protocol violations."""
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+    c = TrackerClient("127.0.0.1", tracker.port, jobid="w0")
+    c.start()
+    _raw_session(tracker.port, rank=99, cmd="recover")
+    with pytest.raises(RuntimeError, match="rank 99 >= world size"):
+        tracker.join(timeout=15)
+    tracker.close()
+
+    tracker2 = RabitTracker("127.0.0.1", 1)
+    tracker2.start(1)
+    c2 = TrackerClient("127.0.0.1", tracker2.port, jobid="w0")
+    c2.start()
+    _raw_session(tracker2.port, rank=99, cmd="shutdown")
+    with pytest.raises(RuntimeError, match="out of range"):
+        tracker2.join(timeout=15)
+    tracker2.close()
+
+
 def test_worker_death_during_batch_brokering():
     """n=2: one real client plus one fake that dies right after the
     batch assignment begins — the survivor must not hang forever and
