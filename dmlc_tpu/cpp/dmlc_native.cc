@@ -425,6 +425,50 @@ long dmlc_gather_spans(const char* src, long src_len, char* dst,
   return total;
 }
 
-int dmlc_native_abi_version() { return 3; }
+// Packed-batch assembly (recordio_packed_feed role): append record
+// spans of src WHOLE into the static batch buffer dst, starting at
+// dst_pos, until the batch is full — out of byte capacity or record
+// slots.  ends[i] receives the i-th packed record's END offset in dst.
+// A record that would overflow dst_cap ends the batch un-consumed,
+// EXCEPT when the batch is empty (allow_truncate): then it is packed
+// truncated to dst_cap so one oversized record cannot wedge the feed.
+// Returns the number of spans consumed (*out_pos = new fill position,
+// *out_full = 1 when the caller should emit), or -1 on a span that
+// walks outside src (corrupt chunk index).
+long dmlc_pack_spans(const char* src, long src_len, char* dst, long dst_cap,
+                     long dst_pos, const int64_t* offs, const int64_t* lens,
+                     long n, long slots, int allow_truncate, int64_t* ends,
+                     long* out_pos, int* out_full) {
+  long i = 0, pos = dst_pos;
+  int full = 0;
+  for (; i < n; ++i) {
+    if (i >= slots) {
+      full = 1;
+      break;
+    }
+    const int64_t off = offs[i], len = lens[i];
+    if (off < 0 || len < 0 || off > src_len || len > src_len - off)
+      return -1;
+    if (pos + len > dst_cap) {
+      if (i == 0 && allow_truncate) {
+        memcpy(dst + pos, src + off, static_cast<size_t>(dst_cap - pos));
+        ends[i] = dst_cap;
+        pos = dst_cap;
+        ++i;
+      }
+      full = 1;
+      break;
+    }
+    memcpy(dst + pos, src + off, static_cast<size_t>(len));
+    pos += len;
+    ends[i] = pos;
+  }
+  if (pos >= dst_cap) full = 1;
+  *out_pos = pos;
+  *out_full = full;
+  return i;
+}
+
+int dmlc_native_abi_version() { return 4; }
 
 }  // extern "C"

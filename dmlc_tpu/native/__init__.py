@@ -25,7 +25,7 @@ import numpy as np
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(os.path.dirname(_HERE), "cpp", "dmlc_native.cc")
 _SO = os.path.join(_HERE, "libdmlc_native.so")
-_ABI = 3
+_ABI = 4
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -93,6 +93,11 @@ def _load():
         lib.dmlc_gather_spans.argtypes = [
             c.c_void_p, c.c_long, c.c_void_p, c.c_void_p, c.c_void_p,
             c.c_void_p, c.c_void_p, c.c_long]
+        lib.dmlc_pack_spans.restype = c.c_long
+        lib.dmlc_pack_spans.argtypes = [
+            c.c_void_p, c.c_long, c.c_void_p, c.c_long, c.c_long,
+            c.c_void_p, c.c_void_p, c.c_long, c.c_long, c.c_int,
+            c.c_void_p, c.POINTER(c.c_long), c.POINTER(c.c_int)]
         _lib = lib
         return _lib
 
@@ -266,6 +271,61 @@ def gather_spans(src, offs: np.ndarray, lens: np.ndarray) -> Optional[np.ndarray
     if got != total:
         raise ValueError("gather_spans: span out of bounds for source")
     return dst
+
+
+def pack_spans(src, offs: np.ndarray, lens: np.ndarray, dst: np.ndarray,
+               dst_pos: int, slots: int, allow_truncate: bool,
+               ends_out: np.ndarray):
+    """Append record spans of ``src`` WHOLE into the packed batch buffer
+    ``dst`` from ``dst_pos`` until the batch fills (byte capacity or
+    ``slots`` record slots).  ``ends_out[:consumed]`` receives each
+    packed record's end offset.  A span that would overflow is left for
+    the next batch, except when ``allow_truncate`` (empty batch): then
+    it is packed truncated so one oversized record cannot wedge the
+    feed.  Returns ``(consumed, new_pos, full)``; works with or without
+    the native library (vectorized numpy fallback)."""
+    lib = _load()
+    n = len(lens)
+    cap = dst.size
+    if lib is not None:
+        _, ptr, src_len = _as_carray(src)
+        offs = np.ascontiguousarray(offs, np.int64)
+        lens = np.ascontiguousarray(lens, np.int64)
+        out_pos = ctypes.c_long()
+        out_full = ctypes.c_int()
+        consumed = lib.dmlc_pack_spans(
+            ptr, src_len, dst.ctypes.data, cap, dst_pos,
+            offs.ctypes.data, lens.ctypes.data, n, slots,
+            1 if allow_truncate else 0, ends_out.ctypes.data,
+            ctypes.byref(out_pos), ctypes.byref(out_full))
+        if consumed < 0:
+            raise ValueError("pack_spans: span out of bounds for source")
+        return int(consumed), int(out_pos.value), bool(out_full.value)
+    # fallback: one cumsum + searchsorted to find the fit, then span
+    # copies via numpy slice assignment
+    src_arr = np.frombuffer(src, np.uint8)
+    ends = dst_pos + np.cumsum(lens[:n], dtype=np.int64)
+    k = int(np.searchsorted(ends, cap, side="right"))
+    full = k < n or (k > 0 and int(ends[k - 1]) >= cap)
+    if k > slots:
+        k, full = slots, True
+    pos = dst_pos
+    for j in range(k):
+        o, ln = int(offs[j]), int(lens[j])
+        dst[pos: pos + ln] = src_arr[o: o + ln]
+        pos += ln
+        ends_out[j] = pos
+    # truncate only when a record slot exists AND the first record
+    # genuinely overflows — mirrors the native path, whose slot check
+    # runs before the truncate branch
+    if k == 0 and n > 0 and slots > 0 and allow_truncate \
+            and dst_pos + int(lens[0]) > cap:
+        m = cap - dst_pos
+        o = int(offs[0])
+        dst[dst_pos:] = src_arr[o: o + m]
+        ends_out[0] = cap
+        return 1, cap, True
+    return k, pos, full
 
 
 def recordio_find_last(data, magic: int) -> Optional[int]:

@@ -166,6 +166,67 @@ def test_recordio_packed_feed_content_exact(tmp_path):
     assert got == recs
 
 
+def test_recordio_packed_feed_native_fallback_parity(tmp_path, monkeypatch):
+    """The native dmlc_pack_spans path and the numpy fallback must emit
+    IDENTICAL batch streams — including oversized records (truncated to
+    buf_bytes), exact-fit batches, slot exhaustion, and escaped-magic
+    records."""
+    import struct
+
+    import dmlc_tpu.native as native_mod
+    from dmlc_tpu.io.recordio import KMAGIC, RecordIOWriter
+    from dmlc_tpu.io.stream import Stream
+
+    rng = np.random.default_rng(23)
+    magic = struct.pack("<I", KMAGIC)
+    recs = []
+    for i in range(60):
+        if i == 7 or i == 31:
+            body = bytes(rng.integers(0, 256, 700, dtype=np.uint8))  # > buf
+        elif i % 11 == 5:
+            body = b"a" * 4 + magic + b"b" * 8  # escaped magic
+        elif i % 13 == 6:
+            body = b""  # empty record
+        else:
+            body = bytes(rng.integers(0, 256, 1 + i % 90, dtype=np.uint8))
+        recs.append(body)
+    path = str(tmp_path / "parity.rec")
+    with Stream.create(path, "w") as s:
+        w = RecordIOWriter(s)
+        for r in recs:
+            w.write_record(r)
+
+    def run(disable_native):
+        if disable_native:
+            monkeypatch.setenv("DMLC_TPU_DISABLE_NATIVE", "1")
+        else:
+            monkeypatch.delenv("DMLC_TPU_DISABLE_NATIVE", raising=False)
+        # force the loader to re-decide with the new env
+        monkeypatch.setattr(native_mod, "_tried", False)
+        monkeypatch.setattr(native_mod, "_lib", None)
+        mesh1 = build_mesh(1, dp=1, sp=1, tp=1, pp=1, ep=1)
+        feed = recordio_packed_feed(path, mesh1, buf_bytes=256,
+                                    max_records=8)
+        out = []
+        for b in feed:
+            out.append((np.asarray(b["data"]).tobytes(),
+                        np.asarray(b["offsets"]).tobytes(),
+                        int(np.asarray(b["count"])[0])))
+        return out
+
+    native_out = run(False)
+    fallback_out = run(True)
+    assert native_out == fallback_out
+    # and the stream decodes back to the records (truncated where > buf)
+    got = []
+    for data_b, offs_b, n in native_out:
+        data = np.frombuffer(data_b, np.uint8)
+        offsets = np.frombuffer(offs_b, np.int32)
+        for i in range(n):
+            got.append(bytes(data[offsets[i]:offsets[i + 1]]))
+    assert got == [r[:256] for r in recs]
+
+
 def test_feed_epoch_ends_cleanly(tmp_path, mesh):
     uri = _write_libsvm(tmp_path, rows=16)
     feed = libsvm_feed(uri, mesh, batch_size=2, max_nnz=4)
