@@ -920,6 +920,365 @@ int dmlc_comm_allgather(DmlcComm* c, const void* in, long nbytes, void* out) {
   return dmlc_comm_broadcast(c, out, nbytes * c->world, 0);
 }
 
+// ---------------------------------------------------------------------
+// Parameter-server KV data plane (see dmlc_collective.h).  Wire format
+// (all native-endian, matching the rabit framing):
+//   registration (node -> scheduler): magic, role:int32, port:int32
+//   scheduler reply: my_id:int32, num_servers:int32,
+//                    then per server: host:str, port:int32
+//   worker -> server messages: op:int32 then
+//     op 1 PUSH: key:int32, n:int32, n f64 payload -> ack:int32(0)
+//     op 2 PULL: key:int32, n:int32, min_pushes:int32 -> n f64
+//     op 3 FIN:  -> ack; server exits after every worker's FIN
+// Keys travel as int32 (parameter-slot ids, as in the reference PS);
+// values are f64 so cross-worker gradient sums are exactly testable.
+// ---------------------------------------------------------------------
+
+struct DmlcKV {
+  int role = DMLC_KV_WORKER;
+  int my_id = -1;
+  int num_workers = 0;
+  int num_servers = 0;
+  int listener = -1;                       // server/scheduler accept socket
+  std::vector<std::pair<std::string, int>> servers;
+  std::vector<Frame> server_links;         // worker: one per server
+  std::string error;
+};
+
+namespace {
+
+DmlcKV* kv_fail(DmlcKV* kv) {
+  g_init_error = kv->error.empty() ? "kv init failed" : kv->error;
+  for (auto& f : kv->server_links) f.close();
+  if (kv->listener >= 0) ::close(kv->listener);
+  delete kv;
+  return nullptr;
+}
+
+int kv_listen(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) != 0 ||
+      listen(fd, 64) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+int sock_port(int fd) {
+  sockaddr_in addr{};
+  socklen_t alen = sizeof addr;
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  return ntohs(addr.sin_port);
+}
+
+std::string peer_ip(int fd) {
+  sockaddr_in addr{};
+  socklen_t alen = sizeof addr;
+  getpeername(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  char buf[INET_ADDRSTRLEN] = {0};
+  inet_ntop(AF_INET, &addr.sin_addr, buf, sizeof buf);
+  return buf;
+}
+
+// Scheduler: accept every node's registration, then answer all at once
+// with the server address list — servers listen BEFORE registering, so
+// no worker can dial an unbound server port.
+int kv_run_scheduler(DmlcKV* kv) {
+  struct Reg { Frame f; int role; std::string host; int port; };
+  std::vector<Reg> regs;
+  const int want = kv->num_workers + kv->num_servers;
+  int servers_seen = 0;
+  while (static_cast<int>(regs.size()) < want) {
+    Frame f;
+    f.fd = accept(kv->listener, nullptr, nullptr);
+    int32_t m = 0, role = -1, port = -1;
+    if (f.fd < 0 || !f.recv_int(&m) || m != kMagic ||
+        !f.recv_int(&role) || !f.recv_int(&port)) {
+      f.close();
+      continue;  // garbage connection: reject, keep serving
+    }
+    if (role == DMLC_KV_SERVER) ++servers_seen;
+    regs.push_back({f, role, peer_ip(f.fd), port});
+  }
+  if (servers_seen != kv->num_servers) {
+    kv->error = "scheduler saw " + std::to_string(servers_seen) +
+                " servers, expected " + std::to_string(kv->num_servers);
+    for (auto& r : regs) r.f.close();
+    return -1;
+  }
+  // server ids in arrival order
+  std::vector<const Reg*> srv;
+  for (auto& r : regs)
+    if (r.role == DMLC_KV_SERVER) srv.push_back(&r);
+  bool ok = true;
+  int next_server = 0, next_worker = 0;
+  for (auto& r : regs) {
+    const int id = r.role == DMLC_KV_SERVER ? next_server++ : next_worker++;
+    ok = ok && r.f.send_int(id) && r.f.send_int(kv->num_servers);
+    for (auto* s : srv)
+      ok = ok && r.f.send_str(s->host) &&
+           r.f.send_int(static_cast<int32_t>(s->port));
+  }
+  // wait for every registrant's socket to close (job teardown) so the
+  // scheduler process outlives the data plane it brokered
+  for (auto& r : regs) {
+    int32_t dummy;
+    r.f.recv_int(&dummy);  // returns false on close — expected
+    r.f.close();
+  }
+  return ok ? 0 : -1;
+}
+
+// Server: poll-driven message loop; deferred pulls wake when their
+// key's push count reaches the requested clock.
+int kv_run_server(DmlcKV* kv) {
+  std::map<int32_t, std::vector<double>> store;
+  std::map<int32_t, long> pushes;
+  struct Pending { int fd; int32_t key; int32_t n; int32_t minp; };
+  std::vector<Pending> pending;
+  std::vector<int> conns;
+  int fins = 0;
+
+  auto reply_pull = [&](int fd, int32_t key, int32_t n) {
+    Frame f{fd};
+    std::vector<double> out(static_cast<size_t>(n), 0.0);
+    auto it = store.find(key);
+    if (it != store.end())
+      for (long i = 0; i < n && i < (long)it->second.size(); ++i)
+        out[i] = it->second[i];
+    return f.send_all(out.data(), sizeof(double) * out.size());
+  };
+
+  while (fins < kv->num_workers) {
+    std::vector<pollfd> pfds;
+    pfds.push_back({kv->listener, POLLIN, 0});
+    for (int fd : conns) pfds.push_back({fd, POLLIN, 0});
+    if (poll(pfds.data(), pfds.size(), -1) < 0) {
+      if (errno == EINTR) continue;
+      kv->error = "server poll failed";
+      return -1;
+    }
+    if (pfds[0].revents & POLLIN) {
+      int fd = accept(kv->listener, nullptr, nullptr);
+      if (fd >= 0) {
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+        conns.push_back(fd);
+      }
+    }
+    for (size_t i = 1; i < pfds.size(); ++i) {
+      if (!(pfds[i].revents & (POLLIN | POLLERR | POLLHUP))) continue;
+      Frame f{pfds[i].fd};
+      int32_t op;
+      if (!f.recv_int(&op)) {  // worker vanished: close, keep serving
+        // purge its deferred pulls too — the fd number will be reused
+        // by the next accept, and a stale reply would corrupt that
+        // worker's stream
+        for (size_t p = 0; p < pending.size();) {
+          if (pending[p].fd == pfds[i].fd)
+            pending.erase(pending.begin() + p);
+          else
+            ++p;
+        }
+        ::close(pfds[i].fd);
+        conns.erase(std::find(conns.begin(), conns.end(), pfds[i].fd));
+        continue;
+      }
+      if (op == 1) {  // PUSH
+        int32_t key, n;
+        if (!f.recv_int(&key) || !f.recv_int(&n) || n < 0) return -1;
+        std::vector<double> val(static_cast<size_t>(n));
+        if (!f.recv_all(val.data(), sizeof(double) * val.size()))
+          return -1;
+        auto& acc = store[key];
+        if (acc.size() < val.size()) acc.resize(val.size(), 0.0);
+        for (size_t j = 0; j < val.size(); ++j) acc[j] += val[j];
+        ++pushes[key];
+        if (!f.send_int(0)) return -1;
+        // wake deferred pulls on this key
+        for (size_t p = 0; p < pending.size();) {
+          if (pending[p].key == key && pushes[key] >= pending[p].minp) {
+            if (!reply_pull(pending[p].fd, key, pending[p].n)) return -1;
+            pending.erase(pending.begin() + p);
+          } else {
+            ++p;
+          }
+        }
+      } else if (op == 2) {  // PULL
+        int32_t key, n, minp;
+        if (!f.recv_int(&key) || !f.recv_int(&n) || !f.recv_int(&minp) ||
+            n < 0)
+          return -1;
+        if (minp > 0 && pushes[key] < minp) {
+          pending.push_back({pfds[i].fd, key, n, minp});
+        } else if (!reply_pull(pfds[i].fd, key, n)) {
+          return -1;
+        }
+      } else if (op == 3) {  // FIN
+        ++fins;
+        if (!f.send_int(0)) return -1;
+      } else {
+        kv->error = "server: unknown op " + std::to_string(op);
+        return -1;
+      }
+    }
+  }
+  for (int fd : conns) ::close(fd);
+  return 0;
+}
+
+}  // namespace
+
+DmlcKV* dmlc_kv_init(void) {
+  auto* kv = new DmlcKV();
+  const char* role = getenv("DMLC_ROLE");
+  kv->role = role == nullptr ? DMLC_KV_WORKER
+             : strcmp(role, "server") == 0 ? DMLC_KV_SERVER
+             : strcmp(role, "scheduler") == 0 ? DMLC_KV_SCHEDULER
+                                              : DMLC_KV_WORKER;
+  kv->num_workers = static_cast<int>(env_long("DMLC_NUM_WORKER", 1));
+  kv->num_servers = static_cast<int>(env_long("DMLC_NUM_SERVER", 0));
+  const char* uri = getenv("DMLC_PS_ROOT_URI");
+  const int root_port =
+      static_cast<int>(env_long("DMLC_PS_ROOT_PORT", 9091));
+  if (kv->role == DMLC_KV_SCHEDULER) {
+    kv->listener = kv_listen(root_port);
+    if (kv->listener < 0) {
+      kv->error = "scheduler cannot bind DMLC_PS_ROOT_PORT " +
+                  std::to_string(root_port);
+      return kv_fail(kv);
+    }
+    return kv;
+  }
+  int my_port = -1;
+  if (kv->role == DMLC_KV_SERVER) {
+    kv->listener = kv_listen(0);
+    if (kv->listener < 0) {
+      kv->error = "server cannot bind an accept socket";
+      return kv_fail(kv);
+    }
+    my_port = sock_port(kv->listener);
+  }
+  // register with the scheduler — retrying the dial: the launcher
+  // starts workers/servers concurrently with the scheduler process,
+  // which may not have bound DMLC_PS_ROOT_PORT yet (same transient the
+  // rabit broker retries cover)
+  Frame fs;
+  for (int a = 0; a < kBrokerRetries && fs.fd < 0; ++a) {
+    fs.fd = dial(uri ? uri : "127.0.0.1", root_port);
+    if (fs.fd < 0) usleep(200 * 1000);
+  }
+  if (fs.fd < 0 || !fs.send_int(kMagic) ||
+      !fs.send_int(static_cast<int32_t>(kv->role)) ||
+      !fs.send_int(static_cast<int32_t>(my_port))) {
+    kv->error = "cannot register with scheduler at DMLC_PS_ROOT";
+    fs.close();
+    return kv_fail(kv);
+  }
+  int32_t id = -1, ns = -1;
+  bool ok = fs.recv_int(&id) && fs.recv_int(&ns);
+  for (int i = 0; ok && i < ns; ++i) {
+    std::string host;
+    int32_t port;
+    ok = fs.recv_str(&host) && fs.recv_int(&port);
+    kv->servers.emplace_back(host, port);
+  }
+  if (!ok || ns != kv->num_servers) {
+    kv->error = "scheduler registration reply malformed";
+    fs.close();
+    return kv_fail(kv);
+  }
+  kv->my_id = id;
+  if (kv->role == DMLC_KV_WORKER) {
+    for (auto& hp : kv->servers) {
+      Frame pf;
+      pf.fd = dial(hp.first, hp.second);
+      if (pf.fd < 0) {
+        kv->error = "worker cannot reach server " + hp.first;
+        fs.close();
+        return kv_fail(kv);
+      }
+      kv->server_links.push_back(pf);
+    }
+  }
+  // keep the scheduler session open as the job-liveness signal; it is
+  // closed (silently) at shutdown
+  kv->server_links.push_back(fs);
+  return kv;
+}
+
+int dmlc_kv_role(const DmlcKV* kv) { return kv->role; }
+
+int dmlc_kv_serve(DmlcKV* kv) {
+  if (kv->role == DMLC_KV_SCHEDULER) return kv_run_scheduler(kv);
+  if (kv->role == DMLC_KV_SERVER) return kv_run_server(kv);
+  kv->error = "dmlc_kv_serve called on a worker";
+  return -2;
+}
+
+int dmlc_kv_push(DmlcKV* kv, long key, const double* val, long n) {
+  if (kv->role != DMLC_KV_WORKER || kv->num_servers <= 0) return -2;
+  if (key < 0 || key > 0x7fffffffL) return -2;  // int32 wire keys
+  if (n < 0 || n > kMaxFrame / static_cast<long>(sizeof(double)))
+    return -3;
+  Frame& f = kv->server_links[static_cast<size_t>(
+      key % kv->num_servers)];
+  int32_t ack = -1;
+  if (!f.send_int(1) || !f.send_int(static_cast<int32_t>(key)) ||
+      !f.send_int(static_cast<int32_t>(n)) ||
+      !f.send_all(val, sizeof(double) * static_cast<size_t>(n)) ||
+      !f.recv_int(&ack) || ack != 0) {
+    kv->error = "push failed (server gone?)";
+    return -1;
+  }
+  return 0;
+}
+
+int dmlc_kv_pull(DmlcKV* kv, long key, double* out, long n,
+                 long min_pushes) {
+  if (kv->role != DMLC_KV_WORKER || kv->num_servers <= 0) return -2;
+  if (key < 0 || key > 0x7fffffffL) return -2;  // int32 wire keys
+  if (n < 0 || n > kMaxFrame / static_cast<long>(sizeof(double)))
+    return -3;
+  Frame& f = kv->server_links[static_cast<size_t>(
+      key % kv->num_servers)];
+  if (!f.send_int(2) || !f.send_int(static_cast<int32_t>(key)) ||
+      !f.send_int(static_cast<int32_t>(n)) ||
+      !f.send_int(static_cast<int32_t>(min_pushes)) ||
+      !f.recv_all(out, sizeof(double) * static_cast<size_t>(n))) {
+    kv->error = "pull failed (server gone?)";
+    return -1;
+  }
+  return 0;
+}
+
+void dmlc_kv_shutdown(DmlcKV* kv) {
+  if (kv == nullptr) return;
+  if (kv->role == DMLC_KV_WORKER && kv->num_servers > 0) {
+    // FIN every server (the scheduler link is last and gets no FIN)
+    for (int s = 0; s < kv->num_servers; ++s) {
+      Frame& f = kv->server_links[static_cast<size_t>(s)];
+      int32_t ack;
+      if (f.send_int(3)) f.recv_int(&ack);
+    }
+  }
+  for (auto& f : kv->server_links) f.close();
+  if (kv->listener >= 0) ::close(kv->listener);
+  delete kv;
+}
+
+const char* dmlc_kv_last_error(const DmlcKV* kv) {
+  return kv == nullptr ? g_init_error.c_str() : kv->error.c_str();
+}
+
 int dmlc_comm_log(DmlcComm* c, const char* msg) {
   Frame fs;
   if (!c->session("print", &fs)) return -1;

@@ -77,6 +77,51 @@ void dmlc_comm_shutdown(DmlcComm* c);
  * returned NULL. */
 const char* dmlc_comm_last_error(const DmlcComm* c);
 
+/* ------------------------------------------------------------------ *
+ * Parameter-server KV data plane (the worker/server/scheduler role
+ * model of the reference's PS path, tracker/dmlc_tracker/tracker.py:
+ * 336-386 env contract).  Under `dmlc-submit --num-servers N` every
+ * task runs the same binary: DMLC_ROLE selects the behavior, the
+ * scheduler rendezvous rides DMLC_PS_ROOT_URI/PORT, and key vectors
+ * shard over servers by key %% num_servers.  Push is SUM-aggregated
+ * server-side; pull can wait for a minimum number of pushes on the
+ * key (the PS clock), which is how workers synchronize an iteration.
+ * ------------------------------------------------------------------ */
+typedef struct DmlcKV DmlcKV;
+
+enum {
+  DMLC_KV_WORKER = 0,
+  DMLC_KV_SERVER = 1,
+  DMLC_KV_SCHEDULER = 2,
+};
+
+/* Role + rendezvous from the DMLC env contract.  Workers return ready
+ * to push/pull; servers and the scheduler return ready for
+ * dmlc_kv_serve().  NULL on failure (see dmlc_kv_last_error(NULL)). */
+DmlcKV* dmlc_kv_init(void);
+
+int dmlc_kv_role(const DmlcKV* kv);
+
+/* Server: answer push/pull until every worker finalized.  Scheduler:
+ * broker registration, then wait for the gang to finish.  Returns 0 on
+ * clean completion. */
+int dmlc_kv_serve(DmlcKV* kv);
+
+/* Worker: SUM-push n doubles under `key` to its owning server. */
+int dmlc_kv_push(DmlcKV* kv, long key, const double* val, long n);
+
+/* Worker: read `key` (zeros if never pushed).  min_pushes > 0 blocks
+ * until that many pushes have been aggregated on the key — pass the
+ * worker count to read a full iteration's sum. */
+int dmlc_kv_pull(DmlcKV* kv, long key, double* out, long n,
+                 long min_pushes);
+
+/* Worker: notify servers + scheduler this worker is done; all roles:
+ * release sockets and free. */
+void dmlc_kv_shutdown(DmlcKV* kv);
+
+const char* dmlc_kv_last_error(const DmlcKV* kv);
+
 #ifdef __cplusplus
 }
 #endif

@@ -319,12 +319,17 @@ def _stage_fn(stage_params, x, positions, axes: ShardAxes,
         if cfg.remat_policy == "save_flash":
             policy = jax.checkpoint_policies.save_only_these_names(
                 "flash_o", "flash_lse")
+        elif cfg.remat_policy == "save_flash_mlp":
+            # + the MLP hidden activation: ~B*T*F bf16 per layer of HBM
+            # buys back the block's largest recompute matmuls (in/gate)
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "flash_o", "flash_lse", "mlp_act")
         elif cfg.remat_policy == "full":
             policy = None
         else:
             raise ValueError(
                 f"unknown remat_policy {cfg.remat_policy!r}; "
-                "expected 'full' or 'save_flash'")
+                "expected 'full', 'save_flash', or 'save_flash_mlp'")
         blk = jax.checkpoint(_block, static_argnums=(3, 4), policy=policy)
 
     def body(h, layer_p):

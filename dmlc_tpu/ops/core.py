@@ -120,9 +120,16 @@ def swiglu_ffn(x, w_in, w_gate, w_out, axes: ShardAxes, *, reduce: bool = True):
     skipped with reduce=False so callers can batch it with other partial
     sums (MoE).
     """
+    from jax.ad_checkpoint import checkpoint_name
+
     h = jnp.einsum("...e,ef->...f", x, w_in) * jax.nn.silu(
         jnp.einsum("...e,ef->...f", x, w_gate)
     )
+    # named for remat policies: saving the [.., F] activation lets the
+    # backward skip re-running the in/gate matmuls — the largest single
+    # recompute in a rematerialized block (models.TransformerConfig
+    # remat_policy='save_flash_mlp')
+    h = checkpoint_name(h, "mlp_act")
     y = jnp.einsum("...f,fe->...e", h, w_out)
     if reduce and axes.tp is not None:
         y = lax.psum(y, axes.tp)

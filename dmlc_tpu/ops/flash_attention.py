@@ -63,15 +63,7 @@ def _kernel(qoff_ref, kvoff_ref, kvend_ref, q_ref, k_ref, v_ref,
         m_ref[0] = jnp.full_like(m_ref[0], _NEG_BIG)
         l_ref[0] = jnp.zeros_like(l_ref[0])
 
-    if causal:
-        # visit only KV blocks intersecting the visible (past) region
-        last_q = qoff_ref[0] + (qi + 1) * block_q - 1
-        visible = kvoff_ref[0] + j * block_k <= last_q
-    else:
-        visible = True
-
-    @pl.when(visible)
-    def _step():
+    def step(masked: bool):
         q = q_ref[0]                      # [block_q, D]
         kb = k_ref[0]                     # [block_k, D]
         vb = v_ref[0]
@@ -79,19 +71,20 @@ def _kernel(qoff_ref, kvoff_ref, kvend_ref, q_ref, k_ref, v_ref,
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [block_q, block_k]
         keep = None
-        if causal or kv_padded:
-            q_pos = qoff_ref[0] + qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kvoff_ref[0] + j * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-        if causal:
-            keep = q_pos >= k_pos
-        if kv_padded:
-            # tail KV rows past the real length are padding, never attend
-            in_range = k_pos < kvend_ref[0]
-            keep = in_range if keep is None else keep & in_range
-        if keep is not None:
-            s = jnp.where(keep, s, _NEG_BIG)
+        if masked:
+            if causal or kv_padded:
+                q_pos = qoff_ref[0] + qi * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                k_pos = kvoff_ref[0] + j * block_k + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+            if causal:
+                keep = q_pos >= k_pos
+            if kv_padded:
+                # tail KV rows past the real length are padding
+                in_range = k_pos < kvend_ref[0]
+                keep = in_range if keep is None else keep & in_range
+            if keep is not None:
+                s = jnp.where(keep, s, _NEG_BIG)
         m_old = m_ref[0][:, 0]
         l_old = l_ref[0][:, 0]
         bm = jnp.max(s, axis=1)
@@ -109,6 +102,33 @@ def _kernel(qoff_ref, kvoff_ref, kvend_ref, q_ref, k_ref, v_ref,
         # axis (Mosaic lane tiling); callers slice lane 0
         m_ref[0] = jnp.broadcast_to(m_new[:, None], (block_q, 8))
         l_ref[0] = jnp.broadcast_to(l_new[:, None], (block_q, 8))
+
+    # Block-level mask classification (exact): only blocks that
+    # intersect the causal diagonal or the padded KV tail need the
+    # per-element iota/compare/select chain — for every other visible
+    # block the mask would be all-True, and skipping it removes ~half
+    # the VPU work per step.  At D=128 the softmax's VPU ops, not the
+    # MXU dots, bound this kernel, so this is a direct rate win.
+    first_q = qoff_ref[0] + qi * block_q
+    last_q = first_q + block_q - 1
+    kb_first = kvoff_ref[0] + j * block_k
+    kb_last = kb_first + block_k - 1
+    visible = kb_first <= last_q if causal else None
+    boundary = None
+    if causal:
+        boundary = kb_last > first_q      # intersects the diagonal
+    if kv_padded:
+        pad = kb_last >= kvend_ref[0]     # intersects the padded tail
+        boundary = pad if boundary is None else boundary | pad
+    if boundary is None:
+        step(False)
+    else:
+        clean = jnp.logical_not(boundary)
+        if visible is not None:
+            clean = clean & visible
+            boundary = boundary & visible
+        pl.when(clean)(lambda: step(False))
+        pl.when(boundary)(lambda: step(True))
 
 
 def supports(q_shape: Tuple[int, ...], k_shape: Tuple[int, ...]) -> bool:
@@ -162,7 +182,7 @@ def _flash_core_fwd(static, q, k, v, qoff, kvoff):
 
 
 def _flash_core_bwd(static, res, cts):
-    scale, causal, _, _, _ = static
+    scale, causal = static[0], static[1]
     q, k, v, qoff, kvoff = res
     _, vjp = jax.vjp(
         functools.partial(_lax_block_attend, scale=scale, causal=causal),
@@ -202,7 +222,7 @@ def _flash_forward(static, q, k, v, qoff, kvoff):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    scale, causal, block_q, block_k, interpret = static
+    scale, causal, block_q, block_k, interpret = static[:5]
     b, tq, h, d = q.shape
     tk = k.shape[1]
     block_q = min(block_q, tq)
@@ -268,6 +288,34 @@ def _flash_forward(static, q, k, v, qoff, kvoff):
 #   dK  = scale · dSᵀ Q
 # ---------------------------------------------------------------------
 
+def _dispatch_masked_step(pl, step, qi, j, block_q: int, block_k: int,
+                          causal: bool, kv_padded: bool, kvend_ref):
+    """Backward-kernel analog of the forward's block classification:
+    skip fully-invisible blocks, run the mask-free body on blocks the
+    mask could not touch (all-keep), and pay the per-element
+    iota/compare/select chain only on diagonal/padded-tail blocks."""
+    first_q = qi * block_q
+    last_q = first_q + block_q - 1
+    kb_first = j * block_k
+    kb_last = kb_first + block_k - 1
+    visible = last_q >= kb_first if causal else None
+    boundary = None
+    if causal:
+        boundary = kb_last > first_q
+    if kv_padded:
+        pad = kb_last >= kvend_ref[0]
+        boundary = pad if boundary is None else boundary | pad
+    if boundary is None:
+        step(False)
+        return
+    clean = jnp.logical_not(boundary)
+    if visible is not None:
+        clean = clean & visible
+        boundary = boundary & visible
+    pl.when(clean)(lambda: step(False))
+    pl.when(boundary)(lambda: step(True))
+
+
 def _bwd_dkv_kernel(kvend_ref, q_ref, do_ref, k_ref, v_ref, lse_ref,
                     delta_ref, dk_ref, dv_ref, *, block_q: int,
                     block_k: int, causal: bool, kv_padded: bool,
@@ -282,13 +330,7 @@ def _bwd_dkv_kernel(kvend_ref, q_ref, do_ref, k_ref, v_ref, lse_ref,
         dk_ref[0] = jnp.zeros_like(dk_ref[0])
         dv_ref[0] = jnp.zeros_like(dv_ref[0])
 
-    if causal:
-        visible = (qi + 1) * block_q - 1 >= j * block_k
-    else:
-        visible = True
-
-    @pl.when(visible)
-    def _step():
+    def step(masked: bool):
         kb = k_ref[0]                     # [block_k, D]
         vb = v_ref[0]
         qb = q_ref[0]                     # [block_q, D]
@@ -299,20 +341,21 @@ def _bwd_dkv_kernel(kvend_ref, q_ref, do_ref, k_ref, v_ref, lse_ref,
             qb, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale    # [bq, bk]
         p = jnp.exp(s - lse[:, None])
-        keep = None
-        if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            keep = q_pos >= k_pos
-        if kv_padded:
-            kp = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            in_range = kp < kvend_ref[0]
-            keep = in_range if keep is None else keep & in_range
-        if keep is not None:
-            p = jnp.where(keep, p, 0.0)
+        if masked:
+            keep = None
+            if causal:
+                q_pos = qi * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                k_pos = j * block_k + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                keep = q_pos >= k_pos
+            if kv_padded:
+                kp = j * block_k + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+                in_range = kp < kvend_ref[0]
+                keep = in_range if keep is None else keep & in_range
+            if keep is not None:
+                p = jnp.where(keep, p, 0.0)
         dv_ref[0] += jax.lax.dot_general(
             p, dob.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bk, D]
@@ -323,6 +366,9 @@ def _bwd_dkv_kernel(kvend_ref, q_ref, do_ref, k_ref, v_ref, lse_ref,
         dk_ref[0] += scale * jax.lax.dot_general(
             ds, qb.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)            # [bk, D]
+
+    _dispatch_masked_step(pl, step, qi, j, block_q, block_k, causal,
+                          kv_padded, kvend_ref)
 
 
 def _bwd_dq_kernel(kvend_ref, q_ref, do_ref, k_ref, v_ref, lse_ref,
@@ -337,13 +383,7 @@ def _bwd_dq_kernel(kvend_ref, q_ref, do_ref, k_ref, v_ref, lse_ref,
     def _init():
         dq_ref[0] = jnp.zeros_like(dq_ref[0])
 
-    if causal:
-        visible = (qi + 1) * block_q - 1 >= j * block_k
-    else:
-        visible = True
-
-    @pl.when(visible)
-    def _step():
+    def step(masked: bool):
         qb = q_ref[0]                      # [block_q, D]
         dob = do_ref[0]
         kb = k_ref[0]
@@ -354,19 +394,20 @@ def _bwd_dq_kernel(kvend_ref, q_ref, do_ref, k_ref, v_ref, lse_ref,
             qb, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse[:, None])
-        keep = None
-        if causal or kv_padded:
-            k_pos = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-        if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            keep = q_pos >= k_pos
-        if kv_padded:
-            in_range = k_pos < kvend_ref[0]
-            keep = in_range if keep is None else keep & in_range
-        if keep is not None:
-            p = jnp.where(keep, p, 0.0)
+        if masked:
+            keep = None
+            if causal or kv_padded:
+                k_pos = j * block_k + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1)
+            if causal:
+                q_pos = qi * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                keep = q_pos >= k_pos
+            if kv_padded:
+                in_range = k_pos < kvend_ref[0]
+                keep = in_range if keep is None else keep & in_range
+            if keep is not None:
+                p = jnp.where(keep, p, 0.0)
         dp = jax.lax.dot_general(
             dob, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -375,12 +416,17 @@ def _bwd_dq_kernel(kvend_ref, q_ref, do_ref, k_ref, v_ref, lse_ref,
             ds, kb.astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
+    _dispatch_masked_step(pl, step, qi, j, block_q, block_k, causal,
+                          kv_padded, kvend_ref)
+
 
 def _flash_backward(static, q, k, v, o, lse, do):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    scale, causal, block_q, block_k, interpret = static
+    scale, causal, block_q, block_k, interpret = static[:5]
+    if len(static) > 5:  # separately-tuned backward blocks
+        block_q, block_k = static[5], static[6]
     b, tq, h, d = q.shape
     tk = k.shape[1]
     block_q = min(block_q, tq)
@@ -516,19 +562,32 @@ def flash_attention(q, k, v, *, causal: bool = True,
     backward recomputes P from the saved (o, lse) residuals in blocks
     (dkv + dq kernels) instead of materializing the T×T matrix.
 
-    Default block sizes are T-adaptive (measured on v5e, min-of-rounds
-    fwd+bwd): 512×512 short-T; at KV length ≥ 4096 a 1024-wide KV block
-    wins ~25% (fewer grid revisits of the Q-block accumulators per
-    walked KV byte), while 2048 regresses (VMEM pressure evicts the
-    double-buffered pipeline).
+    Default block sizes: uniform 1024×1024 for forward AND backward
+    (clamped to T), the winner of a round-5 sweep on v5e over
+    {256..2048}² × fwd/bwd at both T=1024 and T=8192 on the full
+    flagship train step — 1024² beat the round-4 T-adaptive 512/1024
+    scheme by ~2 MFU points at short T and ~1.7 at long T (fewer grid
+    revisits of the accumulator blocks per walked byte; 2048-wide
+    blocks regress, VMEM pressure evicting the double-buffered
+    pipeline).  DMLC_FLASH_BLOCK_Q/K and DMLC_FLASH_BWD_BLOCK_Q/K
+    override for sweeps (read at trace time).
     """
+    import os
+
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
+    # explicit caller blocks bind BOTH passes (a caller sizing for VMEM
+    # must not get surprise-larger backward tiles); env/defaults fill
+    # whatever remains
+    bwd_q = block_q if block_q is not None \
+        else int(os.environ.get("DMLC_FLASH_BWD_BLOCK_Q", 0)) or 1024
+    bwd_k = block_k if block_k is not None \
+        else int(os.environ.get("DMLC_FLASH_BWD_BLOCK_K", 0)) or 1024
     if block_q is None:
-        block_q = 512
+        block_q = int(os.environ.get("DMLC_FLASH_BLOCK_Q", 0)) or 1024
     if block_k is None:
-        block_k = 1024 if k.shape[1] >= 4096 else 512
+        block_k = int(os.environ.get("DMLC_FLASH_BLOCK_K", 0)) or 1024
     static = (float(scale), bool(causal), int(block_q), int(block_k),
-              bool(interpret))
+              bool(interpret), int(bwd_q), int(bwd_k))
     return _flash_attn(static, q, k, v)

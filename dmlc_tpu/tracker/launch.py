@@ -83,19 +83,26 @@ def _await_job(tracker, failures, threads):
     hang forever — poll both."""
     import time
 
+    def abort(msg):
+        # a lingering PS scheduler child would hold the launcher's
+        # stdio pipes open past our exit — kill it before raising
+        if tracker is not None and hasattr(tracker, "terminate"):
+            tracker.terminate()
+        raise RuntimeError(msg)
+
     while True:
         if failures:
-            raise RuntimeError(f"tasks failed: {failures}")
+            abort(f"tasks failed: {failures}")
         if tracker is not None and getattr(tracker, "error", None) is not None:
-            raise RuntimeError(f"tracker failed: {tracker.error}")
+            abort(f"tracker failed: {tracker.error}")
         tracker_done = tracker is None or not tracker.alive()
         if tracker_done and all(not t.is_alive() for t in threads):
             break
         time.sleep(0.05)
     if failures:
-        raise RuntimeError(f"tasks failed: {failures}")
+        abort(f"tasks failed: {failures}")
     if tracker is not None and getattr(tracker, "error", None) is not None:
-        raise RuntimeError(f"tracker failed: {tracker.error}")
+        abort(f"tracker failed: {tracker.error}")
     return tracker
 
 

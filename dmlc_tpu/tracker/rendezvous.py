@@ -354,6 +354,7 @@ class PSTracker:
         self.host_ip = host_ip
         self.cmd = cmd
         self.thread = None
+        self.proc: Optional[subprocess.Popen] = None
         self.error: Optional[BaseException] = None
         self.port = free_port(host_ip)
         if cmd is None:
@@ -365,19 +366,31 @@ class PSTracker:
             "DMLC_PS_ROOT_URI": str(self.host_ip),
             "DMLC_PS_ROOT_PORT": str(self.port),
         })
+        # Popen (not check_call) so an aborting job can terminate() the
+        # scheduler: a lingering scheduler child inherits the launcher's
+        # stdio and keeps a captured pipe open long after dmlc-submit
+        # exits, hanging whoever waits on that pipe.
+        self.proc = subprocess.Popen(self.cmd, shell=True, env=env)
 
         def run():
             # a dead scheduler must abort the job fast, not leave every
             # worker hanging on DMLC_PS_ROOT_PORT — record the failure
             # for _await_job/join instead of losing it in a daemon thread
             try:
-                subprocess.check_call(self.cmd, shell=True, env=env)
+                rc = self.proc.wait()
+                if rc != 0:
+                    raise RuntimeError(f"scheduler exited {rc}")
             except BaseException as e:
                 self.error = e
                 logger.error("PS scheduler died: %s", e)
 
         self.thread = threading.Thread(target=run, daemon=True)
         self.thread.start()
+
+    def terminate(self) -> None:
+        """Kill the scheduler process (job abort path)."""
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
 
     def worker_envs(self) -> Dict[str, str]:
         return {

@@ -1,0 +1,73 @@
+/* Parameter-server KV round trip over the dmlc_collective C ABI.
+ *
+ * One binary, three roles (DMLC_ROLE selects, exactly as the reference
+ * PS jobs run): the scheduler brokers registration at DMLC_PS_ROOT,
+ * servers aggregate pushes, workers push per-rank gradient vectors and
+ * pull the full sum back with min_pushes = NUM_WORKER (the PS clock).
+ *
+ * Run under the launcher:
+ *   dmlc-submit --cluster local --num-workers 3 --num-servers 2 \
+ *       -- ./kv_ps_worker
+ */
+#include "dmlc_collective.h"
+
+#include <math.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define N 257          /* per-key vector length (odd: exercises resize) */
+#define KEYS 5         /* spread over the server shard space */
+
+int main(void) {
+  DmlcKV* kv = dmlc_kv_init();
+  if (kv == NULL) {
+    fprintf(stderr, "FAIL: dmlc_kv_init: %s\n", dmlc_kv_last_error(NULL));
+    return 1;
+  }
+  int role = dmlc_kv_role(kv);
+  if (role != DMLC_KV_WORKER) {
+    int rc = dmlc_kv_serve(kv);
+    if (rc != 0)
+      fprintf(stderr, "FAIL: serve rc=%d: %s\n", rc,
+              dmlc_kv_last_error(kv));
+    dmlc_kv_shutdown(kv);
+    return rc == 0 ? 0 : 1;
+  }
+
+  const char* tid = getenv("DMLC_TASK_ID");
+  const int rank = tid ? atoi(tid) : 0;
+  const char* nw = getenv("DMLC_NUM_WORKER");
+  const int workers = nw ? atoi(nw) : 1;
+
+  double val[N], out[N];
+  int key, i, rc;
+  for (key = 0; key < KEYS; ++key) {
+    for (i = 0; i < N; ++i) val[i] = (double)(rank + 1) * (key + 1);
+    rc = dmlc_kv_push(kv, key, val, N);
+    if (rc != 0) {
+      fprintf(stderr, "FAIL rank=%d: push key=%d rc=%d\n", rank, key, rc);
+      return 1;
+    }
+  }
+  /* full-clock pull: blocks until every worker's push landed */
+  for (key = 0; key < KEYS; ++key) {
+    rc = dmlc_kv_pull(kv, key, out, N, workers);
+    if (rc != 0) {
+      fprintf(stderr, "FAIL rank=%d: pull key=%d rc=%d\n", rank, key, rc);
+      return 1;
+    }
+    const double want = (double)(key + 1) * workers * (workers + 1) / 2.0;
+    for (i = 0; i < N; ++i) {
+      if (fabs(out[i] - want) > 1e-9) {
+        fprintf(stderr, "FAIL rank=%d: key=%d slot=%d got=%f want=%f\n",
+                rank, key, i, out[i], want);
+        return 1;
+      }
+    }
+  }
+  printf("kv OK rank=%d workers=%d\n", rank, workers);
+  fflush(stdout);
+  dmlc_kv_shutdown(kv);
+  return 0;
+}

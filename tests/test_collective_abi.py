@@ -81,6 +81,35 @@ def test_gbdt_allreduce_matches_single_process(gbdt):
     assert abs(multi - single) < 1e-4 * max(single, 1e-9), (single, multi)
 
 
+@pytest.fixture(scope="module")
+def kv_ps(collective_lib):
+    """PS KV role-model consumer: worker/server/scheduler in one binary
+    (reference env contract, tracker.py:336-386)."""
+    return _build_c_consumer(
+        collective_lib, os.path.join(REPO, "examples", "kv_ps_worker.c"),
+        os.path.join(os.path.dirname(collective_lib), "kv_ps_worker"))
+
+
+@pytest.mark.parametrize("workers,servers", [(1, 1), (3, 2)])
+def test_kv_parameter_server_end_to_end(kv_ps, workers, servers):
+    """dmlc-submit --num-servers launches scheduler + servers + workers;
+    each worker pushes per-rank vectors, then pulls with the full PS
+    clock (min_pushes = workers) and must read the exact cross-worker
+    sum on every key/slot."""
+    env = os.environ.copy()
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "dmlc_tpu.tracker.submit",
+         "--cluster", "local", "--num-workers", str(workers),
+         "--num-servers", str(servers), "--max-attempts", "1",
+         "--host-ip", "127.0.0.1", "--", kv_ps],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert "FAIL" not in r.stderr
+    for rank in range(workers):
+        assert f"kv OK rank={rank} workers={workers}" in r.stdout, r.stdout
+
+
 @pytest.mark.parametrize("world", [1, 2, 5, 8])
 @pytest.mark.parametrize("shm", ["1", "0"])
 def test_c_driver_collectives_under_local_launcher(driver, world, shm):
