@@ -17,7 +17,7 @@ A from-scratch rebuild of the capabilities of crazy-cat/dmlc-core
 
 __version__ = "0.1.0"
 
-from . import base, common, concurrency, config, param, registry, serializer  # noqa: F401
+from . import base, common, concurrency, config, memory, param, registry, serializer  # noqa: F401
 from .base import DMLCError, ParamError, get_env  # noqa: F401
 from .config import Config  # noqa: F401
 from .param import Parameter, field  # noqa: F401

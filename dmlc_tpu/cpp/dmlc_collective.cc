@@ -1057,6 +1057,26 @@ int kv_run_server(DmlcKV* kv) {
     return f.send_all(out.data(), sizeof(double) * out.size());
   };
 
+  // a peer that died mid-protocol must not take the server down: drop
+  // its connection and any deferred pulls, keep serving the rest
+  auto drop_conn = [&](int fd) {
+    for (size_t p = 0; p < pending.size();) {
+      if (pending[p].fd == fd)
+        pending.erase(pending.begin() + p);
+      else
+        ++p;
+    }
+    ::close(fd);
+    auto it = std::find(conns.begin(), conns.end(), fd);
+    if (it != conns.end()) conns.erase(it);
+  };
+
+  // one wire frame must never drive an unbounded allocation: mirror
+  // the worker-side kMaxFrame bound (hostile/corrupt n would otherwise
+  // bad_alloc the whole server)
+  const int32_t max_n =
+      static_cast<int32_t>(kMaxFrame / static_cast<long>(sizeof(double)));
+
   while (fins < kv->num_workers) {
     std::vector<pollfd> pfds;
     pfds.push_back({kv->listener, POLLIN, 0});
@@ -1079,22 +1099,13 @@ int kv_run_server(DmlcKV* kv) {
       Frame f{pfds[i].fd};
       int32_t op;
       if (!f.recv_int(&op)) {  // worker vanished: close, keep serving
-        // purge its deferred pulls too — the fd number will be reused
-        // by the next accept, and a stale reply would corrupt that
-        // worker's stream
-        for (size_t p = 0; p < pending.size();) {
-          if (pending[p].fd == pfds[i].fd)
-            pending.erase(pending.begin() + p);
-          else
-            ++p;
-        }
-        ::close(pfds[i].fd);
-        conns.erase(std::find(conns.begin(), conns.end(), pfds[i].fd));
+        drop_conn(pfds[i].fd);
         continue;
       }
       if (op == 1) {  // PUSH
         int32_t key, n;
-        if (!f.recv_int(&key) || !f.recv_int(&n) || n < 0) return -1;
+        if (!f.recv_int(&key) || !f.recv_int(&n) || n < 0 || n > max_n)
+          return -1;
         std::vector<double> val(static_cast<size_t>(n));
         if (!f.recv_all(val.data(), sizeof(double) * val.size()))
           return -1;
@@ -1103,11 +1114,14 @@ int kv_run_server(DmlcKV* kv) {
         for (size_t j = 0; j < val.size(); ++j) acc[j] += val[j];
         ++pushes[key];
         if (!f.send_int(0)) return -1;
-        // wake deferred pulls on this key
+        // wake deferred pulls on this key; a wake hitting a dead
+        // worker's socket drops that worker, not the server
         for (size_t p = 0; p < pending.size();) {
           if (pending[p].key == key && pushes[key] >= pending[p].minp) {
-            if (!reply_pull(pending[p].fd, key, pending[p].n)) return -1;
+            const int pfd = pending[p].fd;
+            const int32_t pn = pending[p].n;
             pending.erase(pending.begin() + p);
+            if (!reply_pull(pfd, key, pn)) drop_conn(pfd);
           } else {
             ++p;
           }
@@ -1115,12 +1129,12 @@ int kv_run_server(DmlcKV* kv) {
       } else if (op == 2) {  // PULL
         int32_t key, n, minp;
         if (!f.recv_int(&key) || !f.recv_int(&n) || !f.recv_int(&minp) ||
-            n < 0)
+            n < 0 || n > max_n)
           return -1;
         if (minp > 0 && pushes[key] < minp) {
           pending.push_back({pfds[i].fd, key, n, minp});
         } else if (!reply_pull(pfds[i].fd, key, n)) {
-          return -1;
+          drop_conn(pfds[i].fd);
         }
       } else if (op == 3) {  // FIN
         ++fins;

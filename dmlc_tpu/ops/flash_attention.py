@@ -103,32 +103,9 @@ def _kernel(qoff_ref, kvoff_ref, kvend_ref, q_ref, k_ref, v_ref,
         m_ref[0] = jnp.broadcast_to(m_new[:, None], (block_q, 8))
         l_ref[0] = jnp.broadcast_to(l_new[:, None], (block_q, 8))
 
-    # Block-level mask classification (exact): only blocks that
-    # intersect the causal diagonal or the padded KV tail need the
-    # per-element iota/compare/select chain — for every other visible
-    # block the mask would be all-True, and skipping it removes ~half
-    # the VPU work per step.  At D=128 the softmax's VPU ops, not the
-    # MXU dots, bound this kernel, so this is a direct rate win.
-    first_q = qoff_ref[0] + qi * block_q
-    last_q = first_q + block_q - 1
-    kb_first = kvoff_ref[0] + j * block_k
-    kb_last = kb_first + block_k - 1
-    visible = kb_first <= last_q if causal else None
-    boundary = None
-    if causal:
-        boundary = kb_last > first_q      # intersects the diagonal
-    if kv_padded:
-        pad = kb_last >= kvend_ref[0]     # intersects the padded tail
-        boundary = pad if boundary is None else boundary | pad
-    if boundary is None:
-        step(False)
-    else:
-        clean = jnp.logical_not(boundary)
-        if visible is not None:
-            clean = clean & visible
-            boundary = boundary & visible
-        pl.when(clean)(lambda: step(False))
-        pl.when(boundary)(lambda: step(True))
+    _dispatch_masked_step(pl, step, qi, j, block_q, block_k, causal,
+                          kv_padded, kvend_ref, qoff=qoff_ref[0],
+                          kvoff=kvoff_ref[0])
 
 
 def supports(q_shape: Tuple[int, ...], k_shape: Tuple[int, ...]) -> bool:
@@ -289,14 +266,19 @@ def _flash_forward(static, q, k, v, qoff, kvoff):
 # ---------------------------------------------------------------------
 
 def _dispatch_masked_step(pl, step, qi, j, block_q: int, block_k: int,
-                          causal: bool, kv_padded: bool, kvend_ref):
-    """Backward-kernel analog of the forward's block classification:
-    skip fully-invisible blocks, run the mask-free body on blocks the
-    mask could not touch (all-keep), and pay the per-element
-    iota/compare/select chain only on diagonal/padded-tail blocks."""
-    first_q = qi * block_q
+                          causal: bool, kv_padded: bool, kvend_ref,
+                          qoff=0, kvoff=0):
+    """Block-level mask classification (exact), shared by the forward
+    and backward kernels: skip fully-invisible blocks, run the
+    mask-free body on blocks the mask could not touch (all-keep), and
+    pay the per-element iota/compare/select chain only on
+    diagonal/padded-tail blocks — for every other visible block the
+    mask would be all-True, and skipping it removes ~half the VPU work
+    per step.  The forward passes its scalar-prefetch global offsets;
+    the backward runs in local positions (offsets 0)."""
+    first_q = qoff + qi * block_q
     last_q = first_q + block_q - 1
-    kb_first = j * block_k
+    kb_first = kvoff + j * block_k
     kb_last = kb_first + block_k - 1
     visible = last_q >= kb_first if causal else None
     boundary = None

@@ -356,6 +356,7 @@ class PSTracker:
         self.thread = None
         self.proc: Optional[subprocess.Popen] = None
         self.error: Optional[BaseException] = None
+        self._terminated = False
         self.port = free_port(host_ip)
         if cmd is None:
             return
@@ -378,7 +379,7 @@ class PSTracker:
             # for _await_job/join instead of losing it in a daemon thread
             try:
                 rc = self.proc.wait()
-                if rc != 0:
+                if rc != 0 and not self._terminated:
                     raise RuntimeError(f"scheduler exited {rc}")
             except BaseException as e:
                 self.error = e
@@ -388,7 +389,10 @@ class PSTracker:
         self.thread.start()
 
     def terminate(self) -> None:
-        """Kill the scheduler process (job abort path)."""
+        """Kill the scheduler process (job abort path).  Flagged first
+        so the watcher thread reports the deliberate kill as cleanup,
+        not as a scheduler failure."""
+        self._terminated = True
         if self.proc is not None and self.proc.poll() is None:
             self.proc.terminate()
 

@@ -1,0 +1,120 @@
+"""Memory pools (dmlc_tpu/memory.py — reference memory.h:22-261 role)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from dmlc_tpu.base import DMLCError
+from dmlc_tpu.memory import BufferPool, MemoryPool, ThreadLocalPool
+from dmlc_tpu.io.stream import Stream
+
+
+def test_memory_pool_recycles_and_arenas():
+    pool = MemoryPool(128, arena_objects=4)
+    bufs = [pool.alloc() for _ in range(6)]  # spans two arenas
+    assert all(b.nbytes == 128 for b in bufs)
+    # distinct live buffers never alias
+    for i, a in enumerate(bufs):
+        a[:] = i
+    for i, a in enumerate(bufs):
+        assert (np.asarray(a) == i).all()
+    for b in bufs:
+        pool.free(b)
+    again = [pool.alloc() for _ in range(6)]
+    assert pool.recycled >= 6  # all served from the freelist
+    del again
+    with pytest.raises(DMLCError):
+        pool.free(np.empty(64, np.uint8))
+
+
+def test_buffer_pool_size_classes_and_bound():
+    pool = BufferPool(max_bytes=1 << 20)
+    a = pool.acquire(1000)
+    assert a.nbytes == 1024  # next power of two
+    pool.release(a)
+    b = pool.acquire(900)    # same class: must be the recycled buffer
+    assert b is a
+    assert pool.hits == 1
+    # the retention bound drops overflow instead of pinning memory
+    big = [pool.acquire(512 << 10) for _ in range(4)]
+    for x in big:
+        pool.release(x)
+    assert pool.held_bytes <= 1 << 20
+
+
+def test_buffer_pool_thread_safety():
+    pool = BufferPool()
+    errors = []
+
+    def work():
+        try:
+            for _ in range(200):
+                buf = pool.acquire(4096)
+                buf[:8] = 7
+                pool.release(buf)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert pool.hits + pool.misses == 8 * 200
+
+
+def test_thread_local_pool_isolated_per_thread():
+    tlp = ThreadLocalPool()
+    main_buf = tlp.acquire(2048)
+    tlp.release(main_buf)
+    seen = {}
+
+    def work():
+        b = tlp.acquire(2048)
+        seen["other"] = b is main_buf  # different thread: different pool
+        tlp.release(b)
+
+    t = threading.Thread(target=work)
+    t.start()
+    t.join()
+    assert seen["other"] is False
+    assert tlp.acquire(2048) is main_buf  # same thread: recycled
+
+
+def test_stream_as_file_text_and_binary(tmp_path):
+    """The dmlc::ostream/istream role: Python's io stack over any
+    Stream/URI — csv/json/line-iteration consumers work unchanged."""
+    import csv
+    import json
+
+    path = str(tmp_path / "t.csv")
+    with Stream.create(path, "w") as s:
+        f = s.as_file("w")
+        w = csv.writer(f)
+        w.writerow(["a", "b"])
+        w.writerow([1, 2])
+        f.close()  # flushes; close_stream=False leaves s open
+        s.write(b"3,4\n")
+    with Stream.create_for_read(path).as_file("r") as f:
+        rows = list(csv.reader(f))
+    assert rows == [["a", "b"], ["1", "2"], ["3", "4"]]
+
+    jpath = str(tmp_path / "t.json")
+    with Stream.create(jpath, "w") as s:
+        json.dump({"k": [1, 2, 3]}, s.as_file("w", close_stream=True))
+    got = json.load(Stream.create_for_read(jpath).as_file("r"))
+    assert got == {"k": [1, 2, 3]}
+
+
+def test_stream_as_file_seek(tmp_path):
+    path = str(tmp_path / "b.bin")
+    with Stream.create(path, "w") as s:
+        s.write(bytes(range(100)))
+    f = Stream.create_for_read(path).as_file("rb", close_stream=True)
+    assert f.read(3) == b"\x00\x01\x02"
+    f.seek(50)
+    assert f.read(2) == b"\x32\x33"
+    assert f.tell() == 52
+    f.close()
