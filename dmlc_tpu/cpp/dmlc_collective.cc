@@ -1045,7 +1045,13 @@ int kv_run_server(DmlcKV* kv) {
   struct Pending { int fd; int32_t key; int32_t n; int32_t minp; };
   std::vector<Pending> pending;
   std::vector<int> conns;
+  // per-connection protocol state, keyed by CURRENT fd (erased on
+  // close so kernel fd-number reuse cannot alias old state):
+  // 0 = connected, never spoke; 1 = spoke the KV protocol (a worker);
+  // 2 = sent FIN (clean teardown expected)
+  std::map<int, int> state;
   int fins = 0;
+  int dropped = 0;  // workers that vanished mid-protocol
 
   auto reply_pull = [&](int fd, int32_t key, int32_t n) {
     Frame f{fd};
@@ -1058,8 +1064,12 @@ int kv_run_server(DmlcKV* kv) {
   };
 
   // a peer that died mid-protocol must not take the server down: drop
-  // its connection and any deferred pulls, keep serving the rest
+  // its connection and any deferred pulls, keep serving the rest.
+  // Each drop counts toward the termination quorum (a vanished worker
+  // will never FIN) so the server exits instead of polling forever.
   auto drop_conn = [&](int fd) {
+    auto it = std::find(conns.begin(), conns.end(), fd);
+    if (it == conns.end()) return;  // already dropped this sweep
     for (size_t p = 0; p < pending.size();) {
       if (pending[p].fd == fd)
         pending.erase(pending.begin() + p);
@@ -1067,8 +1077,16 @@ int kv_run_server(DmlcKV* kv) {
         ++p;
     }
     ::close(fd);
-    auto it = std::find(conns.begin(), conns.end(), fd);
-    if (it != conns.end()) conns.erase(it);
+    conns.erase(it);
+    // only a PROVEN worker (spoke the protocol, no FIN yet) counts as
+    // a death: silent strays (port scans, health probes) must neither
+    // trip the quorum nor be mistaken for workers, and a post-FIN
+    // close is normal teardown
+    auto st = state.find(fd);
+    if (st != state.end()) {
+      if (st->second == 1) ++dropped;
+      state.erase(st);
+    }
   };
 
   // one wire frame must never drive an unbounded allocation: mirror
@@ -1077,7 +1095,7 @@ int kv_run_server(DmlcKV* kv) {
   const int32_t max_n =
       static_cast<int32_t>(kMaxFrame / static_cast<long>(sizeof(double)));
 
-  while (fins < kv->num_workers) {
+  while (fins + dropped < kv->num_workers) {
     std::vector<pollfd> pfds;
     pfds.push_back({kv->listener, POLLIN, 0});
     for (int fd : conns) pfds.push_back({fd, POLLIN, 0});
@@ -1092,6 +1110,7 @@ int kv_run_server(DmlcKV* kv) {
         int one = 1;
         setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
         conns.push_back(fd);
+        state[fd] = 0;
       }
     }
     for (size_t i = 1; i < pfds.size(); ++i) {
@@ -1102,6 +1121,7 @@ int kv_run_server(DmlcKV* kv) {
         drop_conn(pfds[i].fd);
         continue;
       }
+      if (state[pfds[i].fd] == 0) state[pfds[i].fd] = 1;  // a worker
       if (op == 1) {  // PUSH
         int32_t key, n;
         if (!f.recv_int(&key) || !f.recv_int(&n) || n < 0 || n > max_n)
@@ -1115,15 +1135,21 @@ int kv_run_server(DmlcKV* kv) {
         ++pushes[key];
         if (!f.send_int(0)) return -1;
         // wake deferred pulls on this key; a wake hitting a dead
-        // worker's socket drops that worker, not the server
-        for (size_t p = 0; p < pending.size();) {
-          if (pending[p].key == key && pushes[key] >= pending[p].minp) {
-            const int pfd = pending[p].fd;
-            const int32_t pn = pending[p].n;
-            pending.erase(pending.begin() + p);
-            if (!reply_pull(pfd, key, pn)) drop_conn(pfd);
-          } else {
-            ++p;
+        // worker's socket drops that worker, not the server.  Restart
+        // the scan after each wake: drop_conn may erase OTHER entries
+        // and shift indices under the loop.
+        bool woke = true;
+        while (woke) {
+          woke = false;
+          for (size_t p = 0; p < pending.size(); ++p) {
+            if (pending[p].key == key && pushes[key] >= pending[p].minp) {
+              const int pfd = pending[p].fd;
+              const int32_t pn = pending[p].n;
+              pending.erase(pending.begin() + p);
+              if (!reply_pull(pfd, key, pn)) drop_conn(pfd);
+              woke = true;
+              break;
+            }
           }
         }
       } else if (op == 2) {  // PULL
@@ -1138,6 +1164,7 @@ int kv_run_server(DmlcKV* kv) {
         }
       } else if (op == 3) {  // FIN
         ++fins;
+        state[pfds[i].fd] = 2;
         if (!f.send_int(0)) return -1;
       } else {
         kv->error = "server: unknown op " + std::to_string(op);
@@ -1146,6 +1173,11 @@ int kv_run_server(DmlcKV* kv) {
     }
   }
   for (int fd : conns) ::close(fd);
+  if (dropped > 0) {
+    kv->error = std::to_string(dropped) +
+                " worker(s) vanished mid-protocol";
+    return -1;  // the gang lost members: fail the job, don't hang it
+  }
   return 0;
 }
 

@@ -164,6 +164,23 @@ int main(int argc, char** argv) {
     free(big);
   }
 
+  /* large broadcast from a nonzero root: multi-chunk relay (shm slot
+   * double-buffering / TCP ancestor-path streaming) */
+  {
+    long nb = 3 << 20;
+    char* bb = (char*)malloc(nb);
+    long j;
+    if (rank == root) {
+      for (j = 0; j < nb; ++j) bb[j] = (char)((j * 31 + 7) & 0xff);
+    } else {
+      memset(bb, 0, nb);
+    }
+    CHECK(dmlc_comm_broadcast(c, bb, nb, root) == 0, "big broadcast rc");
+    for (j = 0; j < nb; j += 4099)
+      CHECK(bb[j] == (char)((j * 31 + 7) & 0xff), "big broadcast value");
+    free(bb);
+  }
+
   /* large allgather: exercises the duplex ring path */
   {
     long nb = 512 << 10;

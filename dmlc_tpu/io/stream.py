@@ -116,6 +116,9 @@ class Stream(abc.ABC):
         binary = mode in ("rb", "wb")
         check(mode in ("r", "rb", "w", "wb"),
               f"as_file: unsupported mode {mode!r}")
+        check(buffering != 0,
+              "as_file: unbuffered (buffering=0) is not supported — "
+              "write through the Stream directly for unbuffered IO")
         writing = mode in ("w", "wb")
         raw = _StreamRawIO(self, writing=writing,
                            close_stream=close_stream)

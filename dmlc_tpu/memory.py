@@ -47,25 +47,27 @@ class MemoryPool:
         self.obj_size = int(obj_size)
         self._arena_objects = max(1, int(arena_objects))
         self._max_free = int(max_free)
-        self._free: List[np.ndarray] = []
+        self._free: List[np.ndarray] = []    # returned via free()
+        self._fresh: List[np.ndarray] = []   # carved, never handed out
         self._lock = threading.Lock()
         self.allocated = 0   # total pieces handed out over the lifetime
-        self.recycled = 0    # pieces served from the freelist
+        self.recycled = 0    # pieces that went through free() and back
 
     def _grow(self) -> None:
         arena = np.empty(self.obj_size * self._arena_objects, np.uint8)
-        self._free.extend(
+        self._fresh.extend(
             arena[i * self.obj_size:(i + 1) * self.obj_size]
             for i in range(self._arena_objects))
 
     def alloc(self) -> np.ndarray:
         with self._lock:
-            if not self._free:
-                self._grow()
-            else:
-                self.recycled += 1
             self.allocated += 1
-            return self._free.pop()
+            if self._free:
+                self.recycled += 1
+                return self._free.pop()
+            if not self._fresh:
+                self._grow()
+            return self._fresh.pop()
 
     def free(self, buf: np.ndarray) -> None:
         check(buf.nbytes == self.obj_size,
@@ -111,8 +113,13 @@ class BufferPool:
 
     def release(self, buf: np.ndarray) -> None:
         n = buf.nbytes
-        if n & (n - 1) or n < 64:
-            return  # not one of ours (or a sliced view): let GC have it
+        # only whole, owning uint8 arrays of a pool size class come
+        # back: foreign dtypes would make acquire() hand out wrongly-
+        # typed buffers, and a sliced view would pin its entire base
+        # array while held_bytes counts only the slice
+        if (n & (n - 1) or n < 64 or buf.dtype != np.uint8
+                or buf.base is not None or buf.ndim != 1):
+            return  # not one of ours: let the GC have it
         with self._lock:
             if self._held + n > self._max_bytes:
                 return

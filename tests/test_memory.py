@@ -103,7 +103,9 @@ def test_stream_as_file_text_and_binary(tmp_path):
 
     jpath = str(tmp_path / "t.json")
     with Stream.create(jpath, "w") as s:
-        json.dump({"k": [1, 2, 3]}, s.as_file("w", close_stream=True))
+        f = s.as_file("w")
+        json.dump({"k": [1, 2, 3]}, f)
+        f.close()  # explicit: flush must not depend on refcount timing
     got = json.load(Stream.create_for_read(jpath).as_file("r"))
     assert got == {"k": [1, 2, 3]}
 
