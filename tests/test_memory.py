@@ -43,6 +43,22 @@ def test_buffer_pool_size_classes_and_bound():
     assert pool.held_bytes <= 1 << 20
 
 
+def test_buffer_pool_rejects_foreign_buffers():
+    """Only whole owning uint8 arrays come back: a foreign dtype would
+    be handed out by a later acquire(), and a sliced view would pin its
+    whole base array while held_bytes counts just the slice."""
+    pool = BufferPool()
+    pool.release(np.zeros(128, np.float64))     # 1024 bytes, wrong dtype
+    assert pool.held_bytes == 0
+    base = np.zeros(1 << 20, np.uint8)
+    pool.release(base[:64])                     # view: would pin 1 MB
+    assert pool.held_bytes == 0
+    pool.release(np.zeros((32, 32), np.uint8))  # 2-D
+    assert pool.held_bytes == 0
+    got = pool.acquire(1000)
+    assert got.dtype == np.uint8 and got.ndim == 1
+
+
 def test_buffer_pool_thread_safety():
     pool = BufferPool()
     errors = []
