@@ -96,6 +96,64 @@ static int run_bench(DmlcComm* c) {
   return 0;
 }
 
+/* Randomized mixed-op stress: every rank derives the SAME op/size/root
+ * sequence from a broadcast seed, so the gang issues identical
+ * collectives while sizes span 1 element .. ~1.5 MB — many shm chunks,
+ * slot reuse across op types, announce-slot parity flips, odd element
+ * counts.  Catches generation-discipline bugs a fixed sequence cannot. */
+static int run_stress(DmlcComm* c, int rounds) {
+  int rank = dmlc_comm_rank(c);
+  int world = dmlc_comm_world_size(c);
+  unsigned long seed = 0;
+  if (rank == 0) seed = 0x9e3779b9UL ^ (unsigned long)world;
+  CHECK(dmlc_comm_broadcast(c, &seed, sizeof seed, 0) == 0, "seed bcast");
+  double* buf = (double*)malloc((200 * 1000 + 8) * sizeof(double));
+  double* out = (double*)malloc((200 * 1000 + 8) * sizeof(double) * world);
+  int r;
+  for (r = 0; r < rounds; ++r) {
+    seed = seed * 6364136223846793005UL + 1442695040888963407UL;
+    const long n = 1 + (long)((seed >> 16) % 200000); /* elems */
+    const int kind = (int)((seed >> 40) % 3);
+    long i;
+    if (kind == 0) { /* f64 sum allreduce */
+      for (i = 0; i < n; ++i) buf[i] = (double)(i % 13) + rank;
+      CHECK(dmlc_comm_allreduce(c, buf, n, DMLC_F64, DMLC_SUM) == 0,
+            "stress allreduce rc");
+      for (i = 0; i < n; i += 997) {
+        double want = world * (double)(i % 13) + world * (world - 1) / 2.0;
+        CHECK(fabs(buf[i] - want) < 1e-9, "stress allreduce value");
+      }
+    } else if (kind == 1) { /* broadcast from a rotating root */
+      const int root = (int)((seed >> 8) % world);
+      for (i = 0; i < n; ++i)
+        buf[i] = rank == root ? (double)((i * 7 + r) % 101) : -1.0;
+      CHECK(dmlc_comm_broadcast(c, buf, n * 8, root) == 0,
+            "stress broadcast rc");
+      for (i = 0; i < n; i += 997)
+        CHECK(buf[i] == (double)((i * 7 + r) % 101),
+              "stress broadcast value");
+    } else { /* allgather */
+      const long nb = (n % 4096) + 1;
+      for (i = 0; i < nb; ++i) buf[i] = rank * 1000.0 + (double)(i % 7);
+      CHECK(dmlc_comm_allgather(c, buf, nb * 8, out) == 0,
+            "stress allgather rc");
+      for (i = 0; i < world; ++i) {
+        long j;
+        for (j = 0; j < nb; j += 97)  /* sample block interiors too */
+          CHECK(out[i * nb + j] == i * 1000.0 + (double)(j % 7),
+                "stress allgather value");
+      }
+    }
+  }
+  free(buf);
+  free(out);
+  if (rank == 0) {
+    printf("stress OK rounds=%d world=%d\n", rounds, world);
+    fflush(stdout);
+  }
+  return 0;
+}
+
 int main(int argc, char** argv) {
   DmlcComm* c = dmlc_comm_init();
   if (c == NULL) {
@@ -106,6 +164,11 @@ int main(int argc, char** argv) {
   int world = dmlc_comm_world_size(c);
   CHECK(rank >= 0 && world >= 1, "bad rank/world");
 
+  if (argc > 1 && strcmp(argv[1], "stress") == 0) {
+    int rc = run_stress(c, argc > 2 ? atoi(argv[2]) : 60);
+    dmlc_comm_shutdown(c);
+    return rc;
+  }
   if (argc > 1 && strcmp(argv[1], "bench") == 0) {
     int rc = run_bench(c);
     dmlc_comm_shutdown(c);

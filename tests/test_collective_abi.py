@@ -56,15 +56,23 @@ def gbdt(collective_lib):
         os.path.join(os.path.dirname(collective_lib), "gbdt_allreduce"))
 
 
-def _run_gbdt(exe, world):
-    env = os.environ.copy()
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+def _submit(args, env=None, timeout=180):
+    """Run dmlc-submit with the repo importable; returns CompletedProcess
+    after asserting a clean exit and no worker-side FAIL lines."""
+    penv = os.environ.copy()
+    penv["PYTHONPATH"] = REPO + os.pathsep + penv.get("PYTHONPATH", "")
+    penv.update(env or {})
     r = subprocess.run(
         [sys.executable, "-m", "dmlc_tpu.tracker.submit",
-         "--cluster", "local", "--num-workers", str(world), "--", exe],
-        capture_output=True, text=True, timeout=180, env=env, cwd=REPO)
+         "--cluster", "local", *args],
+        capture_output=True, text=True, timeout=timeout, env=penv, cwd=REPO)
     assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
     assert "FAIL" not in r.stderr
+    return r
+
+
+def _run_gbdt(exe, world):
+    r = _submit(["--num-workers", str(world), "--", exe])
     line = next(ln for ln in r.stdout.splitlines()
                 if ln.startswith("gbdt rmse="))
     return float(line.split("rmse=")[1].split()[0])
@@ -79,6 +87,24 @@ def test_gbdt_allreduce_matches_single_process(gbdt):
     assert single < 0.3, single          # the model actually learned
     # fp reduction order differs between tree-allreduce and a local sum
     assert abs(multi - single) < 1e-4 * max(single, 1e-9), (single, multi)
+
+
+@pytest.mark.parametrize("env", [
+    {"DMLC_COLL_SHM": "1"},            # shm, default 512 KB chunks
+    {"DMLC_COLL_SHM": "1",
+     "DMLC_COLL_SHM_CHUNK_KB": "4"},   # shm, heavy multi-chunk + parity
+    {"DMLC_COLL_SHM": "0"},            # TCP tree/ring fallback
+])
+def test_randomized_mixed_op_stress(driver, env):
+    """Every rank derives the same random op/size/root sequence from a
+    broadcast seed: 40 rounds of mixed f64 allreduce / rotating-root
+    broadcast / allgather at sizes up to ~1.5 MB — slot reuse across op
+    types and announce-slot parity flips, the shm generation
+    discipline's hardest inputs."""
+    r = _submit(["--num-workers", "4", "--max-attempts", "1",
+                 "--host-ip", "127.0.0.1", "--", driver, "stress", "40"],
+                env=env)
+    assert "stress OK rounds=40 world=4" in r.stdout, r.stdout
 
 
 @pytest.fixture(scope="module")
@@ -96,16 +122,9 @@ def test_kv_parameter_server_end_to_end(kv_ps, workers, servers):
     each worker pushes per-rank vectors, then pulls with the full PS
     clock (min_pushes = workers) and must read the exact cross-worker
     sum on every key/slot."""
-    env = os.environ.copy()
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    r = subprocess.run(
-        [sys.executable, "-m", "dmlc_tpu.tracker.submit",
-         "--cluster", "local", "--num-workers", str(workers),
-         "--num-servers", str(servers), "--max-attempts", "1",
-         "--host-ip", "127.0.0.1", "--", kv_ps],
-        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
-    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
-    assert "FAIL" not in r.stderr
+    r = _submit(["--num-workers", str(workers), "--num-servers",
+                 str(servers), "--max-attempts", "1",
+                 "--host-ip", "127.0.0.1", "--", kv_ps], timeout=120)
     for rank in range(workers):
         assert f"kv OK rank={rank} workers={workers}" in r.stdout, r.stdout
 
@@ -116,15 +135,8 @@ def test_c_driver_collectives_under_local_launcher(driver, world, shm):
     """Both transports: the same-host shared-memory fast path (default
     on a local gang) and the TCP tree/ring fallback (DMLC_COLL_SHM=0 —
     what cross-host links ride)."""
-    env = os.environ.copy()
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    env["DMLC_COLL_SHM"] = shm
-    r = subprocess.run(
-        [sys.executable, "-m", "dmlc_tpu.tracker.submit",
-         "--cluster", "local", "--num-workers", str(world), "--", driver],
-        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
-    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
-    assert "FAIL" not in r.stderr
+    r = _submit(["--num-workers", str(world), "--", driver],
+                env={"DMLC_COLL_SHM": shm}, timeout=120)
     # every rank logged through the tracker print relay
     for rank in range(world):
         assert f"rank {rank}/{world}: collective ABI OK" in r.stderr, r.stderr
