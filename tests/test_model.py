@@ -189,3 +189,36 @@ def test_gradients_match_oracle(mesh):
             np.asarray(g), np.asarray(w), atol=5e-5, rtol=1e-3,
             err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}",
         )
+
+
+def test_remat_policies_are_math_neutral():
+    """remat and its policies trade memory for recompute — never math:
+    loss and gradients must be bitwise-comparable across full /
+    save_flash / save_flash_mlp and remat off."""
+    import dataclasses
+
+    ids, labels = _data(jax.random.PRNGKey(5))
+    results = []
+    for remat, policy in [(False, "save_flash"), (True, "full"),
+                          (True, "save_flash"), (True, "save_flash_mlp")]:
+        cfg = dataclasses.replace(CFG, remat=remat, remat_policy=policy)
+        params = init_params(jax.random.PRNGKey(0), cfg, n_stages=1)
+        loss, grads = jax.value_and_grad(
+            lambda p: unsharded_loss(p, ids, labels, cfg))(params)
+        gnorm = sum(float(jnp.sum(jnp.abs(g)))
+                    for g in jax.tree.leaves(grads))
+        results.append((float(loss), gnorm))
+    base = results[0]
+    for got in results[1:]:
+        np.testing.assert_allclose(got[0], base[0], rtol=1e-6)
+        np.testing.assert_allclose(got[1], base[1], rtol=1e-5)
+
+
+def test_unknown_remat_policy_rejected():
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, remat=True, remat_policy="bogus")
+    params = init_params(jax.random.PRNGKey(0), cfg, n_stages=1)
+    ids, labels = _data(jax.random.PRNGKey(6))
+    with pytest.raises(ValueError, match="remat_policy"):
+        unsharded_loss(params, ids, labels, cfg)
