@@ -74,6 +74,60 @@ def test_roundtrip_gcs(tmp_path, mesh):
         srv.shutdown()
 
 
+def test_roundtrip_s3_and_hdfs(tmp_path, mesh):
+    """Sharded checkpoints are backend-agnostic: the same save/restore
+    rides the s3:// multipart writer and the hdfs:// temp+RENAME
+    writer through their hermetic emulators."""
+    import os
+    import threading
+    from http.server import ThreadingHTTPServer
+
+    from dmlc_tpu.io.filesys import FileSystem
+    from tests.test_hdfs_azure import _FakeNameNode
+    from tests.test_s3 import _FakeS3
+
+    x = jnp.arange(64.0).reshape(8, 8)
+    sharded = jax.device_put(x, NamedSharding(mesh, P(("pp", "sp"), "tp")))
+    tree = {"w": sharded, "b": np.ones(3, np.float32)}
+
+    _FakeS3.store.clear()
+    s3srv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeS3)
+    threading.Thread(target=s3srv.serve_forever, daemon=True).start()
+    _FakeNameNode.store.clear()
+    nnsrv = ThreadingHTTPServer(("127.0.0.1", 0), _FakeNameNode)
+    threading.Thread(target=nnsrv.serve_forever, daemon=True).start()
+    keys = ("DMLC_S3_ENDPOINT", "AWS_ACCESS_KEY_ID",
+            "AWS_SECRET_ACCESS_KEY", "AWS_REGION",
+            "DMLC_WEBHDFS_ENDPOINT")
+    saved = {k: os.environ.get(k) for k in keys}
+    os.environ["DMLC_S3_ENDPOINT"] = f"127.0.0.1:{s3srv.server_port}"
+    os.environ["AWS_ACCESS_KEY_ID"] = "AKIACKPT"
+    os.environ["AWS_SECRET_ACCESS_KEY"] = "ckpt-secret"
+    os.environ["AWS_REGION"] = "us-test-1"
+    os.environ["DMLC_WEBHDFS_ENDPOINT"] = f"127.0.0.1:{nnsrv.server_port}"
+    for key in [k for k in FileSystem._instances
+                if k.startswith(("s3://", "hdfs://"))]:
+        del FileSystem._instances[key]
+    try:
+        for uri in ("s3://ckpts/run1/step1", "hdfs://nn/ckpts/step1"):
+            save_pytree(uri, tree)
+            got = restore_pytree(uri, tree, mesh=mesh)
+            np.testing.assert_array_equal(np.asarray(got["w"]),
+                                          np.asarray(x))
+            np.testing.assert_array_equal(np.asarray(got["b"]), tree["b"])
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        for key in [k for k in FileSystem._instances
+                    if k.startswith(("s3://", "hdfs://"))]:
+            del FileSystem._instances[key]
+        s3srv.shutdown()
+        nnsrv.shutdown()
+
+
 def test_checkpoint_manager_retention(tmp_path, mesh):
     mgr = CheckpointManager(str(tmp_path / "run"), max_to_keep=2)
     tree = {"w": np.arange(10, dtype=np.float32)}
