@@ -76,14 +76,14 @@ def test_roundtrip_gcs(tmp_path, mesh):
 
 def test_roundtrip_s3_and_hdfs(tmp_path, mesh):
     """Sharded checkpoints are backend-agnostic: the same save/restore
-    rides the s3:// multipart writer and the hdfs:// temp+RENAME
-    writer through their hermetic emulators."""
+    rides the s3:// SigV4 writer (single-PUT at these shard sizes; the
+    multipart lifecycle is covered by test_s3) and the hdfs://
+    temp+RENAME writer through their hermetic emulators."""
     import os
     import threading
     from http.server import ThreadingHTTPServer
 
-    from dmlc_tpu.io.filesys import FileSystem
-    from tests.test_hdfs_azure import _FakeNameNode
+    from tests.test_hdfs_azure import _FakeNameNode, _drop_cached_instances
     from tests.test_s3 import _FakeS3
 
     x = jnp.arange(64.0).reshape(8, 8)
@@ -105,9 +105,7 @@ def test_roundtrip_s3_and_hdfs(tmp_path, mesh):
     os.environ["AWS_SECRET_ACCESS_KEY"] = "ckpt-secret"
     os.environ["AWS_REGION"] = "us-test-1"
     os.environ["DMLC_WEBHDFS_ENDPOINT"] = f"127.0.0.1:{nnsrv.server_port}"
-    for key in [k for k in FileSystem._instances
-                if k.startswith(("s3://", "hdfs://"))]:
-        del FileSystem._instances[key]
+    _drop_cached_instances("s3://", "hdfs://")
     try:
         for uri in ("s3://ckpts/run1/step1", "hdfs://nn/ckpts/step1"):
             save_pytree(uri, tree)
@@ -121,9 +119,7 @@ def test_roundtrip_s3_and_hdfs(tmp_path, mesh):
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
-        for key in [k for k in FileSystem._instances
-                    if k.startswith(("s3://", "hdfs://"))]:
-            del FileSystem._instances[key]
+        _drop_cached_instances("s3://", "hdfs://")
         s3srv.shutdown()
         nnsrv.shutdown()
 
