@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""Head-to-head: dmlc_tpu flash attention vs jax.experimental's
+reference Pallas TPU implementation, same shapes, same chip.
+
+Run on a TPU host:  python scripts/bench_flash_vs_jax.py
+
+Prints per-shape forward and forward+backward wall times plus a
+numerical parity check (both are exact attention with the same
+sm_scale, so outputs must agree to bf16 tolerance — measured max|diff|
+0.0039).  Measured on the round-5 dev chip (v5e):
+
+    B=8 T=1024 H=16 D=128: ours fwd 2.90ms / fwd+bwd  6.42ms
+                           jax  fwd 6.49ms / fwd+bwd 14.24ms   (2.2x)
+    B=1 T=8192 H=16 D=128: ours fwd 5.32ms / fwd+bwd 15.07ms
+                           jax  fwd 22.73ms / fwd+bwd 71.61ms  (4.3-4.8x)
+
+The structural differences that buy this: the KV/Q walk lives in the
+pallas grid (pipelined) with accumulators in revisited output blocks,
+uniform 1024x1024 blocks (swept on the full train step), block-level
+causal-mask classification (only diagonal blocks pay the mask chain),
+and a backward split into dkv/dq passes with independently-tunable
+blocks.
+"""
+
+import sys
+import time
+
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as jax_flash)
+
+    from dmlc_tpu.ops.flash_attention import flash_attention as our_flash
+
+    if jax.devices()[0].platform != "tpu":
+        raise SystemExit("needs a TPU (pallas TPU lowering)")
+
+    def bench(fn, grad_fn, q, k, v, reps=30):
+        o = fn(q, k, v)
+        jax.block_until_ready(o)
+        float(jnp.sum(o.astype(jnp.float32)))
+        g = grad_fn(q, k, v)
+        float(jnp.sum(g[0].astype(jnp.float32)))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            o = fn(q, k, v)
+        float(jnp.sum(o.astype(jnp.float32)))
+        dt_f = (time.perf_counter() - t0) / reps
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            g = grad_fn(q, k, v)
+        float(jnp.sum(g[0].astype(jnp.float32)))
+        dt_b = (time.perf_counter() - t0) / reps
+        return dt_f, dt_b, o
+
+    for (b, t, h, d) in [(8, 1024, 16, 128), (1, 8192, 16, 128)]:
+        q = jax.random.normal(jax.random.PRNGKey(0), (b, t, h, d),
+                              jnp.bfloat16)
+        k = jax.random.normal(jax.random.PRNGKey(1), (b, t, h, d),
+                              jnp.bfloat16)
+        v = jax.random.normal(jax.random.PRNGKey(2), (b, t, h, d),
+                              jnp.bfloat16)
+        qj, kj, vj = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+
+        sm = 1.0 / (d ** 0.5)  # jax_flash defaults sm_scale=1.0; pin both
+        ours_f = jax.jit(lambda q, k, v: our_flash(q, k, v, causal=True,
+                                                   scale=sm))
+        ours_g = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
+            our_flash(q, k, v, causal=True, scale=sm).astype(jnp.float32)),
+            argnums=(0, 1, 2)))
+        jf = jax.jit(lambda q, k, v: jax_flash(q, k, v, causal=True,
+                                               sm_scale=sm))
+        jg = jax.jit(jax.grad(lambda q, k, v: jnp.sum(
+            jax_flash(q, k, v, causal=True,
+                      sm_scale=sm).astype(jnp.float32)),
+            argnums=(0, 1, 2)))
+
+        of, ob, oo = bench(ours_f, ours_g, q, k, v)
+        jfwd, jbwd, jo = bench(jf, jg, qj, kj, vj)
+        # parity: both compute exact causal attention
+        diff = jnp.max(jnp.abs(
+            oo.astype(jnp.float32)
+            - jo.transpose(0, 2, 1, 3).astype(jnp.float32)))
+        print(f"B={b} T={t}: ours fwd {of * 1e3:.2f}ms fwd+bwd "
+              f"{ob * 1e3:.2f}ms | jax fwd {jfwd * 1e3:.2f}ms fwd+bwd "
+              f"{jbwd * 1e3:.2f}ms | speedup {jfwd / of:.2f}x/"
+              f"{jbwd / ob:.2f}x | max|diff| {float(diff):.4f}")
+
+
+if __name__ == "__main__":
+    main()
