@@ -84,14 +84,19 @@ def main():
 
         of, ob, oo = bench(ours_f, ours_g, q, k, v)
         jfwd, jbwd, jo = bench(jf, jg, qj, kj, vj)
-        # parity: both compute exact causal attention
-        diff = jnp.max(jnp.abs(
+        # parity: both compute exact causal attention — a speedup over
+        # numerically wrong kernels is no speedup, so the yardstick
+        # FAILS on disagreement beyond bf16 tolerance
+        diff = float(jnp.max(jnp.abs(
             oo.astype(jnp.float32)
-            - jo.transpose(0, 2, 1, 3).astype(jnp.float32)))
+            - jo.transpose(0, 2, 1, 3).astype(jnp.float32))))
         print(f"B={b} T={t}: ours fwd {of * 1e3:.2f}ms fwd+bwd "
               f"{ob * 1e3:.2f}ms | jax fwd {jfwd * 1e3:.2f}ms fwd+bwd "
               f"{jbwd * 1e3:.2f}ms | speedup {jfwd / of:.2f}x/"
-              f"{jbwd / ob:.2f}x | max|diff| {float(diff):.4f}")
+              f"{jbwd / ob:.2f}x | max|diff| {diff:.4f}")
+        if diff > 0.02:
+            raise SystemExit(
+                f"PARITY FAILURE: outputs diverge (max|diff| {diff})")
 
 
 if __name__ == "__main__":

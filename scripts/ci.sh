@@ -10,6 +10,10 @@
 #   4. ThreadSanitizer stress on the native parse fanout (skipped only
 #      when the tsan runtime itself is absent; a compile failure of our
 #      sources is a hard CI failure)
+#   5. AddressSanitizer pass over the collective ABI: the C driver's
+#      full correctness suite (shm transport + TCP fallback) under the
+#      real launcher, leak detection on — the shm/KV code is the one
+#      native surface with nontrivial object lifecycle
 #
 # Usage: scripts/ci.sh [pytest-args...]
 set -u
@@ -74,4 +78,43 @@ if command -v g++ >/dev/null 2>&1; then
     fi
 fi
 
-echo "== CI OK (native=$NATIVE_OK tsan=$TSAN_OK) =="
+echo "== stage 5: AddressSanitizer pass on the collective ABI =="
+ASAN_OK=skipped
+if command -v g++ >/dev/null 2>&1 && command -v gcc >/dev/null 2>&1; then
+    ASAN_DIR=$(mktemp -d)
+    trap 'rm -rf "$TSAN_DIR" "$ASAN_DIR"' EXIT
+    echo 'int main(){return 0;}' > "$ASAN_DIR/probe.cc"
+    if g++ -fsanitize=address "$ASAN_DIR/probe.cc" -o "$ASAN_DIR/probe" \
+           2>/dev/null && "$ASAN_DIR/probe"; then
+        g++ -O1 -g -fsanitize=address -std=c++17 -shared -fPIC \
+            dmlc_tpu/cpp/dmlc_collective.cc \
+            -o "$ASAN_DIR/libdmlc_collective.so" \
+            || { echo "FAIL: asan build of collective broke"; exit 1; }
+        gcc -O1 -g -fsanitize=address -std=c99 -I dmlc_tpu/cpp \
+            dmlc_tpu/cpp/test_collective.c \
+            "$ASAN_DIR/libdmlc_collective.so" \
+            -o "$ASAN_DIR/test_collective" -lm -lasan \
+            -Wl,-rpath,"$ASAN_DIR" \
+            || { echo "FAIL: asan build of collective driver broke"; exit 1; }
+        for shm in 1 0; do
+            DMLC_COLL_SHM=$shm python -m dmlc_tpu.tracker.submit \
+                --cluster local --num-workers 4 --max-attempts 1 \
+                --host-ip 127.0.0.1 -- "$ASAN_DIR/test_collective" \
+                > "$ASAN_DIR/run.log" 2>&1 \
+                || { echo "FAIL: asan collective run (shm=$shm)";
+                     tail -30 "$ASAN_DIR/run.log"; exit 1; }
+            if grep -qE "AddressSanitizer|LeakSanitizer" \
+                   "$ASAN_DIR/run.log"; then
+                echo "FAIL: sanitizer findings (shm=$shm)"
+                grep -E "AddressSanitizer|LeakSanitizer" -A5 \
+                    "$ASAN_DIR/run.log" | head -40
+                exit 1
+            fi
+        done
+        ASAN_OK=1
+    else
+        echo "asan runtime unavailable; skipping"
+    fi
+fi
+
+echo "== CI OK (native=$NATIVE_OK tsan=$TSAN_OK asan=$ASAN_OK) =="
