@@ -409,6 +409,15 @@ def main():
         "vs_baseline": round(ours / baseline, 3) if baseline else None,
         "extra_metrics": extra,
     }
+    # structured telemetry snapshot (histogram percentiles, span count)
+    # accumulated across every bench above — the attribution data later
+    # perf PRs cite; update_perf_docs.py renders it into the docs
+    try:
+        from dmlc_tpu import telemetry
+
+        result["telemetry"] = telemetry.export_json()
+    except Exception as e:  # noqa: BLE001
+        log(f"bench: telemetry snapshot failed: {e!r}")
     print(json.dumps(result))
 
 

@@ -13,6 +13,7 @@ A from-scratch rebuild of the capabilities of crazy-cat/dmlc-core
   - XLA collective surface (psum/all_gather/... over ICI/DCN)   (tpu/collective)
   - sequence/context-parallel ring primitives                   (parallel/)
   - distributed job launcher + rank rendezvous tracker          (tracker/)
+  - telemetry: histograms, spans, exporters, cluster /metrics   (telemetry/)
 """
 
 __version__ = "0.1.0"

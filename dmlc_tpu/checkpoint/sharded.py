@@ -98,41 +98,53 @@ def save_pytree(uri: str, tree: Any, *, process_index: int = 0) -> None:
     """
     import jax
 
-    _ensure_dir(uri)
-    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
-    manifest: Dict[str, Any] = {"format": 1, "leaves": {}}
-    for path, leaf in leaves:
-        key = _leaf_key(path)
-        check(key not in manifest["leaves"], f"duplicate leaf key {key}")
-        arr = leaf
-        entry: Dict[str, Any] = {
-            "path": jax.tree_util.keystr(path),
-            "shape": list(np.shape(arr)),
-            "dtype": str(arr.dtype) if hasattr(arr, "dtype")
-            else str(np.asarray(arr).dtype),
-            "spec": _spec_to_json(arr),
-            "shards": {},
-        }
-        if hasattr(arr, "addressable_shards"):
-            for shard in arr.addressable_shards:
-                if shard.replica_id != 0:
-                    continue
-                ikey = _index_key(shard.index, arr.shape)
-                fname = f"{key}.{ikey}"
-                entry["shards"][ikey] = fname
-                with Stream.create(_join(uri, fname), "w") as s:
-                    s.write(np.ascontiguousarray(shard.data).tobytes())
-        else:
-            npa = np.asarray(arr)
-            ikey = _index_key(tuple(slice(0, d) for d in npa.shape),
-                              npa.shape)
-            entry["shards"][ikey] = f"{key}.{ikey}"
-            with Stream.create(_join(uri, f"{key}.{ikey}"), "w") as s:
-                s.write(np.ascontiguousarray(npa).tobytes())
-        manifest["leaves"][key] = entry
-    if process_index == 0:
-        with Stream.create(_join(uri, MANIFEST), "w") as s:
-            s.write(json.dumps(manifest, indent=1).encode())
+    from .. import telemetry
+
+    with telemetry.span("checkpoint.save", stage="checkpoint",
+                        args={"uri": uri}), \
+            telemetry.timed("checkpoint", "save"):
+        _ensure_dir(uri)
+        leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+        manifest: Dict[str, Any] = {"format": 1, "leaves": {}}
+        nbytes = 0
+        for path, leaf in leaves:
+            key = _leaf_key(path)
+            check(key not in manifest["leaves"], f"duplicate leaf key {key}")
+            arr = leaf
+            entry: Dict[str, Any] = {
+                "path": jax.tree_util.keystr(path),
+                "shape": list(np.shape(arr)),
+                "dtype": str(arr.dtype) if hasattr(arr, "dtype")
+                else str(np.asarray(arr).dtype),
+                "spec": _spec_to_json(arr),
+                "shards": {},
+            }
+            if hasattr(arr, "addressable_shards"):
+                for shard in arr.addressable_shards:
+                    if shard.replica_id != 0:
+                        continue
+                    ikey = _index_key(shard.index, arr.shape)
+                    fname = f"{key}.{ikey}"
+                    entry["shards"][ikey] = fname
+                    raw = np.ascontiguousarray(shard.data).tobytes()
+                    nbytes += len(raw)
+                    with Stream.create(_join(uri, fname), "w") as s:
+                        s.write(raw)
+            else:
+                npa = np.asarray(arr)
+                ikey = _index_key(tuple(slice(0, d) for d in npa.shape),
+                                  npa.shape)
+                entry["shards"][ikey] = f"{key}.{ikey}"
+                raw = np.ascontiguousarray(npa).tobytes()
+                nbytes += len(raw)
+                with Stream.create(_join(uri, f"{key}.{ikey}"), "w") as s:
+                    s.write(raw)
+            manifest["leaves"][key] = entry
+        telemetry.inc("checkpoint", "bytes_written", nbytes)
+        telemetry.inc("checkpoint", "saves")
+        if process_index == 0:
+            with Stream.create(_join(uri, MANIFEST), "w") as s:
+                s.write(json.dumps(manifest, indent=1).encode())
 
 
 def _parse_index(ikey: str, shape) -> tuple:
@@ -196,6 +208,17 @@ def restore_pytree(uri: str, template: Any, *, mesh=None) -> Any:
     ``mesh``, leaves come back as sharded jax.Arrays per the recorded
     PartitionSpec; without, as host numpy arrays.
     """
+    from .. import telemetry
+
+    with telemetry.span("checkpoint.restore", stage="checkpoint",
+                        args={"uri": uri}), \
+            telemetry.timed("checkpoint", "restore"):
+        out = _restore_pytree(uri, template, mesh=mesh)
+    telemetry.inc("checkpoint", "restores")
+    return out
+
+
+def _restore_pytree(uri: str, template: Any, *, mesh=None) -> Any:
     import jax
 
     with Stream.create(_join(uri, MANIFEST), "r") as s:
@@ -212,6 +235,9 @@ def restore_pytree(uri: str, template: Any, *, mesh=None) -> Any:
         # manifest, so the manifest's shards dict covers one process only
         with Stream.create(_join(uri, f"{key}.{ikey}"), "r") as s:
             raw = _read_all(s)
+        from .. import telemetry
+
+        telemetry.inc("checkpoint", "bytes_read", len(raw))
         return np.frombuffer(raw, dtype=dtype).reshape(shape)
 
     listing_cache: list = []

@@ -155,24 +155,25 @@ class DeviceFeed:
 
         import jax
 
-        from .. import metrics
+        from .. import telemetry
 
         self._t0 = time.perf_counter()
         try:
             while not self._stop.is_set():
-                with metrics.timed("feed", "assemble"):
+                with telemetry.span("feed.assemble", stage="feed"), \
+                        telemetry.timed("feed", "assemble"):
                     host = self._assemble()
                 if host is None:
                     self._queue.put(None)
                     return
-                with metrics.annotate("dmlc_feed_batch"), \
-                        metrics.timed("feed", "device_put"):
+                with telemetry.annotate("dmlc_feed_batch"), \
+                        telemetry.timed("feed", "device_put"):
                     dev = {k: jax.device_put(v, self.sharding)
                            for k, v in host.items()}
                 nbytes = sum(v.nbytes for v in host.values())
                 self._bytes += nbytes
-                metrics.inc("feed", "batches")
-                metrics.inc("feed", "bytes_to_device", nbytes)
+                telemetry.inc("feed", "batches")
+                telemetry.inc("feed", "bytes_to_device", nbytes)
                 if self._bytes - self._last_log >= self._log_every:
                     dt = time.perf_counter() - self._t0
                     from ..logging import info
@@ -183,7 +184,7 @@ class DeviceFeed:
                     )
                     self._last_log = self._bytes
                 # a full queue means the consumer is the bottleneck
-                with metrics.timed("feed", "producer_stall"):
+                with telemetry.timed("feed", "producer_stall"):
                     self._queue.put(dev)
         except BaseException as e:  # surface on the consumer side
             self._queue.put(_ProducerError(e))
@@ -213,11 +214,11 @@ class DeviceFeed:
         self._stop.clear()
         self._thread = threading.Thread(target=self._produce, daemon=True)
         self._thread.start()
-        from .. import metrics
+        from .. import telemetry
 
         while True:
             # an empty queue means the producer is the bottleneck
-            with metrics.timed("feed", "consumer_stall"):
+            with telemetry.timed("feed", "consumer_stall"):
                 item = self._queue.get()
             if item is None:
                 return

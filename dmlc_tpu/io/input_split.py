@@ -525,6 +525,9 @@ class InputSplitBase(InputSplit):
 
     def _load_cursor(self) -> Optional[ChunkCursor]:
         """Chunk::Load with geometric growth (input_split_base.cc:241-258)."""
+        import time
+
+        t0 = time.perf_counter()
         if self._mmap_ok:
             cur = self._load_cursor_mmap()
         else:
@@ -535,10 +538,14 @@ class InputSplitBase(InputSplit):
                     break
                 size *= 2
         if cur is not None:
-            from .. import metrics
+            from .. import telemetry
 
-            metrics.inc("input_split", "chunks")
-            metrics.inc("input_split", "bytes", cur.end - cur.start)
+            telemetry.inc("input_split", "chunks")
+            telemetry.inc("input_split", "bytes", cur.end - cur.start)
+            # per-chunk load latency distribution: the feed-vs-storage
+            # attribution signal (is the producer slow, or its source?)
+            telemetry.observe_duration("input_split", "chunk_latency",
+                                       time.perf_counter() - t0)
         return cur
 
     # back-compat bytes API (copies; the cursor path is the hot one)
@@ -604,9 +611,9 @@ class InputSplitBase(InputSplit):
 
     def _flush_record_count(self) -> None:
         if self._rec_count:
-            from .. import metrics
+            from .. import telemetry
 
-            metrics.inc("input_split", "records", self._rec_count)
+            telemetry.inc("input_split", "records", self._rec_count)
             self._rec_count = 0
 
     def hint_chunk_size(self, chunk_size: int) -> None:

@@ -94,11 +94,36 @@ class RecordIOWriter:
 
 class RecordIOReader:
     """Sequential reader reassembling multi-segment records
-    (src/recordio.cc:53-82)."""
+    (src/recordio.cc:53-82).  Parse progress lands in telemetry
+    (``recordio.records`` / ``recordio.bytes``, flushed in batches so
+    the per-record loop never takes the registry lock)."""
+
+    _FLUSH_EVERY = 1024
 
     def __init__(self, stream: Stream):
         self._strm = stream
         self._eos = False
+        self._pend_records = 0
+        self._pend_bytes = 0
+
+    def _flush_counts(self) -> None:
+        if self._pend_records:
+            from .. import telemetry
+
+            telemetry.inc("recordio", "records", self._pend_records)
+            telemetry.inc("recordio", "bytes", self._pend_bytes)
+            self._pend_records = 0
+            self._pend_bytes = 0
+
+    def close(self) -> None:
+        """Flush batched telemetry counts; the caller owns the stream."""
+        self._flush_counts()
+
+    def __del__(self):  # abandoned mid-stream: don't lose the tail counts
+        try:
+            self._flush_counts()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
 
     def next_record(self) -> Optional[bytes]:
         if self._eos:
@@ -108,6 +133,7 @@ class RecordIOReader:
             hdr = self._strm.read(8)
             if len(hdr) == 0:
                 self._eos = True
+                self._flush_counts()
                 return None
             check(len(hdr) == 8, "invalid RecordIO file (truncated header)")
             magic, lrec = _HDR.unpack(hdr)
@@ -122,7 +148,12 @@ class RecordIOReader:
             if cflag == 0 or cflag == 3:
                 break
             parts.append(_MAGIC_BYTES)  # re-insert elided magic cell
-        return b"".join(parts)
+        rec = b"".join(parts)
+        self._pend_records += 1
+        self._pend_bytes += len(rec)
+        if self._pend_records >= self._FLUSH_EVERY:
+            self._flush_counts()
+        return rec
 
     def __iter__(self) -> Iterator[bytes]:
         while True:
@@ -170,14 +201,19 @@ class RecordIOChunkReader:
     records are reassembled into a temp buffer."""
 
     def __init__(self, chunk: bytes, part_index: int = 0, num_parts: int = 1):
+        from .. import telemetry
+
         self._buf = memoryview(chunk)
         size = len(chunk)
         nstep = (size + num_parts - 1) // num_parts
         nstep = ((nstep + 3) >> 2) << 2  # align (recordio.cc:105-107)
         begin = min(size, nstep * part_index)
         end = min(size, nstep * (part_index + 1))
-        self._pbegin = find_next_record_head(self._buf, begin, size)
-        self._pend = find_next_record_head(self._buf, end, size)
+        # per-chunk span (bounded: one per partition scan, not per record)
+        with telemetry.span("recordio.partition_scan", stage="recordio"), \
+                telemetry.timed("recordio", "partition_scan"):
+            self._pbegin = find_next_record_head(self._buf, begin, size)
+            self._pend = find_next_record_head(self._buf, end, size)
 
     def next_record(self) -> Optional[memoryview]:
         if self._pbegin >= self._pend:
@@ -192,22 +228,27 @@ class RecordIOChunkReader:
             self._pbegin = start + (((clen + 3) >> 2) << 2)
             check(self._pbegin <= self._pend, "invalid RecordIO format")
             return buf[start : start + clen]
-        # multi-segment reassembly (recordio.cc:131-154)
+        # multi-segment reassembly (recordio.cc:131-154) — rare (escaped
+        # magic), so a span per occurrence stays bounded
         check(cflag == 1, "invalid RecordIO format")
-        parts = []
-        while True:
-            check(self._pbegin + 8 <= self._pend, "invalid RecordIO format")
-            magic, lrec = _HDR.unpack_from(buf, self._pbegin)
-            check(magic == KMAGIC, "invalid RecordIO format")
-            cflag = decode_flag(lrec)
-            clen = decode_length(lrec)
-            start = self._pbegin + 8
-            parts.append(bytes(buf[start : start + clen]))
-            self._pbegin = start + (((clen + 3) >> 2) << 2)
-            if cflag == 3:
-                break
-            parts.append(_MAGIC_BYTES)
-        return memoryview(b"".join(parts))
+        from .. import telemetry
+
+        with telemetry.span("recordio.reassemble", stage="recordio"):
+            parts = []
+            while True:
+                check(self._pbegin + 8 <= self._pend,
+                      "invalid RecordIO format")
+                magic, lrec = _HDR.unpack_from(buf, self._pbegin)
+                check(magic == KMAGIC, "invalid RecordIO format")
+                cflag = decode_flag(lrec)
+                clen = decode_length(lrec)
+                start = self._pbegin + 8
+                parts.append(bytes(buf[start : start + clen]))
+                self._pbegin = start + (((clen + 3) >> 2) << 2)
+                if cflag == 3:
+                    break
+                parts.append(_MAGIC_BYTES)
+            return memoryview(b"".join(parts))
 
     def __iter__(self) -> Iterator[memoryview]:
         while True:

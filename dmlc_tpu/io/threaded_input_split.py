@@ -4,10 +4,17 @@ Rebuild of reference src/io/threaded_input_split.h:23-101: a producer thread
 pulls chunks via the base split while the consumer extracts records from the
 previous chunk — capacity 2 (double buffering), applied by default by the
 factory (src/io.cc:108-113).
+
+Telemetry: per-chunk load latency is recorded by the base split
+(``input_split.chunk_latency_secs`` histogram); this wrapper adds
+``input_split.producer_idle_secs`` — time the producer thread spends NOT
+loading (blocked on prefetch capacity), i.e. how far ahead of the
+consumer the storage path could run.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 from ..concurrency import ThreadedIter
@@ -21,6 +28,7 @@ class ThreadedInputSplit(InputSplit):
         self._base = base
         self._cap = max_capacity
         self._chunk: Optional[ChunkCursor] = None
+        self._last_produce_end: Optional[float] = None
         self._iter: ThreadedIter = ThreadedIter(
             self._produce, self._rewind, max_capacity=max_capacity
         )
@@ -28,11 +36,20 @@ class ThreadedInputSplit(InputSplit):
     def _produce(self, recycled):
         # runs on the producer thread; recycled cursors return their
         # buffers to the base pool here, so pool access stays single-thread
+        from .. import telemetry
+
+        t0 = time.perf_counter()
+        if self._last_produce_end is not None:
+            telemetry.observe_duration("input_split", "producer_idle",
+                                       t0 - self._last_produce_end)
         if recycled is not None:
             self._base.recycle_chunk(recycled)
-        return self._base._load_cursor()
+        cur = self._base._load_cursor()
+        self._last_produce_end = time.perf_counter()
+        return cur
 
     def _rewind(self) -> None:
+        self._last_produce_end = None  # the rewind gap is not idle time
         self._base.before_first()
 
     # ---- InputSplit interface ------------------------------------------
@@ -75,6 +92,7 @@ class ThreadedInputSplit(InputSplit):
         self._iter.destroy()
         self._base.reset_partition(part_index, num_parts)
         self._chunk = None
+        self._last_produce_end = None
         self._iter = ThreadedIter(self._produce, self._rewind, max_capacity=self._cap)
 
     def hint_chunk_size(self, chunk_size: int) -> None:

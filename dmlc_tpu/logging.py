@@ -3,14 +3,23 @@
 Rebuild of reference include/dmlc/logging.h:104-155 (LOG(severity) macros) and
 the ``CustomLogMessage`` pluggable sink (logging.h:233-252). Severity FATAL
 raises :class:`dmlc_tpu.base.DMLCError` (the ``DMLC_LOG_FATAL_THROW=1``
-behavior the reference defaults to for library use).
+behavior the reference defaults to for library use) — but only AFTER the
+formatted line reaches the sink/stderr, so the last words of a dying rank
+are in its log, not just in a traceback some launcher may have swallowed.
+
+Lines carry date, time, thread name, and (when ``DMLC_TASK_ID`` or
+``DMLC_RANK`` is set — read once) a rank prefix, so interleaved multi-rank
+output stays attributable:
+
+    [2026-08-03 14:02:11] r3 INFO Thread-2: feed: 120 MB to device
 """
 
 from __future__ import annotations
 
+import os
 import sys
-import time
 import threading
+import time
 from typing import Callable, Optional
 
 from .base import DMLCError
@@ -21,6 +30,7 @@ _LEVELS = {"DEBUG": 0, "INFO": 1, "WARNING": 2, "ERROR": 3, "FATAL": 4}
 _lock = threading.Lock()
 _sink: Optional[Callable[[str], None]] = None
 _verbosity = 1  # default: INFO and above
+_rank_prefix: Optional[str] = None  # lazy: env read once at first format
 
 
 def set_log_sink(sink: Optional[Callable[[str], None]]) -> None:
@@ -35,23 +45,46 @@ def set_verbosity(level: str) -> None:
     _verbosity = _LEVELS[level.upper()]
 
 
+def _get_rank_prefix() -> str:
+    """Rank tag from DMLC_TASK_ID / DMLC_RANK, resolved once — worker env
+    is fixed at launch, and the hot path must not hit os.environ per line."""
+    global _rank_prefix
+    if _rank_prefix is None:
+        rank = os.environ.get("DMLC_TASK_ID") or os.environ.get("DMLC_RANK")
+        _rank_prefix = f"r{rank} " if rank not in (None, "", "NULL") else ""
+    return _rank_prefix
+
+
+def _reset_rank_prefix_cache() -> None:
+    """Drop the cached rank prefix (test hook; workers never need this)."""
+    global _rank_prefix
+    _rank_prefix = None
+
+
 def _format(level: str, msg: str) -> str:
-    ts = time.strftime("%H:%M:%S")
-    return f"[{ts}] {level}: {msg}"
+    ts = time.strftime("%Y-%m-%d %H:%M:%S")
+    thread = threading.current_thread().name
+    return f"[{ts}] {_get_rank_prefix()}{level} {thread}: {msg}"
 
 
-def log(level: str, msg: str) -> None:
-    level = level.upper()
-    if level == "FATAL":
-        raise DMLCError(msg)
-    if _LEVELS[level] < _verbosity:
-        return
-    line = _format(level, msg)
+def _emit(line: str) -> None:
     with _lock:
         if _sink is not None:
             _sink(line)
         else:
             print(line, file=sys.stderr, flush=True)
+
+
+def log(level: str, msg: str) -> None:
+    level = level.upper()
+    if level != "FATAL" and _LEVELS[level] < _verbosity:
+        return
+    # FATAL always emits (glog semantics: FATAL cannot be suppressed) and
+    # emits BEFORE raising — a FATAL that only surfaced as an exception
+    # never reached the installed sink at all
+    _emit(_format(level, msg))
+    if level == "FATAL":
+        raise DMLCError(msg)
 
 
 def info(msg: str) -> None:
@@ -67,5 +100,6 @@ def error(msg: str) -> None:
 
 
 def fatal(msg: str) -> None:
-    """Raises DMLCError (DMLC_LOG_FATAL_THROW behavior, base.h:20-22)."""
-    raise DMLCError(msg)
+    """Logs the line, then raises DMLCError (DMLC_LOG_FATAL_THROW
+    behavior, base.h:20-22)."""
+    log("FATAL", msg)

@@ -1,81 +1,35 @@
-"""Structured per-stage metrics + JAX profiler hooks (SURVEY.md §5).
+"""Back-compatible shim over :mod:`dmlc_tpu.telemetry` (SURVEY.md §5).
 
-The reference had only ad-hoc "X MB/sec" prints (basic_row_iter.h:68-75);
-this module gives every pipeline stage named counters so feed-vs-step
-time is attributable:
+This module used to own the flat per-stage counters; the telemetry
+package subsumed it (histograms with percentiles, span tracing,
+exporters, cluster aggregation — see ``dmlc_tpu/telemetry/``).  Existing
+call sites (io/input_split.py, feed/device_feed.py,
+models/transformer.py, data/parser.py, bench.py, examples) keep
+working unchanged:
 
-    from dmlc_tpu import metrics
-    metrics.snapshot()
-    # {"input_split": {"bytes": ..., "chunks": ..., "records": ...},
-    #  "feed": {"batches": ..., "bytes_to_device": ...,
-    #           "producer_stall_secs": ..., "consumer_stall_secs": ...},
-    #  ...}
-
-Counters are process-global and thread-safe; increments are a dict add
-under a lock, so hot loops should batch increments (count locally, flush
-per chunk/epoch).  ``annotate(name)`` wraps jax.profiler.TraceAnnotation
-when JAX is importable (a no-op otherwise), letting feed batches and
-train steps show up as named spans in a profiler trace.
+  * ``inc`` / ``timed`` / ``annotate`` / ``trace`` delegate directly
+    (``timed`` additionally feeds a histogram now — free distributions
+    for every previously flat ``<name>_secs`` counter);
+  * ``snapshot()`` returns the legacy flat ``{stage: {name: value}}``
+    counter view (``telemetry.snapshot()`` has the structured one);
+  * ``reset()`` clears the whole telemetry registry (test isolation).
 """
 
 from __future__ import annotations
 
-import contextlib
-import threading
-import time
-from collections import defaultdict
 from typing import Dict
 
-_lock = threading.Lock()
-_counters: Dict[str, Dict[str, float]] = defaultdict(lambda: defaultdict(float))
+from . import telemetry
 
+__all__ = ["inc", "timed", "snapshot", "reset", "annotate", "trace"]
 
-def inc(stage: str, name: str, value: float = 1.0) -> None:
-    """Add ``value`` to counter ``name`` of ``stage``."""
-    with _lock:
-        _counters[stage][name] += value
-
-
-@contextlib.contextmanager
-def timed(stage: str, name: str):
-    """Time a block into ``<name>_secs`` of ``stage``."""
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        inc(stage, name + "_secs", time.perf_counter() - t0)
+inc = telemetry.inc
+timed = telemetry.timed
+annotate = telemetry.annotate
+trace = telemetry.trace
+reset = telemetry.reset
 
 
 def snapshot() -> Dict[str, Dict[str, float]]:
-    """Point-in-time copy of every stage's counters."""
-    with _lock:
-        return {stage: dict(vals) for stage, vals in _counters.items()}
-
-
-def reset() -> None:
-    with _lock:
-        _counters.clear()
-
-
-@contextlib.contextmanager
-def annotate(name: str):
-    """Named span in the JAX profiler trace (no-op without jax)."""
-    try:
-        from jax.profiler import TraceAnnotation
-    except Exception:  # pragma: no cover - jax always present in tests
-        yield
-        return
-    with TraceAnnotation(name):
-        yield
-
-
-@contextlib.contextmanager
-def trace(log_dir: str):
-    """Capture a jax.profiler trace around a block (e.g. a bench run)."""
-    import jax
-
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+    """Point-in-time copy of every stage's flat counters (legacy shape)."""
+    return telemetry.counters_snapshot()

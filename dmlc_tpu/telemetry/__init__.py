@@ -1,0 +1,79 @@
+"""dmlc_tpu.telemetry: spans, histograms, exporters, cluster aggregation.
+
+The observability subsystem (successor of the flat ``dmlc_tpu.metrics``
+counters, which remains as a compatible shim over this package):
+
+  * ``core``       counters / gauges / fixed-bucket histograms with
+                   p50/p90/p99 summaries, plus a nested thread-aware
+                   span tracer in a bounded ring buffer
+  * ``exporters``  Chrome trace-event JSON (Perfetto-loadable),
+                   Prometheus text exposition, JSON snapshot embedding
+  * ``heartbeat``  worker heartbeats over the rendezvous protocol,
+                   tracker-side aggregation, /metrics + /healthz HTTP,
+                   straggler flagging
+
+Typical use::
+
+    from dmlc_tpu import telemetry
+
+    with telemetry.span("train.step", stage="train"):
+        ...
+    telemetry.observe_duration("train", "step", dt)
+    telemetry.snapshot()["histograms"]["feed"]["producer_stall_secs"]["p90"]
+    open("trace.json", "w").write(telemetry.to_chrome_trace_json())
+"""
+
+from . import core, exporters, heartbeat  # noqa: F401
+from .core import (  # noqa: F401
+    DEFAULT_BOUNDS,
+    Histogram,
+    annotate,
+    counters_snapshot,
+    inc,
+    observe,
+    observe_duration,
+    reset,
+    set_gauge,
+    snapshot,
+    span,
+    spans,
+    timed,
+    trace,
+)
+from .exporters import (  # noqa: F401
+    export_json,
+    to_chrome_trace,
+    to_chrome_trace_json,
+    to_prometheus_text,
+)
+from .heartbeat import (  # noqa: F401
+    DEFAULT_STRAGGLER_KEYS,
+    HeartbeatSender,
+    TelemetryAggregator,
+    TelemetryHTTPServer,
+)
+
+__all__ = [
+    "DEFAULT_BOUNDS",
+    "DEFAULT_STRAGGLER_KEYS",
+    "Histogram",
+    "HeartbeatSender",
+    "TelemetryAggregator",
+    "TelemetryHTTPServer",
+    "annotate",
+    "counters_snapshot",
+    "export_json",
+    "inc",
+    "observe",
+    "observe_duration",
+    "reset",
+    "set_gauge",
+    "snapshot",
+    "span",
+    "spans",
+    "timed",
+    "to_chrome_trace",
+    "to_chrome_trace_json",
+    "to_prometheus_text",
+    "trace",
+]

@@ -1,0 +1,337 @@
+"""Telemetry core: thread-safe counters, gauges, histograms, and spans.
+
+Successor of the flat ``dmlc_tpu.metrics`` counters (which remains as a
+thin shim over this module).  The reference substrate's only visibility
+was ad-hoc "X MB/sec" prints (basic_row_iter.h:68-75); pod-scale runs
+need *distributions* (which rank is the straggler, what does the stall
+tail look like), so every ``timed`` block now feeds a fixed-bucket
+histogram with p50/p90/p99 summaries in addition to the flat
+``<name>_secs`` counter the old call sites read.
+
+Four primitives, all process-global and thread-safe:
+
+  * ``inc(stage, name, v)``        monotonic counters (dict add under a lock)
+  * ``set_gauge(stage, name, v)``  last-write-wins gauges
+  * ``observe(stage, name, v)``    fixed-bucket histograms (p50/p90/p99)
+  * ``span(name, stage=...)``      nested, thread-aware timed spans in a
+                                   bounded ring buffer (Chrome-trace
+                                   exportable; see telemetry.exporters)
+
+``timed`` records both the counter and the histogram under
+``<name>_secs``; ``annotate`` records a span AND bridges to
+``jax.profiler.TraceAnnotation`` when JAX is importable, so feed batches
+and train steps still show up in a real profiler trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+import os
+import threading
+import time
+from bisect import bisect_left
+from collections import defaultdict, deque
+from typing import Dict, List, Optional
+
+__all__ = [
+    "Histogram",
+    "DEFAULT_BOUNDS",
+    "inc",
+    "set_gauge",
+    "observe",
+    "observe_duration",
+    "timed",
+    "span",
+    "spans",
+    "annotate",
+    "trace",
+    "snapshot",
+    "counters_snapshot",
+    "reset",
+]
+
+# geometric bounds 1 µs .. ~134 s (doubling): one bucket set serves both
+# microsecond-scale parse latencies and multi-second checkpoint saves
+DEFAULT_BOUNDS = tuple(1e-6 * 2.0 ** i for i in range(28))
+
+# spans ring capacity; bounded so a week-long run cannot OOM the host
+_MAX_SPANS = int(os.environ.get("DMLC_TELEMETRY_MAX_SPANS", "8192"))
+
+
+class Histogram:
+    """Fixed-bucket histogram with percentile summaries.
+
+    Bucket ``i`` covers ``(bounds[i-1], bounds[i]]``; the final bucket is
+    the ``+Inf`` overflow — the same cumulative ``le`` semantics as a
+    Prometheus histogram, so export is a direct rendering.  Percentiles
+    interpolate linearly inside the bucket and clamp to the observed
+    min/max, which keeps p50 exact-ish even with coarse buckets.
+    Mutation is NOT internally locked: callers go through the
+    module-level functions, which hold the registry lock.
+    """
+
+    __slots__ = ("bounds", "counts", "total", "count", "vmin", "vmax")
+
+    def __init__(self, bounds=None):
+        self.bounds = tuple(bounds) if bounds is not None else DEFAULT_BOUNDS
+        self.counts = [0] * (len(self.bounds) + 1)
+        self.total = 0.0
+        self.count = 0
+        self.vmin = math.inf
+        self.vmax = -math.inf
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self.counts[bisect_left(self.bounds, v)] += 1
+        self.total += v
+        self.count += 1
+        if v < self.vmin:
+            self.vmin = v
+        if v > self.vmax:
+            self.vmax = v
+
+    def percentile(self, q: float) -> Optional[float]:
+        """q-th percentile (0-100) estimated from bucket counts."""
+        if self.count == 0:
+            return None
+        rank = q / 100.0 * self.count
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            if c and cum >= rank:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i] if i < len(self.bounds) else self.vmax
+                frac = (rank - (cum - c)) / c
+                val = lo + frac * (hi - lo)
+                return min(max(val, self.vmin), self.vmax)
+        return self.vmax
+
+    def summary(self, include_buckets: bool = True) -> Dict:
+        out = {
+            "count": self.count,
+            "sum": self.total,
+            "min": self.vmin if self.count else None,
+            "max": self.vmax if self.count else None,
+            "p50": self.percentile(50),
+            "p90": self.percentile(90),
+            "p99": self.percentile(99),
+        }
+        if include_buckets:
+            out["bounds"] = list(self.bounds)
+            out["buckets"] = list(self.counts)
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "Histogram":
+        """Rebuild from a ``summary(include_buckets=True)`` dict (the
+        heartbeat wire format), so aggregation can merge bucket counts.
+        Every field is coerced eagerly: garbage raises TypeError /
+        ValueError HERE, where wire-facing callers catch it, instead of
+        being stored and crashing a later summary()/merge()."""
+        bounds = d.get("bounds")
+        if bounds is not None:
+            bounds = tuple(float(b) for b in bounds)
+        h = cls(bounds)
+        buckets = d.get("buckets")
+        if buckets is not None and len(buckets) == len(h.counts):
+            h.counts = [int(c) for c in buckets]
+        h.count = int(d.get("count", 0))
+        h.total = float(d.get("sum", 0.0))
+        h.vmin = float(d["min"]) if d.get("min") is not None else math.inf
+        h.vmax = float(d["max"]) if d.get("max") is not None else -math.inf
+        return h
+
+    def merge(self, other: "Histogram") -> None:
+        """Accumulate ``other`` into self (cluster-wide aggregation).
+        Bucket counts merge only for identical bounds; count/sum/min/max
+        always merge."""
+        if other.bounds == self.bounds:
+            for i, c in enumerate(other.counts):
+                self.counts[i] += c
+        self.count += other.count
+        self.total += other.total
+        self.vmin = min(self.vmin, other.vmin)
+        self.vmax = max(self.vmax, other.vmax)
+
+
+# ---------------------------------------------------------------------------
+# process-global registry
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+_counters: Dict[str, Dict[str, float]] = defaultdict(lambda: defaultdict(float))
+_gauges: Dict[str, Dict[str, float]] = defaultdict(dict)
+_hists: Dict[str, Dict[str, Histogram]] = defaultdict(dict)
+_spans: deque = deque(maxlen=_MAX_SPANS)
+_T0 = time.perf_counter()  # session-relative span clock (µs in exports)
+_tls = threading.local()
+
+
+def inc(stage: str, name: str, value: float = 1.0) -> None:
+    """Add ``value`` to counter ``name`` of ``stage``."""
+    with _lock:
+        _counters[stage][name] += value
+
+
+def set_gauge(stage: str, name: str, value: float) -> None:
+    """Set gauge ``name`` of ``stage`` to ``value`` (last write wins)."""
+    with _lock:
+        _gauges[stage][name] = float(value)
+
+
+def observe(stage: str, name: str, value: float, bounds=None) -> None:
+    """Record ``value`` into the histogram ``name`` of ``stage``.  The
+    first observation fixes the bucket bounds."""
+    with _lock:
+        h = _hists[stage].get(name)
+        if h is None:
+            h = _hists[stage][name] = Histogram(bounds)
+        h.observe(value)
+
+
+def observe_duration(stage: str, name: str, secs: float) -> None:
+    """Duration convention: counter ``<name>_secs`` += secs (the flat
+    total old call sites read) plus a histogram observation under the
+    same key (the distribution new consumers read)."""
+    key = name + "_secs"
+    with _lock:
+        _counters[stage][key] += secs
+        h = _hists[stage].get(key)
+        if h is None:
+            h = _hists[stage][key] = Histogram()
+        h.observe(secs)
+
+
+@contextlib.contextmanager
+def timed(stage: str, name: str):
+    """Time a block into counter + histogram ``<name>_secs`` of ``stage``."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        observe_duration(stage, name, time.perf_counter() - t0)
+
+
+# ---------------------------------------------------------------------------
+# span tracer
+# ---------------------------------------------------------------------------
+
+def _span_stack() -> List[str]:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+@contextlib.contextmanager
+def span(name: str, stage: str = "dmlc", args: Optional[Dict] = None):
+    """Nested, thread-aware timed span recorded into the bounded ring.
+
+    Nesting is tracked per thread (a span opened inside another on the
+    same thread records ``depth`` = enclosing count); Perfetto nests by
+    ts/dur containment per tid, so exports render the tree directly.
+    """
+    stack = _span_stack()
+    stack.append(name)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        t1 = time.perf_counter()
+        stack.pop()
+        th = threading.current_thread()
+        rec = {
+            "name": name,
+            "cat": stage,
+            "ts": (t0 - _T0) * 1e6,
+            "dur": (t1 - t0) * 1e6,
+            "tid": th.ident,
+            "thread": th.name,
+            "depth": len(stack),
+        }
+        if args:
+            rec["args"] = dict(args)
+        with _lock:
+            _spans.append(rec)
+
+
+def spans() -> List[Dict]:
+    """Copy of the span ring, oldest first."""
+    with _lock:
+        return list(_spans)
+
+
+_ANNOTATION = False  # False = unresolved; None = jax unavailable
+
+
+def _trace_annotation():
+    global _ANNOTATION
+    if _ANNOTATION is False:
+        try:
+            from jax.profiler import TraceAnnotation
+            _ANNOTATION = TraceAnnotation
+        except Exception:  # pragma: no cover - jax present in tests
+            _ANNOTATION = None
+    return _ANNOTATION
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named span in BOTH our ring buffer and the JAX profiler trace
+    (the jax half is a no-op without jax)."""
+    ann = _trace_annotation()
+    with span(name, stage="annotate"):
+        if ann is None:
+            yield
+        else:
+            with ann(name):
+                yield
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a jax.profiler trace around a block (e.g. a bench run)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+# ---------------------------------------------------------------------------
+# snapshots
+# ---------------------------------------------------------------------------
+
+def counters_snapshot() -> Dict[str, Dict[str, float]]:
+    """Flat stage → name → value counter copy (the legacy
+    ``metrics.snapshot()`` shape)."""
+    with _lock:
+        return {stage: dict(vals) for stage, vals in _counters.items()}
+
+
+def snapshot(include_buckets: bool = True) -> Dict:
+    """Full structured snapshot: counters, gauges, and histogram
+    summaries with p50/p90/p99 (plus raw buckets for merging unless
+    ``include_buckets`` is False)."""
+    with _lock:
+        return {
+            "counters": {s: dict(v) for s, v in _counters.items()},
+            "gauges": {s: dict(v) for s, v in _gauges.items()},
+            "histograms": {
+                s: {n: h.summary(include_buckets) for n, h in hs.items()}
+                for s, hs in _hists.items()
+            },
+        }
+
+
+def reset() -> None:
+    """Clear every counter, gauge, histogram, and recorded span
+    (test isolation)."""
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _hists.clear()
+        _spans.clear()

@@ -1,0 +1,158 @@
+"""Telemetry exporters: Chrome trace-event JSON, Prometheus text, JSON.
+
+Three formats the ecosystem already reads:
+
+  * ``to_chrome_trace()``    — trace-event JSON; load the file straight
+    into Perfetto / chrome://tracing.  Spans become complete ("X")
+    events; thread names ship as metadata ("M") events so the timeline
+    is labeled per producer/consumer thread.
+  * ``to_prometheus_text()`` — text exposition format (0.0.4): counters,
+    gauges, and real histograms (cumulative ``_bucket{le=...}`` +
+    ``_sum`` + ``_count``), optionally labeled (e.g. ``rank="3"`` on the
+    tracker's aggregated surface).
+  * ``export_json()``        — the structured snapshot bench.py embeds
+    into its one-line BENCH output (buckets stripped by default to keep
+    the line small).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, List, Optional
+
+from . import core
+
+__all__ = [
+    "to_chrome_trace",
+    "to_chrome_trace_json",
+    "to_prometheus_text",
+    "export_json",
+]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_name(prefix: str, stage: str, name: str) -> str:
+    return _NAME_RE.sub("_", f"{prefix}_{stage}_{name}")
+
+
+def _fmt_labels(labels: Optional[Dict[str, str]], extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in (labels or {}).items()]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt_val(v: float) -> str:
+    return repr(float(v))
+
+
+def to_chrome_trace(span_list: Optional[List[Dict]] = None,
+                    pid: int = 0) -> Dict:
+    """Spans → Chrome trace-event dict ({"traceEvents": [...]})."""
+    recs = core.spans() if span_list is None else span_list
+    events: List[Dict] = []
+    seen_threads = {}
+    for r in recs:
+        if r["tid"] not in seen_threads:
+            seen_threads[r["tid"]] = r.get("thread", str(r["tid"]))
+        ev = {
+            "name": r["name"],
+            "cat": r.get("cat", "dmlc"),
+            "ph": "X",
+            "ts": round(r["ts"], 3),
+            "dur": round(r["dur"], 3),
+            "pid": pid,
+            "tid": r["tid"],
+        }
+        if "args" in r:
+            ev["args"] = r["args"]
+        events.append(ev)
+    meta = [
+        {"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+         "args": {"name": tname}}
+        for tid, tname in seen_threads.items()
+    ]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def to_chrome_trace_json(span_list: Optional[List[Dict]] = None) -> str:
+    return json.dumps(to_chrome_trace(span_list))
+
+
+def _render_histogram(lines: List[str], mname: str, summ: Dict,
+                      labels: Optional[Dict[str, str]]) -> None:
+    bounds = summ.get("bounds")
+    buckets = summ.get("buckets")
+    if bounds and buckets:
+        cum = 0
+        for bound, c in zip(bounds, buckets[:-1]):
+            cum += c
+            le = 'le="' + repr(float(bound)) + '"'
+            lines.append(f"{mname}_bucket{_fmt_labels(labels, le)} {cum}")
+        inf = 'le="+Inf"'
+        lines.append(
+            f"{mname}_bucket{_fmt_labels(labels, inf)} {summ['count']}")
+    lines.append(f"{mname}_sum{_fmt_labels(labels)} {_fmt_val(summ['sum'])}")
+    lines.append(f"{mname}_count{_fmt_labels(labels)} {summ['count']}")
+
+
+def to_prometheus_text(snap: Optional[Dict] = None, prefix: str = "dmlc",
+                       labels: Optional[Dict[str, str]] = None,
+                       emit_type_lines: bool = True) -> str:
+    """Snapshot → Prometheus text exposition format.
+
+    ``snap`` defaults to the live registry (with buckets).  ``labels``
+    are attached to every sample — the tracker's aggregated surface uses
+    ``{"rank": "<r>"}`` per worker.  ``emit_type_lines=False`` skips the
+    ``# TYPE`` headers so multiple per-rank renderings of the same
+    metric family can be concatenated into one valid payload.
+    """
+    if snap is None:
+        snap = core.snapshot(include_buckets=True)
+    lines: List[str] = []
+    # durations recorded via timed() exist as BOTH a flat counter and a
+    # histogram under the same key; emitting both would declare one
+    # family name twice (invalid exposition) — the histogram's _sum
+    # already carries the flat total, so the counter is skipped
+    hist_keys = {(stage, name)
+                 for stage, hs in snap.get("histograms", {}).items()
+                 for name in hs}
+    for stage, vals in sorted(snap.get("counters", {}).items()):
+        for name, v in sorted(vals.items()):
+            if (stage, name) in hist_keys:
+                continue
+            mname = _metric_name(prefix, stage, name)
+            if emit_type_lines:
+                lines.append(f"# TYPE {mname} counter")
+            lines.append(f"{mname}{_fmt_labels(labels)} {_fmt_val(v)}")
+    for stage, vals in sorted(snap.get("gauges", {}).items()):
+        for name, v in sorted(vals.items()):
+            mname = _metric_name(prefix, stage, name)
+            if emit_type_lines:
+                lines.append(f"# TYPE {mname} gauge")
+            lines.append(f"{mname}{_fmt_labels(labels)} {_fmt_val(v)}")
+    for stage, hists in sorted(snap.get("histograms", {}).items()):
+        for name, summ in sorted(hists.items()):
+            mname = _metric_name(prefix, stage, name)
+            if emit_type_lines:
+                lines.append(f"# TYPE {mname} histogram")
+            _render_histogram(lines, mname, summ, labels)
+    return "\n".join(lines) + "\n"
+
+
+def export_json(include_buckets: bool = False,
+                include_spans: bool = False) -> Dict:
+    """Structured snapshot for embedding (BENCH artifacts, heartbeats).
+
+    Heartbeats set ``include_buckets=True`` so the tracker can merge
+    bucket counts across ranks; bench embedding keeps the default to
+    stay a compact one-line JSON.
+    """
+    out = core.snapshot(include_buckets=include_buckets)
+    if include_spans:
+        out["spans"] = core.spans()
+    else:
+        out["n_spans"] = len(core.spans())
+    return out

@@ -1,0 +1,342 @@
+"""Cluster telemetry: worker heartbeats → tracker aggregation → /metrics.
+
+Workers push periodic snapshots over the existing rendezvous connection
+protocol (a ``metrics`` command session, the same short-session shape as
+the tracker's ``print`` relay); the ``RabitTracker`` keeps the latest
+snapshot per rank and serves a merged cluster view over a lightweight
+HTTP endpoint:
+
+    GET /metrics   Prometheus text: per-rank samples (``rank`` label)
+                   plus cluster-merged families (``rank="all"``)
+    GET /healthz   JSON: rank count, per-rank heartbeat age
+
+Straggler flagging: for the configured histogram keys (feed stalls,
+step time by default), a rank whose p90 exceeds a configurable multiple
+of the cluster median is reported through ``logging.warning`` — once
+per (rank, key) until the rank stops being a straggler.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from . import exporters
+from .core import Histogram
+
+__all__ = [
+    "DEFAULT_STRAGGLER_KEYS",
+    "TelemetryAggregator",
+    "TelemetryHTTPServer",
+    "HeartbeatSender",
+]
+
+logger = logging.getLogger("dmlc_tpu.tracker")
+
+# (stage, histogram name) pairs checked for stragglers: a rank slow to
+# FEED shows an inflated producer-side pipeline; a rank slow to STEP
+# shows inflated consumer stall on its peers and step time on itself
+DEFAULT_STRAGGLER_KEYS: Tuple[Tuple[str, str], ...] = (
+    ("feed", "producer_stall_secs"),
+    ("feed", "consumer_stall_secs"),
+    ("input_split", "chunk_latency_secs"),
+    ("train", "step_secs"),
+)
+
+
+def _sanitize(snap: Dict) -> Dict:
+    """Shape-validate an incoming heartbeat: keep only well-formed
+    counters/gauges (stage → name → number) and histogram summaries
+    (stage → name → dict).  Everything else is dropped, so a skewed or
+    hostile worker can never park a snapshot that later crashes
+    merged()/check_stragglers()/prometheus_text() on other threads."""
+    out: Dict = {"counters": {}, "gauges": {}, "histograms": {}}
+    for kind in ("counters", "gauges"):
+        src = snap.get(kind)
+        if not isinstance(src, dict):
+            continue
+        for stage, vals in src.items():
+            if not isinstance(vals, dict):
+                continue
+            clean = {}
+            for name, v in vals.items():
+                try:
+                    clean[str(name)] = float(v)
+                except (TypeError, ValueError):
+                    continue
+            if clean:
+                out[kind][str(stage)] = clean
+    src = snap.get("histograms")
+    if isinstance(src, dict):
+        for stage, hs in src.items():
+            if not isinstance(hs, dict):
+                continue
+            clean = {}
+            for name, summ in hs.items():
+                if not isinstance(summ, dict):
+                    continue
+                try:
+                    # canonicalize through a Histogram round-trip: the
+                    # stored summary is then ALWAYS a complete, numeric
+                    # summary() dict, whatever the wire carried
+                    clean[str(name)] = Histogram.from_dict(summ).summary()
+                except (TypeError, ValueError, KeyError):
+                    continue
+            if clean:
+                out["histograms"][str(stage)] = clean
+    return out
+
+
+def _median(vals: List[float]) -> float:
+    """Lower median: with an even rank count the smaller middle element
+    is the baseline, so an inflated rank cannot drag the comparison
+    point up and mask itself (the n=2 degenerate case: averaging the
+    two would put the straggler at ~2x its own median forever)."""
+    s = sorted(vals)
+    return s[(len(s) - 1) // 2]
+
+
+class TelemetryAggregator:
+    """Per-rank snapshot store with merge + straggler detection."""
+
+    def __init__(self, straggler_factor: float = 3.0,
+                 straggler_keys=DEFAULT_STRAGGLER_KEYS,
+                 log=logger):
+        self.straggler_factor = float(straggler_factor)
+        self.straggler_keys = tuple(straggler_keys)
+        self._log = log
+        self._lock = threading.Lock()
+        self._ranks: Dict[int, Dict] = {}      # rank -> snapshot dict
+        self._seen: Dict[int, float] = {}      # rank -> last heartbeat time
+        self._flagged: set = set()             # (rank, stage, name) warned
+
+    # ---- ingest ---------------------------------------------------------
+    def update(self, rank: int, snap: Dict) -> None:
+        if rank < 0:
+            return  # heartbeat from an unassigned worker: nothing to key on
+        with self._lock:
+            self._ranks[rank] = _sanitize(snap)
+            self._seen[rank] = time.time()
+        for w in self.check_stragglers():
+            self._log.warning("%s", w)
+
+    def update_json(self, rank: int, payload: str) -> None:
+        """Parse-and-ingest; malformed heartbeats are dropped with a
+        warning rather than poisoning the tracker accept loop — a worker
+        on a skewed version (or garbage on the open tracker port) must
+        never be able to kill the rendezvous thread."""
+        try:
+            snap = json.loads(payload)
+            if not isinstance(snap, dict):
+                raise TypeError(f"non-dict telemetry ({type(snap).__name__})")
+            self.update(rank, snap)
+        except Exception as e:  # noqa: BLE001 - see docstring
+            self._log.warning("rank %d sent malformed telemetry: %r", rank, e)
+
+    # ---- views ----------------------------------------------------------
+    def ranks(self) -> Dict[int, float]:
+        """rank → heartbeat age in seconds."""
+        now = time.time()
+        with self._lock:
+            return {r: now - t for r, t in self._seen.items()}
+
+    def merged(self) -> Dict:
+        """Cluster-wide snapshot: counters/gauges summed, histogram
+        buckets merged (percentiles recomputed over the merged counts)."""
+        with self._lock:
+            snaps = dict(self._ranks)
+        counters: Dict[str, Dict[str, float]] = {}
+        gauges: Dict[str, Dict[str, float]] = {}
+        hists: Dict[str, Dict[str, Histogram]] = {}
+        for snap in snaps.values():
+            for stage, vals in snap.get("counters", {}).items():
+                dst = counters.setdefault(stage, {})
+                for name, v in vals.items():
+                    dst[name] = dst.get(name, 0.0) + float(v)
+            for stage, vals in snap.get("gauges", {}).items():
+                dst = gauges.setdefault(stage, {})
+                for name, v in vals.items():
+                    dst[name] = dst.get(name, 0.0) + float(v)
+            for stage, hs in snap.get("histograms", {}).items():
+                dsth = hists.setdefault(stage, {})
+                for name, summ in hs.items():
+                    try:
+                        h = Histogram.from_dict(summ)
+                    except (TypeError, ValueError, KeyError):
+                        continue  # malformed summary: skip, don't crash
+                    if name in dsth:
+                        dsth[name].merge(h)
+                    else:
+                        dsth[name] = h
+        return {
+            "counters": counters,
+            "gauges": gauges,
+            "histograms": {
+                s: {n: h.summary() for n, h in hs.items()}
+                for s, hs in hists.items()
+            },
+        }
+
+    def prometheus_text(self) -> str:
+        """Per-rank samples (rank label) + merged families (rank="all")."""
+        with self._lock:
+            snaps = dict(self._ranks)
+        parts = [
+            exporters.to_prometheus_text(
+                snap, labels={"rank": str(r)}, emit_type_lines=(i == 0))
+            for i, (r, snap) in enumerate(sorted(snaps.items()))
+        ]
+        parts.append(exporters.to_prometheus_text(
+            self.merged(), labels={"rank": "all"},
+            emit_type_lines=not parts))
+        n = len(snaps)
+        parts.append(f"dmlc_tracker_ranks_reporting {n}\n")
+        return "".join(parts)
+
+    def healthz(self) -> Dict:
+        ages = self.ranks()
+        with self._lock:  # _flagged mutates on the tracker accept thread
+            flagged = sorted({r for (r, _s, _n) in self._flagged})
+        return {
+            "status": "ok",
+            "ranks_reporting": len(ages),
+            "ranks": {str(r): round(age, 3) for r, age in sorted(ages.items())},
+            "stragglers": flagged,
+        }
+
+    # ---- straggler detection -------------------------------------------
+    def check_stragglers(self) -> List[str]:
+        """Compare each rank's p90 against the cluster median for the
+        configured keys; returns (and records) fresh warnings."""
+        with self._lock:
+            snaps = dict(self._ranks)
+        warnings: List[str] = []
+        if len(snaps) < 2:
+            return warnings
+        for stage, name in self.straggler_keys:
+            p90s = {}
+            for rank, snap in snaps.items():
+                summ = snap.get("histograms", {}).get(stage, {}).get(name)
+                try:
+                    if summ and summ.get("p90") is not None:
+                        p90s[rank] = float(summ["p90"])
+                except (TypeError, ValueError):
+                    continue  # malformed summary: rank just has no data
+            if len(p90s) < 2:
+                continue
+            med = _median(list(p90s.values()))
+            if med <= 0:
+                continue
+            for rank, p90 in p90s.items():
+                key = (rank, stage, name)
+                with self._lock:  # healthz() reads _flagged concurrently
+                    if p90 > self.straggler_factor * med:
+                        fresh = key not in self._flagged
+                        self._flagged.add(key)
+                    else:
+                        fresh = False
+                        self._flagged.discard(key)
+                if fresh:
+                    warnings.append(
+                        f"straggler: rank {rank} {stage}.{name} "
+                        f"p90={p90:.4f}s vs cluster median {med:.4f}s "
+                        f"(>{self.straggler_factor:g}x)")
+        return warnings
+
+
+class TelemetryHTTPServer:
+    """Lightweight /metrics + /healthz HTTP surface over an aggregator."""
+
+    def __init__(self, aggregator: TelemetryAggregator,
+                 host: str = "127.0.0.1", port: int = 0):
+        agg = aggregator
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, ctype: str, body: bytes) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 - http.server API
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    self._send(200,
+                               "text/plain; version=0.0.4; charset=utf-8",
+                               agg.prometheus_text().encode())
+                elif path == "/healthz":
+                    self._send(200, "application/json",
+                               json.dumps(agg.healthz()).encode())
+                else:
+                    self._send(404, "text/plain", b"not found\n")
+
+            def log_message(self, fmt, *args):  # quiet: scrapes are periodic
+                logger.debug("telemetry http: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="telemetry-http")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+class HeartbeatSender:
+    """Worker-side periodic telemetry push over the tracker protocol.
+
+    Each beat opens a short ``metrics`` session (same shape as the
+    ``print`` relay) carrying the full local snapshot with histogram
+    buckets, so the tracker can merge distributions across ranks.
+    ``close()`` sends one final beat so short jobs still report.
+    """
+
+    def __init__(self, client, interval: float = 5.0,
+                 auto_start: bool = True):
+        self._client = client
+        self.interval = float(interval)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if auto_start:
+            self.start()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="telemetry-heartbeat")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.send_once()
+            except OSError as e:  # tracker gone mid-shutdown: stop quietly
+                logger.debug("heartbeat send failed: %s", e)
+                return
+
+    def send_once(self) -> None:
+        payload = json.dumps(
+            exporters.export_json(include_buckets=True))
+        self._client.send_metrics(payload)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        try:
+            self.send_once()  # final flush so short jobs report at all
+        except OSError as e:
+            logger.debug("final heartbeat failed: %s", e)

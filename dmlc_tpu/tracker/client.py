@@ -125,6 +125,15 @@ class TrackerClient:
         fs.send_str(msg)
         fs.close()
 
+    def send_metrics(self, payload: str) -> None:
+        """Push a telemetry heartbeat (JSON snapshot) to the tracker's
+        aggregator over a short ``metrics`` session — same session shape
+        as the ``print`` relay.  See telemetry.heartbeat.HeartbeatSender
+        for the periodic-push wrapper."""
+        fs = self._session("metrics", self.rank, -1)
+        fs.send_str(payload)
+        fs.close()
+
     def shutdown(self) -> None:
         fs = self._session("shutdown", self.rank, -1)
         fs.close()

@@ -166,10 +166,20 @@ class WorkerEntry:
 
 
 class RabitTracker:
-    """Rendezvous server; one thread accepts workers until all shut down."""
+    """Rendezvous server; one thread accepts workers until all shut down.
+
+    Beyond rendezvous, the tracker is the cluster's telemetry sink:
+    workers push periodic heartbeats (``metrics`` command sessions, same
+    shape as the ``print`` relay) into a :class:`TelemetryAggregator`,
+    and ``metrics_port`` (or ``DMLC_TRACKER_METRICS_PORT``; 0 =
+    ephemeral) serves the merged view over HTTP ``/metrics``
+    (Prometheus text) + ``/healthz``, with straggler ranks flagged via
+    ``logging.warning``.
+    """
 
     def __init__(self, host_ip: str, n_workers: int,
-                 port: int = 9091, port_end: int = 9999):
+                 port: int = 9091, port_end: int = 9999,
+                 metrics_port: Optional[int] = None):
         family = socket.getaddrinfo(host_ip, None)[0][0]
         sock = socket.socket(family, socket.SOCK_STREAM)
         for p in range(port, port_end):
@@ -188,6 +198,22 @@ class RabitTracker:
         self.thread: Optional[threading.Thread] = None
         self.start_time: Optional[float] = None
         self.end_time: Optional[float] = None
+        from ..telemetry import TelemetryAggregator
+
+        self.telemetry = TelemetryAggregator(log=logger)
+        self.metrics_server = None
+        self.metrics_port: Optional[int] = None
+        if metrics_port is None:
+            env = os.environ.get("DMLC_TRACKER_METRICS_PORT")
+            metrics_port = int(env) if env else None
+        if metrics_port is not None:
+            from ..telemetry import TelemetryHTTPServer
+
+            self.metrics_server = TelemetryHTTPServer(
+                self.telemetry, host=host_ip, port=metrics_port)
+            self.metrics_port = self.metrics_server.port
+            logger.info("tracker /metrics on %s:%d", host_ip,
+                        self.metrics_port)
         logger.info("tracker listening on %s:%d", host_ip, self.port)
 
     def worker_envs(self) -> Dict[str, str]:
@@ -235,6 +261,11 @@ class RabitTracker:
                 w = WorkerEntry(fd, addr)
                 if w.cmd == "print":
                     logger.info("%s", w.sock.recv_str().strip())
+                    continue
+                if w.cmd == "metrics":
+                    # telemetry heartbeat: latest snapshot for this rank
+                    # (short session, like print; never fails the job)
+                    self.telemetry.update_json(w.rank, w.sock.recv_str())
                     continue
             except (OSError, UnicodeDecodeError) as e:
                 # pre-registration garbage (port scans, torn handshakes,
@@ -334,6 +365,9 @@ class RabitTracker:
             self.sock.close()
         except OSError:
             pass
+        if self.metrics_server is not None:
+            self.metrics_server.close()
+            self.metrics_server = None
 
 
 def free_port(host_ip: str = "127.0.0.1") -> int:

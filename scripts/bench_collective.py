@@ -73,18 +73,27 @@ def loopback_line_rate(nbytes=256 << 20):
 
 
 def main():
+    from dmlc_tpu import telemetry
+
     world = int(sys.argv[1]) if len(sys.argv) > 1 else 8
     with tempfile.TemporaryDirectory() as work:
-        exe = build(work)
-        r = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bin", "dmlc-submit"),
-             "--cluster", "local", "--num-workers", str(world), "--",
-             exe, "bench"],
-            capture_output=True, text=True, timeout=600)
+        with telemetry.span("collective.build", stage="bench"), \
+                telemetry.timed("collective_bench", "build"):
+            exe = build(work)
+        with telemetry.span("collective.run", stage="bench",
+                            args={"world": world}), \
+                telemetry.timed("collective_bench", "run"):
+            r = subprocess.run(
+                [sys.executable, os.path.join(REPO, "bin", "dmlc-submit"),
+                 "--cluster", "local", "--num-workers", str(world), "--",
+                 exe, "bench"],
+                capture_output=True, text=True, timeout=600)
         assert r.returncode == 0, r.stderr[-2000:]
         results = [json.loads(line) for line in r.stdout.splitlines()
                    if line.startswith("{")]
-    line_rate = loopback_line_rate()
+    with telemetry.span("collective.loopback_probe", stage="bench"), \
+            telemetry.timed("collective_bench", "loopback_probe"):
+        line_rate = loopback_line_rate()
     big = next((x for x in results
                 if x["op"] == "allreduce" and x["bytes"] == 64 << 20), None)
     out = {
@@ -98,6 +107,8 @@ def main():
             round(big["busbw_MBps"] / line_rate, 3) if big else None,
         "allreduce_64MB_link_vs_loopback":
             round(big["aggregate_link_MBps"] / line_rate, 3) if big else None,
+        # harness-phase wall-time attribution (build vs run vs probe)
+        "telemetry": telemetry.export_json(),
     }
     path = os.path.join(REPO, "BENCH_collective.json")
     with open(path, "w") as f:

@@ -14,6 +14,9 @@
 #      full correctness suite (shm transport + TCP fallback) under the
 #      real launcher, leak detection on — the shm/KV code is the one
 #      native surface with nontrivial object lifecycle
+#   6. telemetry smoke: 2-worker local rendezvous pushing heartbeats,
+#      tracker /metrics scraped + validated as Prometheus text, Chrome
+#      trace export validated as JSON with >= 1 complete event
 #
 # Usage: scripts/ci.sh [pytest-args...]
 set -u
@@ -117,4 +120,8 @@ if command -v g++ >/dev/null 2>&1 && command -v gcc >/dev/null 2>&1; then
     fi
 fi
 
-echo "== CI OK (native=$NATIVE_OK tsan=$TSAN_OK asan=$ASAN_OK) =="
+echo "== stage 6: telemetry smoke (rendezvous heartbeats + /metrics) =="
+timeout -k 10 180 python scripts/telemetry_smoke.py \
+    || { echo "FAIL: telemetry smoke"; exit 1; }
+
+echo "== CI OK (native=$NATIVE_OK tsan=$TSAN_OK asan=$ASAN_OK telemetry=1) =="

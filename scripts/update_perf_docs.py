@@ -86,6 +86,7 @@ def fmt_bench_lines(bench, coll):
                      "this tunnel compresses, so the padded zeros travel "
                      "nearly free here.")
         lines.append(feed)
+    lines += fmt_telemetry_lines(bench.get("telemetry"))
     big = next((r for r in coll["results"]
                 if r["op"] == "allreduce" and r["bytes"] == 64 << 20), None)
     mid = next((r for r in coll["results"]
@@ -103,6 +104,34 @@ def fmt_bench_lines(bench, coll):
             f"({coll['loopback_MBps'] / 1e3:.1f} GB/s) that the tuned "
             f"tree/ring TCP fallback (cross-host links) is bounded by.")
     return lines
+
+
+def _fmt_secs(v):
+    return f"{v * 1e3:.1f} ms" if v < 1 else f"{v:.2f} s"
+
+
+def fmt_telemetry_lines(tele):
+    """Stall/latency distribution line from the bench's embedded
+    telemetry snapshot (absent in pre-telemetry artifacts)."""
+    if not tele:
+        return []
+    hists = tele.get("histograms", {})
+    parts = []
+    for stage, name, label in (
+            ("feed", "producer_stall_secs", "feed producer stall"),
+            ("feed", "consumer_stall_secs", "feed consumer stall"),
+            ("input_split", "chunk_latency_secs", "chunk load"),
+    ):
+        s = hists.get(stage, {}).get(name)
+        if s and s.get("p50") is not None:
+            parts.append(
+                f"{label} p50/p90/p99 = {_fmt_secs(s['p50'])} / "
+                f"{_fmt_secs(s['p90'])} / {_fmt_secs(s['p99'])} "
+                f"(n={s['count']})")
+    if not parts:
+        return []
+    return ["- Telemetry distributions over the bench run: "
+            + "; ".join(parts) + "."]
 
 
 MARK = re.compile(r"<!-- perf:auto -->.*?<!-- /perf:auto -->", re.S)
