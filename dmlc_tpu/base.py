@@ -37,11 +37,16 @@ class DMLCError(RuntimeError):
     ``status`` carries a machine-readable code (e.g. an HTTP status) so
     callers can dispatch on it instead of matching message text — the
     filesystem backends use this to map 404s to FileNotFoundError.
+    ``transient`` marks retry-worthy conditions for
+    ``resilience.RetryPolicy`` classification (None = derive from
+    ``status``; the GCS backend's ``GCSError`` sets it explicitly).
     """
 
-    def __init__(self, *args, status: Optional[int] = None):
+    def __init__(self, *args, status: Optional[int] = None,
+                 transient: Optional[bool] = None):
         super().__init__(*args)
         self.status = status
+        self.transient = transient
 
 
 class ParamError(ValueError, DMLCError):

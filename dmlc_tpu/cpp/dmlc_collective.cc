@@ -603,7 +603,11 @@ bool shm_wait_all(DmlcComm* c, ShmField f, long target) {
                                            : ct->cons;
     int spins = 0;
     while (a.load(std::memory_order_acquire) < target) {
-      if (++spins > 256) {
+      // stop counting at the threshold: a multi-minute stall would
+      // otherwise push the counter past INT_MAX (signed-overflow UB)
+      // and silence the deadline check until it wrapped positive again
+      if (spins <= 256) ++spins;
+      if (spins > 256) {
         sched_yield();  // gangs share cores; never busy-burn a slice
         if (now_seconds() > deadline) {
           c->error = "shm collective timed out waiting on rank " +
@@ -1124,16 +1128,33 @@ int kv_run_server(DmlcKV* kv) {
       if (state[pfds[i].fd] == 0) state[pfds[i].fd] = 1;  // a worker
       if (op == 1) {  // PUSH
         int32_t key, n;
-        if (!f.recv_int(&key) || !f.recv_int(&n) || n < 0 || n > max_n)
-          return -1;
+        // a recv failure mid-message is a worker death between frames
+        // (same as a death at an op boundary): drop the connection and
+        // keep serving — it counts toward the termination quorum via
+        // drop_conn, instead of killing the whole server with -1 and
+        // an empty kv->error
+        if (!f.recv_int(&key) || !f.recv_int(&n)) {
+          drop_conn(pfds[i].fd);
+          continue;
+        }
+        if (n < 0 || n > max_n) {  // a LIVE peer speaking garbage:
+          kv->error = "server: PUSH length " + std::to_string(n) +
+                      " out of bounds";
+          return -1;  // protocol violation, not a death — fail loudly
+        }
         std::vector<double> val(static_cast<size_t>(n));
-        if (!f.recv_all(val.data(), sizeof(double) * val.size()))
-          return -1;
+        if (!f.recv_all(val.data(), sizeof(double) * val.size())) {
+          drop_conn(pfds[i].fd);
+          continue;
+        }
         auto& acc = store[key];
         if (acc.size() < val.size()) acc.resize(val.size(), 0.0);
         for (size_t j = 0; j < val.size(); ++j) acc[j] += val[j];
         ++pushes[key];
-        if (!f.send_int(0)) return -1;
+        if (!f.send_int(0)) {  // ack undeliverable: worker died post-PUSH
+          drop_conn(pfds[i].fd);
+          continue;
+        }
         // wake deferred pulls on this key; a wake hitting a dead
         // worker's socket drops that worker, not the server.  Restart
         // the scan after each wake: drop_conn may erase OTHER entries
@@ -1154,9 +1175,15 @@ int kv_run_server(DmlcKV* kv) {
         }
       } else if (op == 2) {  // PULL
         int32_t key, n, minp;
-        if (!f.recv_int(&key) || !f.recv_int(&n) || !f.recv_int(&minp) ||
-            n < 0 || n > max_n)
+        if (!f.recv_int(&key) || !f.recv_int(&n) || !f.recv_int(&minp)) {
+          drop_conn(pfds[i].fd);  // torn frame = death, not a server bug
+          continue;
+        }
+        if (n < 0 || n > max_n) {
+          kv->error = "server: PULL length " + std::to_string(n) +
+                      " out of bounds";
           return -1;
+        }
         if (minp > 0 && pushes[key] < minp) {
           pending.push_back({pfds[i].fd, key, n, minp});
         } else if (!reply_pull(pfds[i].fd, key, n)) {
@@ -1164,8 +1191,8 @@ int kv_run_server(DmlcKV* kv) {
         }
       } else if (op == 3) {  // FIN
         ++fins;
-        state[pfds[i].fd] = 2;
-        if (!f.send_int(0)) return -1;
+        state[pfds[i].fd] = 2;  // post-FIN: drop_conn won't double-count
+        if (!f.send_int(0)) drop_conn(pfds[i].fd);
       } else {
         kv->error = "server: unknown op " + std::to_string(op);
         return -1;

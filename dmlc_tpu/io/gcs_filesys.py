@@ -25,6 +25,8 @@ import urllib.request
 from typing import Callable, List, Optional
 
 from ..base import DMLCError, check
+from ..resilience import RetryPolicy, fault_point
+from ..resilience.retry import TRANSIENT_HTTP
 from .filesys import FileInfo, FileSystem
 from .http_filesys import HttpReadStream
 from .stream import SeekStream, Stream
@@ -69,28 +71,32 @@ class GCSError(DMLCError):
         return self.status
 
 
-_TRANSIENT_HTTP = {408, 429, 500, 502, 503, 504}
+_TRANSIENT_HTTP = TRANSIENT_HTTP
 
 
-def _retry_policy():
-    return (int(os.environ.get("DMLC_GCS_RETRIES", "5")),
-            float(os.environ.get("DMLC_GCS_RETRY_BASE_S", "0.25")))
+def _policy(retry: bool = True) -> RetryPolicy:
+    """The GCS retry policy (resilience.RetryPolicy over the historical
+    DMLC_GCS_RETRIES / DMLC_GCS_RETRY_BASE_S knobs).  ``retry=False``
+    yields a single-attempt policy for NON-idempotent requests
+    (resumable chunk PUTs) whose callers recover through the 308
+    committed-range query instead — blindly resending a chunk after a
+    connection error could double-commit bytes."""
+    if not retry:
+        return RetryPolicy(attempts=1, name="gcs")
+    return RetryPolicy.from_env(retries_env="DMLC_GCS_RETRIES",
+                                default_attempts=5,
+                                base_env="DMLC_GCS_RETRY_BASE_S",
+                                name="gcs")
 
 
 def _api(url: str, *, method: str = "GET", data: Optional[bytes] = None,
          headers: Optional[dict] = None, ok=(200,), retry: bool = True):
     """One API call with exponential-backoff retry on 5xx/429/timeouts
-    (the reference's S3 retry-on-disconnect role, s3_filesys.cc:295-446).
+    (the reference's S3 retry-on-disconnect role, s3_filesys.cc:295-446)."""
+    short_url = url.split("?")[0]
 
-    ``retry=False`` disables in-call retries for NON-idempotent requests
-    (resumable chunk PUTs) whose callers recover through the 308
-    committed-range query instead — blindly resending a chunk after a
-    connection error could double-commit bytes."""
-    import time
-
-    attempts, base = _retry_policy() if retry else (1, 0.0)
-    last = "no attempts"
-    for i in range(attempts):
+    def attempt():
+        fault_point("gcs.request", method=method, url=short_url)
         req = urllib.request.Request(url, data=data, method=method,
                                      headers={**_auth_headers(),
                                               **(headers or {})})
@@ -99,25 +105,17 @@ def _api(url: str, *, method: str = "GET", data: Optional[bytes] = None,
         except urllib.error.HTTPError as e:
             if e.code in ok:
                 return e  # e.g. 308 resume-incomplete is a valid answer
-            if e.code in _TRANSIENT_HTTP and i + 1 < attempts:
-                last = f"HTTP {e.code}"
-                time.sleep(base * (2 ** i))
-                continue
             raise GCSError(
-                f"GCS {method} {url.split('?')[0]} failed: HTTP {e.code} "
+                f"GCS {method} {short_url} failed: HTTP {e.code} "
                 f"{e.read()[:200]!r}", code=e.code,
                 transient=e.code in _TRANSIENT_HTTP) from e
         except urllib.error.URLError as e:  # DNS, refused, timeouts
-            if i + 1 < attempts:
-                last = str(e.reason)
-                time.sleep(base * (2 ** i))
-                continue
-            raise GCSError(f"GCS {method} {url.split('?')[0]} failed: "
+            raise GCSError(f"GCS {method} {short_url} failed: "
                            f"{e.reason}", transient=True) from e
         check(resp.status in ok, f"GCS {method}: unexpected HTTP {resp.status}")
         return resp
-    raise GCSError(f"GCS {method} {url.split('?')[0]} failed after "
-                   f"{attempts} attempts: {last}", transient=True)
+
+    return _policy(retry).call(attempt)
 
 
 class GCSWriteStream(Stream):
@@ -170,12 +168,12 @@ class GCSWriteStream(Stream):
     def _put_range(self, body: bytes, total_str: str, ok) -> None:
         """PUT with interrupted-chunk recovery: on a transient failure,
         ask the session how much it committed (308 + Range) and resend
-        only the remainder — never double-commits, never loses bytes."""
-        import time
-
-        attempts, base = _retry_policy()
+        only the remainder — never double-commits, never loses bytes.
+        Keeps its own loop (the recovery probe runs BETWEEN attempts)
+        but shares the RetryPolicy backoff/classification/counters."""
+        policy = _policy()
         start = self._offset
-        for i in range(attempts):
+        for i in range(policy.attempts):
             if body:
                 crange = f"bytes {start}-{start + len(body) - 1}/{total_str}"
             else:
@@ -186,9 +184,9 @@ class GCSWriteStream(Stream):
                 self._offset = start + len(body)
                 return
             except GCSError as e:
-                if not e.transient or i + 1 >= attempts:
+                if not policy.is_retryable(e) or i + 1 >= policy.attempts:
                     raise
-                time.sleep(base * (2 ** i))
+                policy.sleep_for(i, error=e)
                 committed = self._query_committed()
                 if committed is None:  # finalized under us (final PUT)
                     self._offset = start + len(body)

@@ -45,6 +45,7 @@ import urllib.request
 from typing import List, Optional
 
 from ..base import DMLCError, check
+from ..resilience import RetryPolicy, fault_point
 from .filesys import FileInfo, FileSystem
 from .http_filesys import HttpReadStream
 from .stream import SeekStream, Stream
@@ -78,36 +79,58 @@ def _op_url(base: str, path: str, op: str, **params) -> str:
 
 
 def _request(url: str, method: str, data: Optional[bytes] = None,
-             ok=(200, 201)) -> object:
+             ok=(200, 201), retry: bool = False) -> object:
     """One WebHDFS call, following the namenode's 307 datanode redirect
-    by hand: urllib only auto-follows redirects for GET/HEAD."""
-    for _hop in range(4):
-        req = urllib.request.Request(url, data=data, method=method)
-        if data is not None:
-            req.add_header("Content-Type", "application/octet-stream")
-        try:
-            resp = urllib.request.urlopen(req, timeout=60)
-        except urllib.error.HTTPError as e:
-            if e.code == 307 and e.headers.get("Location"):
-                url = e.headers["Location"]
+    by hand: urllib only auto-follows redirects for GET/HEAD.
+
+    ``retry=True`` adds transient retry (resilience.RetryPolicy over
+    DMLC_HDFS_RETRIES) around the WHOLE redirect dance — callers must
+    only enable it for idempotent operations (stat/list/reads/DELETE);
+    an APPEND resent blindly would double-commit its chunk, and a
+    RENAME resent after a lost success reply would read as 'destination
+    exists' and confuse the overwrite path."""
+
+    def attempt(start_url=url):
+        fault_point("hdfs.request", method=method,
+                    url=start_url.split("?")[0])
+        u = start_url
+        for _hop in range(4):
+            req = urllib.request.Request(u, data=data, method=method)
+            if data is not None:
+                req.add_header("Content-Type", "application/octet-stream")
+            try:
+                resp = urllib.request.urlopen(req, timeout=60)
+            except urllib.error.HTTPError as e:
+                if e.code == 307 and e.headers.get("Location"):
+                    u = e.headers["Location"]
+                    continue
+                if e.code in ok:  # e.g. DELETE of an already-absent path
+                    return e
+                body = e.read()[:300]
+                hint = (" (cluster requires authentication: this backend "
+                        "speaks simple auth only — point "
+                        "DMLC_WEBHDFS_ENDPOINT at an authenticating gateway "
+                        "such as Knox/HttpFS)") if e.code == 401 else ""
+                raise DMLCError(
+                    f"WebHDFS {method} {u.split('?')[0]} failed: "
+                    f"HTTP {e.code} {body!r}{hint}", status=e.code) from e
+            except urllib.error.URLError as e:  # namenode gone, timeouts
+                raise DMLCError(f"WebHDFS {method} {u.split('?')[0]} "
+                                f"failed: {e.reason}", transient=True) from e
+            if resp.status == 307 and resp.headers.get("Location"):
+                u = resp.headers["Location"]
                 continue
-            if e.code in ok:  # e.g. DELETE of an already-absent path
-                return e
-            body = e.read()[:300]
-            hint = (" (cluster requires authentication: this backend "
-                    "speaks simple auth only — point "
-                    "DMLC_WEBHDFS_ENDPOINT at an authenticating gateway "
-                    "such as Knox/HttpFS)") if e.code == 401 else ""
-            raise DMLCError(
-                f"WebHDFS {method} {url.split('?')[0]} failed: "
-                f"HTTP {e.code} {body!r}{hint}", status=e.code) from e
-        if resp.status == 307 and resp.headers.get("Location"):
-            url = resp.headers["Location"]
-            continue
-        check(resp.status in ok,
-              f"WebHDFS {method}: unexpected HTTP {resp.status}")
-        return resp
-    raise DMLCError(f"WebHDFS {method}: redirect loop at {url.split('?')[0]}")
+            check(resp.status in ok,
+                  f"WebHDFS {method}: unexpected HTTP {resp.status}")
+            return resp
+        raise DMLCError(f"WebHDFS {method}: redirect loop at "
+                        f"{u.split('?')[0]}")
+
+    if not retry:
+        return attempt()
+    policy = RetryPolicy.from_env(retries_env="DMLC_HDFS_RETRIES",
+                                  default_attempts=4, name="hdfs")
+    return policy.call(attempt)
 
 
 def _probe_redirect(url: str, method: str) -> Optional[str]:
@@ -163,7 +186,7 @@ class WebHdfsReadStream(HttpReadStream):
             return b""
         url = _op_url(self._base, self._path, "OPEN",
                       offset=start, length=size)
-        resp = _request(url, "GET")
+        resp = _request(url, "GET", retry=True)
         body = resp.read()
         check(len(body) == size,
               f"WebHDFS OPEN returned {len(body)} bytes for span "
@@ -181,7 +204,16 @@ class WebHdfsWriteStream(Stream):
     place, so writing the destination directly would expose torn
     partials to concurrent readers; the temp+RENAME dance restores the
     no-partial-object property the GCS/Azure writers give for free.
-    HDFS RENAME within a directory is an atomic namenode metadata op."""
+    HDFS RENAME within a directory is an atomic namenode metadata op.
+
+    Overwrite semantics: when the destination already exists, the old
+    version is first RENAMEd aside to a hidden ``.<name>.old.<pid>.<n>``
+    sibling, the temp is RENAMEd into place, and the backup is deleted.
+    Each step is an atomic namenode op, but the sequence is not one
+    atomic swap (WebHDFS has none): a crash mid-overwrite leaves either
+    the old version live (before the backup rename) or a recoverable
+    copy at the backup path — never a torn file, and never the
+    old-version-lost window of a DELETE-then-RENAME."""
 
     def __init__(self, base: str, path: str):
         mb = int(os.environ.get("DMLC_HDFS_WRITE_BUFFER_MB", "64"))
@@ -232,7 +264,7 @@ class WebHdfsWriteStream(Stream):
     def _delete_tmp(self) -> None:
         try:
             _request(_op_url(self._base, self._tmp, "DELETE"),
-                     "DELETE", ok=(200, 404))
+                     "DELETE", ok=(200, 404), retry=True)
         except DMLCError:
             pass  # best-effort; the dot-prefix keeps it out of scans
 
@@ -250,22 +282,41 @@ class WebHdfsWriteStream(Stream):
             # RENAME first (the common fresh-destination case commits in
             # one atomic namenode op).  Only on refusal — WebHDFS RENAME
             # returns {"boolean": false} when the destination exists —
-            # DELETE the old file and retry, matching
-            # CREATE&overwrite=true semantics while keeping the old
-            # version live until the last possible moment.
-            if not self._rename():
-                _request(_op_url(self._base, self._path, "DELETE"),
-                         "DELETE", ok=(200, 404))
-                check(self._rename(),
-                      f"WebHDFS RENAME {self._tmp} -> {self._path} "
-                      f"refused by namenode after destination delete")
+            # take the backup path: rename the live destination ASIDE
+            # (atomic), rename the temp into place, then delete the
+            # backup.  A crash between the two renames leaves the old
+            # version recoverable at the dot-prefixed backup path
+            # (unlike the previous DELETE-then-RENAME, which had a
+            # window where the old version was gone and the new one not
+            # yet published).  There is still no atomic swap in WebHDFS:
+            # readers can observe the destination absent between the
+            # renames.
+            if not self._rename_to(self._tmp, self._path):
+                d, _, name = self._path.rpartition("/")
+                backup = f"{d}/.{name}.old.{os.getpid()}.{_next_nonce()}"
+                check(self._rename_to(self._path, backup),
+                      f"WebHDFS RENAME {self._path} -> {backup} (backup "
+                      f"of the old version) refused by namenode")
+                if not self._rename_to(self._tmp, self._path):
+                    # put the old version back before failing: the
+                    # destination must not stay absent on our account
+                    self._rename_to(backup, self._path)
+                    check(False,
+                          f"WebHDFS RENAME {self._tmp} -> {self._path} "
+                          f"refused by namenode after moving the old "
+                          f"version aside")
+                try:
+                    _request(_op_url(self._base, backup, "DELETE"),
+                             "DELETE", ok=(200, 404), retry=True)
+                except DMLCError:
+                    pass  # recoverable copy stranded; dot-prefix hides it
         except Exception:
             self._delete_tmp()  # don't strand the temp next to the data
             raise
 
-    def _rename(self) -> bool:
-        resp = _request(_op_url(self._base, self._tmp, "RENAME",
-                                destination=self._path), "PUT", ok=(200,))
+    def _rename_to(self, src: str, dst: str) -> bool:
+        resp = _request(_op_url(self._base, src, "RENAME",
+                                destination=dst), "PUT", ok=(200,))
         return bool(json.loads(resp.read()).get("boolean"))
 
 
@@ -297,7 +348,7 @@ class WebHDFSFileSystem(FileSystem):
     def get_path_info(self, path: URI) -> FileInfo:
         url = _op_url(self._base, path.name, "GETFILESTATUS")
         try:
-            resp = _request(url, "GET")
+            resp = _request(url, "GET", retry=True)
         except DMLCError as e:
             if e.status == 404:
                 raise FileNotFoundError(path.str_uri()) from e
@@ -307,7 +358,7 @@ class WebHDFSFileSystem(FileSystem):
 
     def list_directory(self, path: URI) -> List[FileInfo]:
         url = _op_url(self._base, path.name, "LISTSTATUS")
-        resp = _request(url, "GET")
+        resp = _request(url, "GET", retry=True)
         statuses = json.loads(resp.read())["FileStatuses"]["FileStatus"]
         base = path.name.rstrip("/")
         out = []

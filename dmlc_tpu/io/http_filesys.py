@@ -14,13 +14,12 @@ import urllib.request
 from typing import List, Optional
 
 from ..base import DMLCError, check
+from ..resilience import RetryPolicy, fault_point, maybe_corrupt
 from .filesys import FileInfo, FileSystem
 from .stream import SeekStream, Stream
 from .uri import URI
 
 __all__ = ["HTTPFileSystem", "HttpReadStream"]
-
-_RETRIES = 3
 
 
 class HttpReadStream(SeekStream):
@@ -56,16 +55,18 @@ class HttpReadStream(SeekStream):
 
     def _fill(self, start: int, size: int) -> bytes:
         """Ranged GET [start, start+size) with retry (s3_filesys.cc retry
-        structure).  Permanent 4xx failures are not retried."""
+        structure, now resilience.RetryPolicy; attempts from
+        DMLC_HTTP_RETRIES).  Permanent 4xx failures are not retried."""
         end = min(start + size, self._size) - 1
         if end < start:
             return b""
-        last_err: Optional[Exception] = None
-        for _ in range(_RETRIES):
+
+        def attempt():
+            fault_point("http.request", url=self._url.split("?")[0])
+            headers = self._resolve_headers()
+            headers["Range"] = f"bytes={start}-{end}"
+            req = urllib.request.Request(self._url, headers=headers)
             try:
-                headers = self._resolve_headers()
-                headers["Range"] = f"bytes={start}-{end}"
-                req = urllib.request.Request(self._url, headers=headers)
                 with urllib.request.urlopen(req, timeout=60) as r:
                     body = r.read()
                     if r.status == 206:
@@ -81,13 +82,22 @@ class HttpReadStream(SeekStream):
             except urllib.error.HTTPError as e:
                 if 400 <= e.code < 500:
                     raise DMLCError(
-                        f"HTTP {e.code} reading {self._url.split('?')[0]}"
-                    ) from e
-                last_err = e
+                        f"HTTP {e.code} reading {self._url.split('?')[0]}",
+                        status=e.code) from e
+                raise DMLCError(
+                    f"HTTP {e.code} reading {self._url.split('?')[0]}",
+                    status=e.code, transient=True) from e
             except (urllib.error.URLError, ConnectionError, OSError) as e:
-                last_err = e
-        raise DMLCError(
-            f"HTTP read failed after {_RETRIES} retries: {last_err}")
+                # keep the io/ contract: I/O failures surface as
+                # DMLCError (transient -> the policy retries; after
+                # exhaustion callers still catch one exception type)
+                raise DMLCError(
+                    f"HTTP read {self._url.split('?')[0]} failed: {e}",
+                    transient=True) from e
+
+        policy = RetryPolicy.from_env(retries_env="DMLC_HTTP_RETRIES",
+                                      default_attempts=3, name="http")
+        return policy.call(attempt)
 
     def read(self, size: int) -> bytes:
         if self._pos >= self._size:
@@ -104,7 +114,12 @@ class HttpReadStream(SeekStream):
             rest = self._fill(self._pos + len(out), size - len(out))
             out += rest
         self._pos += len(out)
-        return out
+        # chaos hook shared by every ranged-read backend (S3/GCS/Azure/
+        # WebHDFS subclasses all route reads through here): an armed
+        # 'storage.response=corrupt' rule flips bytes so integrity
+        # checks downstream (recordio magic, checkpoint digests) can be
+        # exercised against torn storage replies
+        return maybe_corrupt("storage.response", out)
 
     def write(self, data: bytes) -> int:
         raise DMLCError("HttpReadStream is read-only")

@@ -27,6 +27,7 @@ in-place ``\\0`` termination — Python slices replace C-string hacks.
 
 from __future__ import annotations
 
+import logging
 import mmap
 import os
 import re
@@ -40,6 +41,8 @@ from .filesys import FileInfo, FileSystem
 from .recordio import KMAGIC, decode_flag, decode_length
 from .stream import SeekStream
 from .uri import URI, URISpec
+
+_logger = logging.getLogger("dmlc_tpu.io")
 
 __all__ = [
     "InputSplit",
@@ -354,13 +357,25 @@ class InputSplitBase(InputSplit):
                 # FileInputFormat convention): in-flight writer temps
                 # (.name.tmp.<pid>) and markers like _SUCCESS are not
                 # data.  Deviation from input_split_base.cc:96-175,
-                # which takes every non-empty entry.
-                self._files.extend(
-                    f for f in dfiles
-                    if f.size != 0 and f.type == "file"
-                    and not f.path.name.rpartition("/")[2].startswith(
-                        (".", "_"))
-                )
+                # which takes every non-empty entry — logged below so a
+                # dataset with legitimate underscore-prefixed data files
+                # is never dropped silently.
+                skipped = []
+                for f in dfiles:
+                    if f.size == 0 or f.type != "file":
+                        continue
+                    if f.path.name.rpartition("/")[2].startswith((".", "_")):
+                        skipped.append(f.path.name.rpartition("/")[2])
+                    else:
+                        self._files.append(f)
+                if skipped:
+                    _logger.info(
+                        "input_split: directory %s: skipped %d hidden "
+                        "('.'/'_'-prefixed) file(s) by the Hadoop "
+                        "convention (deviation from the reference, which "
+                        "reads them): %s%s", info.path.str_uri(),
+                        len(skipped), ", ".join(skipped[:5]),
+                        ", ..." if len(skipped) > 5 else "")
             elif info.size != 0:
                 self._files.append(info)
         check(self._files, f"Cannot find any files that match the URI pattern {uri}")

@@ -1,26 +1,32 @@
 """Shared REST request machinery for the cloud filesystem backends.
 
-One retry/backoff loop (transient 408/429/5xx with exponential sleep,
-``DMLCError.status`` carrying the HTTP code on permanent failure) used
-by the Azure and S3 backends; GCS keeps its own loop because its
+One retry/backoff loop — ``resilience.RetryPolicy`` (transient
+408/429/5xx and connection errors with exponential sleep + jitter,
+``DMLCError.status`` carrying the HTTP code on permanent failure) —
+used by the Azure and S3 backends; GCS keeps its own loop because its
 resumable-upload protocol treats specific codes (308) as answers and
 tracks transience on its error type, and WebHDFS keeps its own because
-of the namenode 307 redirect dance.
+of the namenode 307 redirect dance (both now share the SAME policy
+object for backoff and classification).
+
+Fault injection: each attempt crosses the ``<service>.request`` fault
+point, so ``DMLC_FAULT_SPEC='s3.request=error::2'`` deterministically
+tears the first two S3 requests (exercised by tests and the CI chaos
+stage).
 """
 
 from __future__ import annotations
 
 import os
-import time
 import urllib.error
 import urllib.request
 from typing import Callable, Optional
 
 from ..base import DMLCError, check
+from ..resilience import RetryPolicy, fault_point
+from ..resilience.retry import TRANSIENT_HTTP  # noqa: F401  (re-export)
 
 __all__ = ["TRANSIENT_HTTP", "rest_request"]
-
-TRANSIENT_HTTP = {408, 429, 500, 502, 503, 504}
 
 Signer = Callable[[str, str, dict, Optional[bytes]], dict]
 
@@ -39,35 +45,32 @@ def rest_request(service: str, url: str, method: str = "GET",
     An HTTPError whose code is listed in ``ok`` is returned, not raised
     (e.g. DELETE of an already-absent path answering 404).
     """
-    attempts = int(os.environ.get(retries_env, "4"))
-    last = "no attempts"
-    for i in range(attempts):
+    policy = RetryPolicy.from_env(retries_env=retries_env,
+                                  name=service.lower())
+    timeout = float(os.environ.get("DMLC_REST_TIMEOUT_S", "60"))
+    short_url = url.split("?")[0]
+    site = f"{service.lower()}.request"
+
+    def attempt():
+        fault_point(site, method=method, url=short_url)
         hdrs = sign(method, url, headers or {}, data) if sign \
             else dict(headers or {})
         hdrs.pop("host", None)  # urllib sets Host itself
         req = urllib.request.Request(url, data=data, method=method,
                                      headers=hdrs)
         try:
-            resp = urllib.request.urlopen(req, timeout=60)
+            resp = urllib.request.urlopen(req, timeout=timeout)
         except urllib.error.HTTPError as e:
             if e.code in ok:
                 return e
-            if e.code in TRANSIENT_HTTP and i + 1 < attempts:
-                last = f"HTTP {e.code}"
-                time.sleep(0.25 * (2 ** i))
-                continue
             raise DMLCError(
-                f"{service} {method} {url.split('?')[0]} failed: "
+                f"{service} {method} {short_url} failed: "
                 f"HTTP {e.code} {e.read()[:300]!r}", status=e.code) from e
-        except urllib.error.URLError as e:
-            if i + 1 < attempts:
-                last = str(e.reason)
-                time.sleep(0.25 * (2 ** i))
-                continue
-            raise DMLCError(f"{service} {method} {url.split('?')[0]} "
-                            f"failed: {e.reason}") from e
+        except urllib.error.URLError as e:  # DNS, refused, timeouts
+            raise DMLCError(f"{service} {method} {short_url} "
+                            f"failed: {e.reason}", transient=True) from e
         check(resp.status in ok,
               f"{service} {method}: unexpected HTTP {resp.status}")
         return resp
-    raise DMLCError(f"{service} {method} {url.split('?')[0]} failed "
-                    f"after {attempts} attempts: {last}")
+
+    return policy.call(attempt)

@@ -179,6 +179,7 @@ class S3WriteStream(Stream):
         self._buf = bytearray()
         self._upload_id: Optional[str] = None
         self._etags: List[str] = []
+        self._total = 0  # bytes committed as parts (Complete verification)
         self._closed = False
         self._failed = False
 
@@ -216,6 +217,7 @@ class S3WriteStream(Stream):
             etag = resp.headers.get("ETag", "")
             check(bool(etag), "S3 UploadPart: no ETag in response")
             self._etags.append(etag)
+            self._total += len(body)
         except Exception:
             self._failed = True
             self._abort()
@@ -251,15 +253,35 @@ class S3WriteStream(Stream):
                 f"<ETag>{etag}</ETag></Part>"
                 for i, etag in enumerate(self._etags))
                 + "</CompleteMultipartUpload>")
-            _request(f"{self._url}?uploadId="
-                     f"{urllib.parse.quote(self._upload_id)}",
-                     "POST", data=xml.encode("utf-8"),
-                     headers={"Content-Type": "application/xml"},
-                     ok=(200,))
+            try:
+                _request(f"{self._url}?uploadId="
+                         f"{urllib.parse.quote(self._upload_id)}",
+                         "POST", data=xml.encode("utf-8"),
+                         headers={"Content-Type": "application/xml"},
+                         ok=(200,))
+            except DMLCError as e:
+                # A 404 NoSuchUpload on a RETRIED Complete can mean the
+                # first attempt committed and only its response was lost
+                # (a 500-after-commit or a dropped connection): the
+                # commit deletes the upload id, so the blind resend
+                # 404s.  Verify against the object itself before
+                # declaring failure — if it exists at the expected size
+                # the upload succeeded and close() must not raise.
+                if e.status != 404 or not self._object_committed():
+                    raise
         except Exception:
             self._failed = True
             self._abort()
             raise
+
+    def _object_committed(self) -> bool:
+        """HEAD the destination: did a lost-response Complete actually
+        commit our bytes?"""
+        try:
+            resp = _request(self._url, "HEAD")
+        except DMLCError:
+            return False
+        return int(resp.headers.get("Content-Length", -1)) == self._total
 
 
 class S3FileSystem(FileSystem):

@@ -1,0 +1,60 @@
+"""dmlc_tpu.resilience: unified retry/backoff + deterministic fault injection.
+
+The fault-tolerance layer the reference spreads across rabit recovery,
+per-backend restart policies, and hand-rolled curl retry loops
+(src/io/s3_filesys.cc:295-446), rebuilt as two small primitives every
+other subsystem shares:
+
+  * ``retry``  — :class:`RetryPolicy`: exponential backoff with jitter,
+                 an overall deadline, and retryable-error classification
+                 (transient HTTP codes, connection errors, explicit
+                 ``transient`` markers on ``DMLCError``).  One policy
+                 object replaces the ad-hoc loops that used to live in
+                 the S3/GCS/Azure/HDFS/HTTP backends and the tracker
+                 client.  Every retry increments the ``resilience``
+                 telemetry counters, so discipline is observable.
+  * ``fault``  — :class:`FaultInjector`: env/config-driven deterministic
+                 fault injection (``DMLC_FAULT_SPEC``).  Instrumented
+                 sites call :func:`fault_point` (drop a connection,
+                 delay a response, kill the process at a named barrier)
+                 or :func:`maybe_corrupt` (corrupt a storage response).
+                 Used by tests and the CI chaos stage
+                 (``scripts/chaos_smoke.py``); free when unset.
+
+Typical use::
+
+    from dmlc_tpu.resilience import RetryPolicy, fault_point
+
+    policy = RetryPolicy.from_env(retries_env="DMLC_S3_RETRIES", name="s3")
+    resp = policy.call(lambda: one_signed_request(...))
+
+    fault_point("barrier.epoch_end", rank=rank, attempt=attempt)
+"""
+
+from .fault import (  # noqa: F401
+    FaultInjected,
+    FaultInjector,
+    fault_point,
+    get_injector,
+    install_injector,
+    maybe_corrupt,
+    reset_injector,
+)
+from .retry import (  # noqa: F401
+    TRANSIENT_HTTP,
+    RetryPolicy,
+    default_retryable,
+)
+
+__all__ = [
+    "FaultInjected",
+    "FaultInjector",
+    "RetryPolicy",
+    "TRANSIENT_HTTP",
+    "default_retryable",
+    "fault_point",
+    "get_injector",
+    "install_injector",
+    "maybe_corrupt",
+    "reset_injector",
+]

@@ -101,14 +101,25 @@ def _median(vals: List[float]) -> float:
 
 
 class TelemetryAggregator:
-    """Per-rank snapshot store with merge + straggler detection."""
+    """Per-rank snapshot store with merge + straggler detection.
+
+    ``local_snapshot`` (a zero-arg callable returning a snapshot dict)
+    adds the AGGREGATING process's own registry to the /metrics surface
+    under ``rank="<local_label>"`` — the tracker uses it to publish
+    launcher/tracker-side resilience counters (task restarts, declared
+    worker deaths) that no worker heartbeat carries.  ``extra_health``
+    (zero-arg callable returning a dict) is merged into /healthz."""
 
     def __init__(self, straggler_factor: float = 3.0,
                  straggler_keys=DEFAULT_STRAGGLER_KEYS,
-                 log=logger):
+                 log=logger, local_snapshot=None,
+                 local_label: str = "tracker"):
         self.straggler_factor = float(straggler_factor)
         self.straggler_keys = tuple(straggler_keys)
         self._log = log
+        self._local_snapshot = local_snapshot
+        self._local_label = local_label
+        self.extra_health = None
         self._lock = threading.Lock()
         self._ranks: Dict[int, Dict] = {}      # rank -> snapshot dict
         self._seen: Dict[int, float] = {}      # rank -> last heartbeat time
@@ -136,6 +147,16 @@ class TelemetryAggregator:
             self.update(rank, snap)
         except Exception as e:  # noqa: BLE001 - see docstring
             self._log.warning("rank %d sent malformed telemetry: %r", rank, e)
+
+    def touch(self, rank: int) -> None:
+        """Reset ``rank``'s heartbeat clock without a snapshot — the
+        tracker calls this when a replacement worker finishes brokering,
+        so the failure detector does not re-flag the rank in the gap
+        before its first heartbeat lands."""
+        if rank < 0:
+            return
+        with self._lock:
+            self._seen[rank] = time.time()
 
     # ---- views ----------------------------------------------------------
     def ranks(self) -> Dict[int, float]:
@@ -193,6 +214,14 @@ class TelemetryAggregator:
         parts.append(exporters.to_prometheus_text(
             self.merged(), labels={"rank": "all"},
             emit_type_lines=not parts))
+        if self._local_snapshot is not None:
+            try:
+                parts.append(exporters.to_prometheus_text(
+                    _sanitize(self._local_snapshot()),
+                    labels={"rank": self._local_label},
+                    emit_type_lines=False))
+            except Exception as e:  # noqa: BLE001 - scrape must not 500
+                self._log.warning("local telemetry snapshot failed: %r", e)
         n = len(snaps)
         parts.append(f"dmlc_tracker_ranks_reporting {n}\n")
         return "".join(parts)
@@ -201,12 +230,18 @@ class TelemetryAggregator:
         ages = self.ranks()
         with self._lock:  # _flagged mutates on the tracker accept thread
             flagged = sorted({r for (r, _s, _n) in self._flagged})
-        return {
+        out = {
             "status": "ok",
             "ranks_reporting": len(ages),
             "ranks": {str(r): round(age, 3) for r, age in sorted(ages.items())},
             "stragglers": flagged,
         }
+        if self.extra_health is not None:
+            try:
+                out.update(self.extra_health())
+            except Exception as e:  # noqa: BLE001 - health must not 500
+                self._log.warning("extra_health failed: %r", e)
+        return out
 
     # ---- straggler detection -------------------------------------------
     def check_stragglers(self) -> List[str]:

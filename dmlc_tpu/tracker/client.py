@@ -18,9 +18,38 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..resilience import RetryPolicy, fault_point
 from .protocol import MAGIC, FrameSocket
 
 __all__ = ["TrackerClient"]
+
+
+def _connect_timeout() -> Optional[float]:
+    """Per-dial connect timeout (DMLC_CLIENT_CONNECT_TIMEOUT_S, default
+    15; 0 disables).  Bounds how long one attempt can hang on a dead
+    tracker or peer before the reconnect backoff takes over."""
+    t = float(os.environ.get("DMLC_CLIENT_CONNECT_TIMEOUT_S", "15"))
+    return t if t > 0 else None
+
+
+def _op_timeout() -> Optional[float]:
+    """Per-socket operation timeout (DMLC_CLIENT_OP_TIMEOUT_S, default
+    300 — the DMLC_TRACKER_TIMEOUT / shm-collective companion; 0
+    disables).  A tracker or peer that dies without a FIN raises
+    ``socket.timeout`` (an OSError, so the recover path catches it)
+    instead of blocking a recv forever."""
+    t = float(os.environ.get("DMLC_CLIENT_OP_TIMEOUT_S", "300"))
+    return t if t > 0 else None
+
+
+def _dial_policy() -> RetryPolicy:
+    """Reconnect-with-backoff for tracker dials (DMLC_CLIENT_RETRIES,
+    default 5): rides out a tracker restart / slow bind instead of
+    failing the worker on the first refused connection."""
+    return RetryPolicy.from_env(retries_env="DMLC_CLIENT_RETRIES",
+                                default_attempts=5,
+                                base_env="DMLC_CLIENT_RETRY_BASE_S",
+                                default_base=0.3, name="tracker_dial")
 
 
 class TrackerClient:
@@ -45,11 +74,27 @@ class TrackerClient:
 
     # ---- tracker session helpers ---------------------------------------
     def _dial(self) -> FrameSocket:
-        s = socket.create_connection((self.tracker_uri, self.tracker_port))
-        fs = FrameSocket(s)
-        fs.send_int(MAGIC)
-        assert fs.recv_int() == MAGIC
-        return fs
+        """Connect to the tracker with timeouts + backoff: a dead or
+        restarting tracker yields a prompt, classified failure (after
+        DMLC_CLIENT_RETRIES attempts) instead of an indefinite hang."""
+
+        def attempt() -> FrameSocket:
+            fault_point("tracker.dial", host=self.tracker_uri)
+            s = socket.create_connection(
+                (self.tracker_uri, self.tracker_port),
+                timeout=_connect_timeout())
+            s.settimeout(_op_timeout())
+            fs = FrameSocket(s)
+            try:
+                fs.send_int(MAGIC)
+                if fs.recv_int() != MAGIC:
+                    raise ConnectionError("tracker answered bad magic")
+            except BaseException:
+                fs.close()
+                raise
+            return fs
+
+        return _dial_policy().call(attempt)
 
     def _session(self, cmd: str, rank: int, world: int) -> FrameSocket:
         fs = self._dial()
@@ -67,6 +112,9 @@ class TrackerClient:
         self._listener = socket.socket()
         self._listener.bind(("0.0.0.0", 0))
         self._listener.listen(16)
+        # a gang-mate dying before it dials us must not park accept()
+        # forever: surface as socket.timeout -> OSError -> recover path
+        self._listener.settimeout(_op_timeout())
         my_port = self._listener.getsockname()[1]
 
         fs = self._session(cmd, self.rank, world_size)
@@ -79,30 +127,47 @@ class TrackerClient:
         self.ring_next = fs.recv_int()
 
         # brokering dance: report already-good links, connect to assigned
-        # peers, then report our accept port
-        good = sorted(self.links.keys())
-        fs.send_int(len(good))
-        for r in good:
-            fs.send_int(r)
-        n_conn = fs.recv_int()
-        n_accept = fs.recv_int()
-        for _ in range(n_conn):
-            host = fs.recv_str()
-            port = fs.recv_int()
-            peer_rank = fs.recv_int()
-            ps = FrameSocket(socket.create_connection((host, port)))
-            ps.send_int(MAGIC)
-            ps.send_int(self.rank)
-            assert ps.recv_int() == MAGIC
-            got = ps.recv_int()
-            assert got == peer_rank, (got, peer_rank)
-            self.links[peer_rank] = ps
-        fs.send_int(0)          # nerr
+        # peers, then report our accept port.  A failed peer dial (the
+        # peer died, or the tracker handed out a stale endpoint before
+        # its failure detector caught the death) is REPORTED as a dial
+        # error — the tracker restarts the round — instead of crashing
+        # this worker; rounds are bounded so a permanently-dead peer
+        # still surfaces as an error rather than a livelock.
+        policy = _dial_policy()
+        round_no = 0
+        while True:
+            good = sorted(self.links.keys())
+            fs.send_int(len(good))
+            for r in good:
+                fs.send_int(r)
+            n_conn = fs.recv_int()
+            n_accept = fs.recv_int()
+            n_errors = 0
+            for _ in range(n_conn):
+                host = fs.recv_str()
+                port = fs.recv_int()
+                peer_rank = fs.recv_int()
+                try:
+                    self.links[peer_rank] = self._dial_peer(host, port,
+                                                            peer_rank)
+                except OSError:
+                    n_errors += 1
+            fs.send_int(n_errors)
+            if n_errors == 0:
+                break
+            round_no += 1
+            if round_no >= policy.attempts:
+                fs.close()
+                raise ConnectionError(
+                    f"rank {self.rank}: peer dials kept failing after "
+                    f"{round_no} brokering rounds")
+            policy.sleep_for(round_no - 1)  # let dead peers get culled
         fs.send_int(my_port)
         fs.close()
 
         for _ in range(n_accept):
             conn, _ = self._listener.accept()
+            conn.settimeout(_op_timeout())
             ps = FrameSocket(conn)
             assert ps.recv_int() == MAGIC
             peer_rank = ps.recv_int()
@@ -110,6 +175,27 @@ class TrackerClient:
             ps.send_int(self.rank)
             self.links[peer_rank] = ps
         return self
+
+    def _dial_peer(self, host: str, port: int, peer_rank: int) -> FrameSocket:
+        """One peer link: connect + (MAGIC, rank) identification."""
+        s = socket.create_connection((host, port),
+                                     timeout=_connect_timeout())
+        s.settimeout(_op_timeout())
+        ps = FrameSocket(s)
+        try:
+            ps.send_int(MAGIC)
+            ps.send_int(self.rank)
+            if ps.recv_int() != MAGIC:
+                raise ConnectionError(f"peer {peer_rank} at {host}:{port} "
+                                      f"answered bad magic")
+            got = ps.recv_int()
+            if got != peer_rank:
+                raise ConnectionError(f"dialed {host}:{port} expecting "
+                                      f"rank {peer_rank}, got {got}")
+        except BaseException:
+            ps.close()
+            raise
+        return ps
 
     def recover(self) -> "TrackerClient":
         """Reconnect after restart keeping our rank (tracker 'recover')."""

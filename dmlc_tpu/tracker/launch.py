@@ -114,6 +114,8 @@ def submit_local(args):
 
     def fun_submit(n_workers, n_servers, envs):
         def run_task(role, task_id):
+            from .. import telemetry
+
             for attempt in range(args.max_attempts):
                 env = os.environ.copy()
                 env.update(task_env(envs, role, task_id, attempt, "local",
@@ -126,6 +128,11 @@ def submit_local(args):
                     return
                 logger.warning("%s %d attempt %d exited %d", role, task_id,
                                attempt, ret)
+                if attempt + 1 < args.max_attempts:
+                    # supervised restart: visible on the tracker's
+                    # /metrics as dmlc_resilience_task_restarts
+                    telemetry.inc("resilience", "task_restarts")
+            telemetry.inc("resilience", "task_budget_exhausted")
             failures.append((role, task_id, args.max_attempts))
 
         for role, tid in _roles(n_workers, n_servers):
@@ -239,12 +246,18 @@ class GangScheduler:
             if ok:
                 return
             self.host_failures[host] = self.host_failures.get(host, 0) + 1
-            if self.host_failures[host] >= self.blacklist_after:
+            if self.host_failures[host] >= self.blacklist_after \
+                    and host not in self.blacklist:
                 self.blacklist.add(host)
                 logger.warning("blacklisted host %s", host)
+                from .. import telemetry
+
+                telemetry.inc("resilience", "hosts_blacklisted")
 
     def run_task(self, role: str, task_id: int, envs: Dict[str, str],
                  cluster: str, extra_env=None) -> None:
+        from .. import telemetry
+
         for attempt in range(self.max_attempts):
             host = self._pick_host_for(role, task_id, attempt)
             env = task_env(envs, role, task_id, attempt, cluster, extra_env)
@@ -255,6 +268,11 @@ class GangScheduler:
                 return
             logger.warning("%s %d attempt %d on %s exited %d",
                            role, task_id, attempt, host, ret)
+            if attempt + 1 < self.max_attempts:
+                # supervised restart onto a (possibly different) healthy
+                # host; surfaces as dmlc_resilience_task_restarts
+                telemetry.inc("resilience", "task_restarts")
+        telemetry.inc("resilience", "task_budget_exhausted")
         raise RuntimeError(
             f"{role} {task_id} failed after {self.max_attempts} attempts")
 

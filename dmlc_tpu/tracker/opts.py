@@ -64,7 +64,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="archives (.zip/.tar[.gz]) shipped and unpacked in "
                         "the job cache dir — python-library shipping")
     p.add_argument("--max-attempts", default=3, type=int,
-                   help="per-task restart budget (DMLC_NUM_ATTEMPT contract)")
+                   help="per-task attempt budget (DMLC_NUM_ATTEMPT contract)")
+    p.add_argument("--max-restarts", default=None, type=int,
+                   help="per-task RESTART budget (attempts = restarts + 1); "
+                        "overrides --max-attempts when given.  Default: "
+                        "--max-attempts 3, i.e. 2 restarts; 0 = fail fast "
+                        "on the first crash")
     p.add_argument("--env", action="append", default=[],
                    metavar="KEY=VALUE", help="extra env passed to every task")
     p.add_argument("command", nargs=argparse.REMAINDER,
@@ -120,6 +125,10 @@ def get_opts(argv=None) -> argparse.Namespace:
         raise SystemExit("missing command to run")
     if args.command and args.command[0] == "--":
         args.command = args.command[1:]
+    if args.max_restarts is not None:
+        if args.max_restarts < 0:
+            raise SystemExit("--max-restarts must be >= 0")
+        args.max_attempts = args.max_restarts + 1
     args.worker_memory_mb = parse_memory_mb(args.worker_memory)
     args.server_memory_mb = parse_memory_mb(args.server_memory)
     extra = {}

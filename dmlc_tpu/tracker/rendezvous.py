@@ -41,32 +41,56 @@ class AcceptRegistry:
     finished, and so will be told to dial IT later).  Each time the
     tracker directs some later worker to dial rank r, r's quota drops;
     at zero the rank stops being a dial target and leaves the registry.
+
+    Lock-protected: the failure detector (a separate thread) may
+    ``drop()`` a dead rank while the accept loop brokers.
     """
 
     def __init__(self):
         self._listening: Dict[int, "WorkerEntry"] = {}
+        self._lock = threading.Lock()
 
     def __contains__(self, rank: int) -> bool:
-        return rank in self._listening
-
-    def endpoint(self, rank: int):
-        w = self._listening[rank]
-        return w.host, w.port
+        with self._lock:
+            return rank in self._listening
 
     def add(self, rank: int, worker: "WorkerEntry") -> None:
         if worker.inbound_quota > 0:
-            self._listening[rank] = worker
+            with self._lock:
+                self._listening[rank] = worker
+
+    def drop(self, rank: int) -> None:
+        """Remove a rank declared dead: later workers must not be told
+        to dial its stale endpoint (they will be counted as accepts and
+        satisfied when the replacement re-brokers)."""
+        with self._lock:
+            self._listening.pop(rank, None)
+
+    def dial_targets(self, ranks) -> Dict[int, tuple]:
+        """Atomic snapshot {rank: (host, port)} for the subset of
+        ``ranks`` currently listening — membership and endpoint resolve
+        under ONE lock hold, so a concurrent ``drop()`` by the failure
+        detector can never KeyError the brokering loop between a
+        membership check and the endpoint read."""
+        with self._lock:
+            return {r: (self._listening[r].host, self._listening[r].port)
+                    for r in ranks if r in self._listening}
 
     def note_dialed(self, ranks) -> List[int]:
         """Record that ``ranks`` each just received one inbound link;
-        returns those whose quota is now exhausted (and drops them)."""
+        returns those whose quota is now exhausted (and drops them).
+        Ranks no longer present (dropped as dead mid-round) are
+        skipped."""
         filled = []
-        for r in ranks:
-            w = self._listening[r]
-            w.inbound_quota -= 1
-            if w.inbound_quota == 0:
-                filled.append(r)
-                del self._listening[r]
+        with self._lock:
+            for r in ranks:
+                w = self._listening.get(r)
+                if w is None:
+                    continue
+                w.inbound_quota -= 1
+                if w.inbound_quota == 0:
+                    filled.append(r)
+                    del self._listening[r]
         return filled
 
 
@@ -144,12 +168,13 @@ class WorkerEntry:
             filled += registry.note_dialed(confirmed)
             debited |= confirmed
             missing = required - held
-            dial_now = sorted(r for r in missing if r in registry)
+            targets = registry.dial_targets(missing)  # one atomic snapshot
+            dial_now = sorted(targets)
             n_accept = len(missing) - len(dial_now)
             self.sock.send_int(len(dial_now))
             self.sock.send_int(n_accept)
             for r in dial_now:
-                host, port = registry.endpoint(r)
+                host, port = targets[r]
                 self.sock.send_str(host)
                 self.sock.send_int(port)
                 self.sock.send_int(r)
@@ -175,11 +200,24 @@ class RabitTracker:
     ephemeral) serves the merged view over HTTP ``/metrics``
     (Prometheus text) + ``/healthz``, with straggler ranks flagged via
     ``logging.warning``.
+
+    Failure detection: with a positive ``miss_window_s`` (or
+    ``DMLC_TRACKER_MISS_WINDOW_S``; default 0 = disabled) a monitor
+    thread watches the heartbeat stream and declares a rank DEAD once
+    its heartbeats go missing for the window: the rank's connection is
+    dropped (closed + removed from the dial registry) WITHOUT killing
+    the accept loop, the death is logged and counted
+    (``resilience.worker_declared_dead``), and /healthz lists the rank
+    under ``dead_ranks``.  A replacement worker re-admitted through the
+    existing ``recover``/job-map path clears the flag and counts as
+    ``resilience.worker_readmitted`` — the tracker's half of supervised
+    restart (the launcher's restart budget owns re-running the task).
     """
 
     def __init__(self, host_ip: str, n_workers: int,
                  port: int = 9091, port_end: int = 9999,
-                 metrics_port: Optional[int] = None):
+                 metrics_port: Optional[int] = None,
+                 miss_window_s: Optional[float] = None):
         family = socket.getaddrinfo(host_ip, None)[0][0]
         sock = socket.socket(family, socket.SOCK_STREAM)
         for p in range(port, port_end):
@@ -198,9 +236,28 @@ class RabitTracker:
         self.thread: Optional[threading.Thread] = None
         self.start_time: Optional[float] = None
         self.end_time: Optional[float] = None
-        from ..telemetry import TelemetryAggregator
+        if miss_window_s is None:
+            miss_window_s = float(
+                os.environ.get("DMLC_TRACKER_MISS_WINDOW_S", "0"))
+        self.miss_window_s = miss_window_s
+        self.dead_ranks: set = set()
+        self._finished_ranks: set = set()  # clean shutdowns: never "dead"
+        self._dead_lock = threading.Lock()
+        self._entries: Dict[int, "WorkerEntry"] = {}
+        self._registry: Optional[AcceptRegistry] = None
+        self._monitor: Optional[threading.Thread] = None
+        self._monitor_stop = threading.Event()
+        from ..telemetry import TelemetryAggregator, exporters
 
-        self.telemetry = TelemetryAggregator(log=logger)
+        # local_snapshot: the tracker process IS the launcher for local
+        # jobs — its own registry carries restart/retry counters that no
+        # worker heartbeat ever will; publish them under rank="tracker"
+        self.telemetry = TelemetryAggregator(
+            log=logger,
+            local_snapshot=lambda: exporters.export_json(
+                include_buckets=True))
+        self.telemetry.extra_health = lambda: {
+            "dead_ranks": self._dead_snapshot()}
         self.metrics_server = None
         self.metrics_port: Optional[int] = None
         if metrics_port is None:
@@ -225,6 +282,7 @@ class RabitTracker:
     def _accept_loop(self, n_workers: int) -> None:
         shutdown: Dict[int, WorkerEntry] = {}
         registry = AcceptRegistry()
+        self._registry = registry
         job_map: Dict[str, int] = {}
         pending: List[WorkerEntry] = []
         tree_map = None
@@ -254,6 +312,8 @@ class RabitTracker:
                 raise DMLCError(
                     f"worker rank {rank} ({entry.host}) died "
                     f"mid-brokering: {e}") from e
+            self._entries[rank] = entry
+            self._note_admitted(rank, entry.cmd)
 
         while len(shutdown) != n_workers:
             fd, addr = self.sock.accept()
@@ -283,6 +343,13 @@ class RabitTracker:
                     raise fail(f"rank {w.rank} shut down while peers "
                                f"still expect to dial it")
                 shutdown[w.rank] = w
+                # a cleanly-finished rank leaves the failure detector's
+                # watch: its heartbeat age grows forever from here, and
+                # flagging it dead would corrupt the death counters
+                self._entries.pop(w.rank, None)
+                with self._dead_lock:
+                    self._finished_ranks.add(w.rank)
+                    self.dead_ranks.discard(w.rank)
                 logger.debug("shutdown from rank %d", w.rank)
                 continue
             if w.cmd not in ("start", "recover"):
@@ -333,6 +400,60 @@ class RabitTracker:
             logger.info("@tracker %.3f secs between start and finish",
                         self.end_time - self.start_time)
 
+    # ---- heartbeat-driven failure detection ----------------------------
+    def _dead_snapshot(self) -> List[int]:
+        with self._dead_lock:  # the monitor mutates the set concurrently
+            return sorted(self.dead_ranks)
+
+    def _note_admitted(self, rank: int, cmd: str) -> None:
+        """A worker finished brokering under ``rank``: if that rank was
+        declared dead, this is the supervised-restart re-admission."""
+        with self._dead_lock:
+            was_dead = rank in self.dead_ranks
+            self.dead_ranks.discard(rank)
+            self._finished_ranks.discard(rank)
+        self.telemetry.touch(rank)  # restart the miss-window clock
+        if was_dead:
+            from .. import telemetry
+
+            telemetry.inc("resilience", "worker_readmitted")
+            logger.info("rank %d re-admitted via %r after being declared "
+                        "dead", rank, cmd)
+
+    def _declare_dead(self, rank: int, age: float) -> None:
+        from .. import telemetry
+
+        with self._dead_lock:
+            if rank in self.dead_ranks:
+                return
+            self.dead_ranks.add(rank)
+        telemetry.inc("resilience", "worker_declared_dead")
+        logger.warning(
+            "rank %d declared dead: no heartbeat for %.1fs (miss window "
+            "%.1fs); dropping its connection and awaiting a replacement",
+            rank, age, self.miss_window_s)
+        entry = self._entries.pop(rank, None)
+        if entry is not None:
+            entry.sock.close()  # usually already closed by the worker
+        if self._registry is not None:
+            self._registry.drop(rank)
+
+    def _monitor_loop(self) -> None:
+        interval = max(0.1, min(1.0, self.miss_window_s / 4))
+        while not self._monitor_stop.wait(interval):
+            with self._dead_lock:
+                finished = set(self._finished_ranks)
+            for rank, age in self.telemetry.ranks().items():
+                if rank in finished:
+                    continue  # clean shutdown: silence is expected
+                if age > self.miss_window_s:
+                    self._declare_dead(rank, age)
+                else:
+                    # heartbeats resumed (replacement already pushing
+                    # before its brokering finished): clear the flag
+                    with self._dead_lock:
+                        self.dead_ranks.discard(rank)
+
     def start(self, n_workers: Optional[int] = None) -> None:
         n = self.n_workers if n_workers is None else n_workers
         self.error: Optional[BaseException] = None
@@ -343,9 +464,16 @@ class RabitTracker:
             except BaseException as e:  # surfaced by join()/_await_job
                 self.error = e
                 logger.error("tracker accept loop died: %s", e)
+            finally:
+                self._monitor_stop.set()
 
         self.thread = threading.Thread(target=run, daemon=True)
         self.thread.start()
+        if self.miss_window_s > 0 and self._monitor is None:
+            self._monitor = threading.Thread(
+                target=self._monitor_loop, daemon=True,
+                name="tracker-failure-detector")
+            self._monitor.start()
 
     def join(self, timeout: Optional[float] = None) -> None:
         assert self.thread is not None
@@ -361,6 +489,7 @@ class RabitTracker:
         return self.thread is not None and self.thread.is_alive()
 
     def close(self) -> None:
+        self._monitor_stop.set()
         try:
             self.sock.close()
         except OSError:

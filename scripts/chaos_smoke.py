@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Fault-tolerance end-to-end chaos smoke (ci.sh stage 7).
+
+Runs a real 2-worker local job with the FaultInjector armed to KILL
+rank 1 (no cleanup, no shutdown handshake — the preempted-host shape)
+at a named barrier right after rendezvous, then verifies the whole
+self-healing chain:
+
+  1. the tracker's heartbeat failure detector declares the rank dead
+     within the miss window (``dmlc_resilience_worker_declared_dead``);
+  2. the launcher restarts the task within its ``--max-restarts``
+     budget (``dmlc_resilience_task_restarts``);
+  3. the replacement completes rendezvous under its old rank via the
+     job map / ``recover`` path
+     (``dmlc_resilience_worker_readmitted``);
+  4. the surviving rank rides out the dropped link with
+     ``TrackerClient.recover`` and the job's allreduce completes with
+     the correct sum on BOTH ranks;
+  5. the restart/death/readmission events are visible as telemetry
+     counters on the tracker's /metrics surface (rank="tracker").
+
+The replacement deliberately delays its re-rendezvous past the miss
+window so the death detection provably fires before re-admission —
+deterministic chaos, no coin flips.
+
+Exit 0 on success, 1 with a diagnostic on any failure.
+"""
+
+import os
+import re
+import sys
+import tempfile
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from dmlc_tpu import telemetry  # noqa: E402
+from dmlc_tpu.tracker import launch  # noqa: E402
+from dmlc_tpu.tracker.opts import get_opts  # noqa: E402
+
+MISS_WINDOW_S = 1.0
+RESTART_DELAY_S = 3.0  # > MISS_WINDOW_S: death must be declared first
+
+WORKER_CODE = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+import numpy as np
+from dmlc_tpu.resilience import fault_point
+from dmlc_tpu.telemetry import HeartbeatSender
+from dmlc_tpu.tracker.client import TrackerClient
+
+attempt = int(os.environ.get("DMLC_NUM_ATTEMPT", "0"))
+if attempt > 0:
+    # replacement incarnation: stay away past the tracker's miss window
+    # so the failure detector provably declares the old self dead
+    time.sleep(float(os.environ["CHAOS_RESTART_DELAY_S"]))
+c = TrackerClient().start(world_size=2)
+hb = HeartbeatSender(c, interval=0.2)
+hb.send_once()  # beat immediately: the detector must know this rank
+# the named barrier: DMLC_FAULT_SPEC kills rank 1's first incarnation here
+fault_point("barrier.chaos", rank=c.rank, attempt=attempt)
+out = None
+for _ in range(10):
+    try:
+        out = c.allreduce_sum(np.full(2, float(c.rank + 1)))
+        break
+    except OSError:
+        # peer died mid-collective: re-broker through the tracker
+        c.recover()
+assert out is not None, "allreduce never completed after recover"
+expected = c.world_size * (c.world_size + 1) / 2.0
+assert np.allclose(out, expected), (out, expected)
+with open(os.environ["CHAOS_OUT"] + "." + str(c.rank), "w") as f:
+    f.write("attempt=%d sum=%g" % (attempt, out[0]))
+hb.close()
+c.shutdown()
+"""
+
+
+def fail(msg: str) -> None:
+    print(f"chaos smoke FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def metric(body: str, name: str) -> float:
+    m = re.search(rf'^{name}{{rank="tracker"}} ([0-9.eE+-]+)$', body,
+                  re.MULTILINE)
+    return float(m.group(1)) if m else 0.0
+
+
+def main() -> None:
+    telemetry.reset()  # counters below must come from THIS run
+    os.environ["DMLC_TRACKER_MISS_WINDOW_S"] = str(MISS_WINDOW_S)
+    os.environ["DMLC_TRACKER_METRICS_PORT"] = "0"
+    spec = "barrier.chaos@rank:1@attempt:0=kill:137:1"
+    with tempfile.TemporaryDirectory() as tmp:
+        out = os.path.join(tmp, "result")
+        args = get_opts([
+            "--cluster", "local", "--num-workers", "2",
+            "--max-restarts", "2", "--host-ip", "127.0.0.1",
+            "--env", f"DMLC_FAULT_SPEC={spec}",
+            "--env", f"CHAOS_OUT={out}",
+            "--env", f"CHAOS_RESTART_DELAY_S={RESTART_DELAY_S}",
+            "--", sys.executable, "-c", WORKER_CODE.format(repo=REPO),
+        ])
+        tracker = launch.submit_local(args)
+        if tracker is None or tracker.alive():
+            fail("job did not run to completion")
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{tracker.metrics_port}/metrics",
+                timeout=10).read().decode()
+        finally:
+            tracker.close()
+
+        results = {}
+        for rank in (0, 1):
+            path = f"{out}.{rank}"
+            if not os.path.exists(path):
+                fail(f"rank {rank} never wrote its result")
+            results[rank] = open(path).read()
+        if "attempt=0" not in results[0]:
+            fail(f"rank 0 restarted unexpectedly: {results[0]!r}")
+        if "attempt=1" not in results[1]:
+            fail(f"rank 1 was never killed+restarted: {results[1]!r}")
+        for rank, text in results.items():
+            if "sum=3" not in text:
+                fail(f"rank {rank} got a wrong allreduce: {text!r}")
+        print(f"chaos smoke: job self-healed (rank 1 killed at barrier, "
+              f"replacement on attempt 1) -> {results[1]!r}")
+
+    for name, want in (("dmlc_resilience_task_restarts", 1),
+                       ("dmlc_resilience_worker_declared_dead", 1),
+                       ("dmlc_resilience_worker_readmitted", 1)):
+        got = metric(body, name)
+        if got < want:
+            fail(f"/metrics {name} = {got} (< {want}); payload:\n"
+                 f"{body[:3000]}")
+        print(f"chaos smoke: {name} = {got:g} OK")
+    print("chaos smoke OK")
+
+
+if __name__ == "__main__":
+    main()

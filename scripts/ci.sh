@@ -17,6 +17,11 @@
 #   6. telemetry smoke: 2-worker local rendezvous pushing heartbeats,
 #      tracker /metrics scraped + validated as Prometheus text, Chrome
 #      trace export validated as JSON with >= 1 complete event
+#   7. chaos smoke: FaultInjector kills rank 1 at a barrier mid-job;
+#      the tracker's heartbeat failure detector declares it dead, the
+#      launcher restarts it within its budget, the replacement rejoins
+#      via recover, the job completes, and the restart/death/readmit
+#      counters appear on /metrics
 #
 # Usage: scripts/ci.sh [pytest-args...]
 set -u
@@ -124,4 +129,9 @@ echo "== stage 6: telemetry smoke (rendezvous heartbeats + /metrics) =="
 timeout -k 10 180 python scripts/telemetry_smoke.py \
     || { echo "FAIL: telemetry smoke"; exit 1; }
 
-echo "== CI OK (native=$NATIVE_OK tsan=$TSAN_OK asan=$ASAN_OK telemetry=1) =="
+echo "== stage 7: chaos smoke (fault-injected worker death + self-heal) =="
+timeout -k 10 180 python scripts/chaos_smoke.py \
+    || { echo "FAIL: chaos smoke"; exit 1; }
+
+echo "== CI OK (native=$NATIVE_OK tsan=$TSAN_OK asan=$ASAN_OK" \
+     "telemetry=1 chaos=1) =="

@@ -120,6 +120,26 @@ def test_directory_uri(tmp_path):
     assert sorted(bytes(r).decode() for r in sp) == ["1", "2", "3"]
 
 
+def test_directory_hidden_file_skip_is_logged(tmp_path, caplog):
+    """The '.'/'_' hidden-file filter (a documented deviation from the
+    reference, which reads those entries) must announce what it dropped
+    — silent data loss on migrated datasets is the failure mode."""
+    import logging
+
+    d = tmp_path / "dir"
+    d.mkdir()
+    (d / "a.txt").write_bytes(b"1\n")
+    (d / "_SUCCESS").write_bytes(b"marker\n")
+    (d / ".part.tmp.123").write_bytes(b"partial\n")
+    caplog.set_level(logging.INFO, logger="dmlc_tpu.io")
+    sp = isplit.create(str(d), 0, 1, "text", threaded=False)
+    assert [bytes(r).decode() for r in sp] == ["1"]
+    msgs = [r.message for r in caplog.records if "hidden" in r.message]
+    assert msgs, "hidden-file skip was not logged"
+    assert "_SUCCESS" in msgs[0] and ".part.tmp.123" in msgs[0]
+    assert "2" in msgs[0]  # the count
+
+
 def test_regex_uri(tmp_path):
     d = tmp_path / "rx"
     d.mkdir()

@@ -1,0 +1,628 @@
+"""Resilience layer tests: RetryPolicy backoff/deadline/classification,
+FaultInjector spec semantics, the unified retry wiring in the REST
+backends, the S3/HDFS crash-window fixes, tracker failure detection +
+replacement re-admission, client timeouts, and the launcher restart
+budget (including the full fault-injected chaos smoke)."""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+
+import pytest
+
+from dmlc_tpu import telemetry
+from dmlc_tpu.base import DMLCError
+from dmlc_tpu.resilience import (
+    FaultInjected,
+    FaultInjector,
+    RetryPolicy,
+    default_retryable,
+    fault_point,
+    install_injector,
+    reset_injector,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    reset_injector()
+    yield
+    reset_injector()
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+def test_backoff_sequence_exponential_and_capped():
+    p = RetryPolicy(attempts=6, base_s=0.25, multiplier=2.0, max_s=1.0,
+                    jitter=0.0)
+    assert [p.delay(i) for i in range(5)] == [0.25, 0.5, 1.0, 1.0, 1.0]
+
+
+def test_jitter_bounded():
+    p = RetryPolicy(base_s=1.0, jitter=0.5)
+    for i in range(50):
+        assert 1.0 <= p.delay(0) <= 1.5
+
+
+def test_retries_transient_then_succeeds():
+    sleeps = []
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("torn")
+        return "ok"
+
+    p = RetryPolicy(attempts=4, base_s=0.01, jitter=0.0,
+                    sleep=sleeps.append)
+    assert p.call(flaky) == "ok"
+    assert len(calls) == 3 and len(sleeps) == 2
+
+
+def test_exhausts_attempts_raises_last_error():
+    p = RetryPolicy(attempts=3, base_s=0.0, jitter=0.0, sleep=lambda _: None)
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise ConnectionError("still torn")
+
+    with pytest.raises(ConnectionError, match="still torn"):
+        p.call(always)
+    assert len(calls) == 3
+
+
+def test_permanent_errors_raise_immediately():
+    p = RetryPolicy(attempts=5, sleep=lambda _: None)
+    for exc in (ValueError("nope"), FileNotFoundError("gone"),
+                DMLCError("denied", status=403),
+                DMLCError("flagged", transient=False)):
+        calls = []
+
+        def once(e=exc):
+            calls.append(1)
+            raise e
+
+        with pytest.raises(type(exc)):
+            p.call(once)
+        assert len(calls) == 1, exc
+
+
+def test_deadline_stops_retrying():
+    p = RetryPolicy(attempts=10, base_s=5.0, jitter=0.0, deadline_s=1.0,
+                    sleep=lambda _: None)
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise ConnectionError("x")
+
+    # first backoff (5s) would blow the 1s deadline: no retry happens
+    with pytest.raises(ConnectionError):
+        p.call(always)
+    assert len(calls) == 1
+
+
+def test_classification():
+    assert default_retryable(ConnectionRefusedError())
+    assert default_retryable(socket.timeout())
+    assert default_retryable(urllib.error.URLError("dns"))
+    assert default_retryable(DMLCError("x", status=503))
+    assert default_retryable(DMLCError("x", transient=True))
+    assert default_retryable(FaultInjected("chaos"))
+    assert not default_retryable(DMLCError("x", status=404))
+    assert not default_retryable(PermissionError())
+    assert not default_retryable(KeyError("x"))
+
+
+def test_retry_counters_reach_telemetry():
+    telemetry.reset()
+    p = RetryPolicy(attempts=3, base_s=0.0, jitter=0.0,
+                    sleep=lambda _: None, name="unittest")
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] < 3:
+            raise ConnectionError("x")
+
+    p.call(flaky)
+    counters = telemetry.counters_snapshot()["resilience"]
+    assert counters["retries"] == 2
+    assert counters["retries_unittest"] == 2
+
+
+def test_from_env_reads_knobs(monkeypatch):
+    monkeypatch.setenv("DMLC_S3_RETRIES", "7")
+    monkeypatch.setenv("DMLC_RETRY_MAX_S", "2.5")
+    monkeypatch.setenv("DMLC_RETRY_DEADLINE_S", "9")
+    p = RetryPolicy.from_env(retries_env="DMLC_S3_RETRIES")
+    assert p.attempts == 7 and p.max_s == 2.5 and p.deadline_s == 9.0
+
+
+# ---------------------------------------------------------------------------
+# FaultInjector
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_counts_down():
+    inj = FaultInjector("a.b=error:boom:2")
+    for _ in range(2):
+        with pytest.raises(FaultInjected, match="boom"):
+            inj.fire("a.b")
+    inj.fire("a.b")  # disarmed: no raise
+    inj.fire("other.site")  # never armed
+
+
+def test_fault_spec_predicates():
+    inj = FaultInjector("barrier.x@rank:1@attempt:0=error")
+    inj.fire("barrier.x", rank=0, attempt=0)  # wrong rank: no fire
+    inj.fire("barrier.x", rank=1, attempt=1)  # wrong attempt: no fire
+    with pytest.raises(FaultInjected):
+        inj.fire("barrier.x", rank=1, attempt=0)
+
+
+def test_fault_spec_unlimited_and_delay():
+    inj = FaultInjector("slow.site=delay:0.01:*")
+    t0 = time.monotonic()
+    inj.fire("slow.site")
+    inj.fire("slow.site")
+    assert time.monotonic() - t0 >= 0.02
+
+
+def test_fault_corrupt_flips_bytes():
+    inj = FaultInjector("storage.response=corrupt")
+    data = bytes(range(32))
+    bad = inj.corrupt("storage.response", data)
+    assert bad != data and len(bad) == len(data)
+    assert bad[8:] == data[8:]  # only a prefix is flipped
+    # disarmed after one firing
+    assert inj.corrupt("storage.response", data) == data
+
+
+def test_fault_spec_parse_errors():
+    for bad in ("nonsense", "site=explode", "a@b=error"):
+        with pytest.raises(ValueError):
+            FaultInjector(bad)
+
+
+def test_fault_point_tracks_env(monkeypatch):
+    monkeypatch.setenv("DMLC_FAULT_SPEC", "env.site=error")
+    with pytest.raises(FaultInjected):
+        fault_point("env.site")
+    monkeypatch.setenv("DMLC_FAULT_SPEC", "")
+    fault_point("env.site")  # spec cleared: no fire
+
+
+def test_install_injector_pins_over_env(monkeypatch):
+    monkeypatch.setenv("DMLC_FAULT_SPEC", "env.site=error")
+    install_injector("pinned.site=error")
+    fault_point("env.site")  # env spec ignored while pinned
+    with pytest.raises(FaultInjected):
+        fault_point("pinned.site")
+
+
+def test_kill_action_dies_without_cleanup(tmp_path):
+    prog = (
+        "import atexit, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from dmlc_tpu.resilience import fault_point\n"
+        "atexit.register(lambda: print('atexit-ran'))\n"
+        "fault_point('die.here')\n"
+        "print('survived')\n"
+    )
+    env = os.environ.copy()
+    env["DMLC_FAULT_SPEC"] = "die.here=kill:9"
+    r = subprocess.run([sys.executable, "-c", prog], env=env,
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode == 9
+    assert "survived" not in r.stdout and "atexit-ran" not in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# rest_request through the unified policy
+# ---------------------------------------------------------------------------
+
+def test_rest_request_retries_injected_faults(monkeypatch):
+    from dmlc_tpu.io import rest
+
+    class FakeResp:
+        status = 200
+
+    monkeypatch.setattr("urllib.request.urlopen",
+                        lambda req, timeout=None: FakeResp())
+    monkeypatch.setenv("DMLC_FAULT_SPEC", "svc.request=error::2")
+    telemetry.reset()
+    monkeypatch.setenv("DMLC_RETRY_MAX_S", "0.01")
+    resp = rest.rest_request("SVC", "http://x/y", "GET",
+                             retries_env="DMLC_TEST_RETRIES")
+    assert resp.status == 200
+    counters = telemetry.counters_snapshot()["resilience"]
+    assert counters["retries_svc"] == 2
+    assert counters["faults_injected"] == 2
+
+
+def test_rest_request_gives_up_on_permanent(monkeypatch):
+    from dmlc_tpu.io import rest
+
+    calls = []
+
+    def deny(req, timeout=None):
+        calls.append(1)
+        raise urllib.error.HTTPError(req.full_url, 403, "denied", {}, None)
+
+    monkeypatch.setattr("urllib.request.urlopen", deny)
+    with pytest.raises(DMLCError) as ei:
+        rest.rest_request("SVC", "http://x/y", "GET")
+    assert ei.value.status == 403
+    assert len(calls) == 1  # permanent: no blind resend
+
+
+def test_storage_response_corruption_hits_reads(monkeypatch):
+    from dmlc_tpu.io.http_filesys import HttpReadStream
+
+    payload = b"A" * 64
+
+    class S(HttpReadStream):
+        def __init__(self):
+            super().__init__("http://x", size=len(payload))
+
+        def _fill(self, start, size):
+            return payload[start:start + size]
+
+    assert S().read(64) == payload
+    install_injector("storage.response=corrupt")
+    assert S().read(64) != payload
+
+
+# ---------------------------------------------------------------------------
+# satellite fixes: S3 Complete-retry 404, HDFS overwrite backup
+# ---------------------------------------------------------------------------
+
+def _s3_stream_with_parts(monkeypatch, complete_behavior, head_len):
+    from dmlc_tpu.io import s3_filesys
+
+    log = []
+
+    class Resp:
+        def __init__(self, headers=None, body=b"<x><UploadId>u1</UploadId></x>"):
+            self.headers = headers or {}
+            self._body = body
+
+        def read(self):
+            return self._body
+
+    def fake_request(url, method="GET", data=None, headers=None, ok=()):
+        log.append((method, url.split("?")[-1][:20]))
+        if "?uploads=" in url:
+            return Resp()
+        if "partNumber=" in url:
+            return Resp(headers={"ETag": f"e{len(log)}"})
+        if method == "POST" and "uploadId=" in url:
+            return complete_behavior()
+        if method == "HEAD":
+            return Resp(headers={"Content-Length": str(head_len)})
+        if method == "DELETE":
+            log.append(("ABORT", ""))
+            return Resp()
+        raise AssertionError(f"unexpected {method} {url}")
+
+    monkeypatch.setattr(s3_filesys, "_request", fake_request)
+    monkeypatch.setenv("DMLC_S3_WRITE_BUFFER_MB", "1")
+    s = s3_filesys.S3WriteStream("http://bucket/key")
+    s._part = 4  # tiny parts without 5 MiB buffers
+    s.write(b"abcdefgh")  # two parts committed
+    return s, log
+
+
+def test_s3_complete_404_after_committed_object_is_success(monkeypatch):
+    def complete():
+        raise DMLCError("NoSuchUpload", status=404)
+
+    s, log = _s3_stream_with_parts(monkeypatch, complete, head_len=8)
+    s.close()  # must NOT raise: HEAD says the 8 bytes are all there
+    assert ("ABORT", "") not in log
+
+
+def test_s3_complete_404_with_missing_object_still_fails(monkeypatch):
+    def complete():
+        raise DMLCError("NoSuchUpload", status=404)
+
+    # HEAD reports the wrong size: the commit did NOT happen
+    s, log = _s3_stream_with_parts(monkeypatch, complete, head_len=3)
+    with pytest.raises(DMLCError, match="NoSuchUpload"):
+        s.close()
+    assert ("ABORT", "") in log  # upload aborted on genuine failure
+
+
+def test_hdfs_overwrite_backs_up_old_version(monkeypatch):
+    import json as _json
+
+    from dmlc_tpu.io import hdfs_filesys
+
+    ops = []
+
+    class Resp:
+        def __init__(self, body):
+            self._body = body
+
+        def read(self):
+            return self._body
+
+    def fake_request(url, method, data=None, ok=(), retry=False):
+        from urllib.parse import unquote
+
+        q = dict(p.split("=", 1) for p in url.split("?", 1)[1].split("&")
+                 if "=" in p)
+        path = unquote(url.split("?")[0].split("/webhdfs/v1", 1)[1])
+        op = q["op"]
+        ops.append((op, path, unquote(q.get("destination", ""))))
+        if op == "RENAME":
+            # refuse only temp -> destination while the destination
+            # still exists (i.e. before the backup rename happened)
+            dest = unquote(q["destination"])
+            exists = not any(o == "RENAME" and d.startswith("/d/.f.old")
+                             for o, _p, d in ops[:-1])
+            if dest == "/d/f" and exists:
+                return Resp(_json.dumps({"boolean": False}).encode())
+            return Resp(_json.dumps({"boolean": True}).encode())
+        return Resp(b"{}")
+
+    monkeypatch.setattr(hdfs_filesys, "_request", fake_request)
+    monkeypatch.setattr(hdfs_filesys, "_write_op",
+                        lambda url, method, body, ok: None)
+    s = hdfs_filesys.WebHdfsWriteStream("http://nn:9870", "/d/f")
+    s.write(b"new contents")
+    s.close()
+    renames = [(p, d) for o, p, d in ops if o == "RENAME"]
+    # 1: temp -> dest (refused), 2: dest -> .f.old backup,
+    # 3: temp -> dest (succeeds)
+    assert renames[0][1] == "/d/f"
+    assert renames[1][0] == "/d/f" and renames[1][1].startswith("/d/.f.old")
+    assert renames[2][1] == "/d/f" and renames[2][0].startswith("/d/.f.tmp")
+    # the backup is garbage-collected afterwards
+    deletes = [p for o, p, _d in ops if o == "DELETE"]
+    assert any(p.startswith("/d/.f.old") for p in deletes)
+
+
+# ---------------------------------------------------------------------------
+# tracker failure detection + client timeouts
+# ---------------------------------------------------------------------------
+
+def test_tracker_declares_dead_after_miss_window():
+    from dmlc_tpu.tracker.rendezvous import RabitTracker
+
+    telemetry.reset()
+    tracker = RabitTracker("127.0.0.1", 1, miss_window_s=0.4)
+    tracker.start(1)
+    try:
+        tracker.telemetry.update(0, {"counters": {}})  # one heartbeat
+        deadline = time.time() + 5
+        while 0 not in tracker.dead_ranks and time.time() < deadline:
+            time.sleep(0.05)
+        assert 0 in tracker.dead_ranks
+        counters = telemetry.counters_snapshot()["resilience"]
+        assert counters["worker_declared_dead"] == 1
+        assert tracker.telemetry.healthz()["dead_ranks"] == [0]
+    finally:
+        tracker.close()
+
+
+def test_tracker_readmits_replacement_after_death():
+    """Heartbeat stops -> rank declared dead -> a replacement worker
+    re-admitted under the same rank (job map) clears the flag and
+    counts as a readmission."""
+    from dmlc_tpu.tracker.client import TrackerClient
+    from dmlc_tpu.tracker.rendezvous import RabitTracker
+
+    telemetry.reset()
+    tracker = RabitTracker("127.0.0.1", 1, miss_window_s=0.4)
+    tracker.start(1)
+    c = TrackerClient("127.0.0.1", tracker.port, jobid="j0")
+    c.start()
+    assert c.rank == 0
+    tracker.telemetry.update(0, {"counters": {}})
+    deadline = time.time() + 5
+    while 0 not in tracker.dead_ranks and time.time() < deadline:
+        time.sleep(0.05)
+    assert 0 in tracker.dead_ranks
+    # the "replacement": same jobid, fresh process in real life
+    c2 = TrackerClient("127.0.0.1", tracker.port, jobid="j0")
+    c2.start()
+    assert c2.rank == 0
+    deadline = time.time() + 5
+    while 0 in tracker.dead_ranks and time.time() < deadline:
+        time.sleep(0.05)
+    assert 0 not in tracker.dead_ranks
+    counters = telemetry.counters_snapshot()["resilience"]
+    assert counters["worker_readmitted"] == 1
+    c2.shutdown()
+    tracker.join(timeout=15)
+    tracker.close()
+
+
+def test_clean_shutdown_rank_never_declared_dead():
+    """A rank that heartbeated and then finished CLEANLY (sent
+    'shutdown') goes silent forever — the failure detector must not
+    flag it while the rest of the job keeps running."""
+    from dmlc_tpu.tracker.client import TrackerClient
+    from dmlc_tpu.tracker.rendezvous import RabitTracker
+
+    telemetry.reset()
+    tracker = RabitTracker("127.0.0.1", 2, miss_window_s=0.3)
+    tracker.start(2)
+    clients = []
+
+    def join_worker(i):
+        c = TrackerClient("127.0.0.1", tracker.port, jobid=f"cs{i}")
+        c.start()
+        clients.append(c)
+
+    threads = [threading.Thread(target=join_worker, args=(i,))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    finisher = clients[0]
+    finisher.send_metrics('{"counters": {}}')  # it IS on the watch list
+    finisher.shutdown()
+    # 4x the miss window with the job still running; the survivor keeps
+    # heartbeating (silence would make IT legitimately declared dead)
+    for _ in range(8):
+        clients[1].send_metrics('{"counters": {}}')
+        time.sleep(0.15)
+    assert tracker.dead_ranks == set()
+    counters = telemetry.counters_snapshot().get("resilience", {})
+    assert counters.get("worker_declared_dead", 0) == 0
+    clients[1].shutdown()
+    tracker.join(timeout=15)
+    tracker.close()
+
+
+def test_tracker_metrics_include_local_resilience_counters():
+    from dmlc_tpu.tracker.rendezvous import RabitTracker
+
+    telemetry.reset()
+    telemetry.inc("resilience", "task_restarts")
+    tracker = RabitTracker("127.0.0.1", 1)
+    try:
+        text = tracker.telemetry.prometheus_text()
+        assert 'dmlc_resilience_task_restarts{rank="tracker"} 1' in text
+    finally:
+        tracker.close()
+
+
+def test_client_dead_tracker_fails_fast_with_backoff(monkeypatch):
+    from dmlc_tpu.tracker.client import TrackerClient
+
+    monkeypatch.setenv("DMLC_CLIENT_RETRIES", "2")
+    monkeypatch.setenv("DMLC_CLIENT_RETRY_BASE_S", "0.01")
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_port = probe.getsockname()[1]
+    probe.close()  # nothing listens here now
+    telemetry.reset()
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        TrackerClient("127.0.0.1", dead_port)._dial()
+    assert time.monotonic() - t0 < 10
+    assert telemetry.counters_snapshot()["resilience"]["retries"] == 1
+
+
+def test_client_silent_tracker_times_out(monkeypatch):
+    from dmlc_tpu.tracker.client import TrackerClient
+
+    monkeypatch.setenv("DMLC_CLIENT_RETRIES", "1")
+    monkeypatch.setenv("DMLC_CLIENT_OP_TIMEOUT_S", "0.5")
+    silent = socket.socket()
+    silent.bind(("127.0.0.1", 0))
+    silent.listen(1)  # accepts but never answers the magic
+    try:
+        with pytest.raises(OSError):
+            TrackerClient("127.0.0.1", silent.getsockname()[1])._dial()
+    finally:
+        silent.close()
+
+
+# ---------------------------------------------------------------------------
+# launcher restart budget
+# ---------------------------------------------------------------------------
+
+def test_max_restarts_opt_maps_to_attempts():
+    from dmlc_tpu.tracker.opts import get_opts
+
+    args = get_opts(["--cluster", "local", "--num-workers", "1",
+                     "--max-restarts", "5", "--", "true"])
+    assert args.max_attempts == 6
+    args = get_opts(["--cluster", "local", "--num-workers", "1",
+                     "--max-restarts", "0", "--", "true"])
+    assert args.max_attempts == 1
+    args = get_opts(["--cluster", "local", "--num-workers", "1",
+                     "--max-attempts", "4", "--", "true"])
+    assert args.max_attempts == 4  # legacy knob untouched
+
+
+def test_gang_scheduler_counts_restarts_and_blacklists():
+    from dmlc_tpu.tracker import launch
+
+    telemetry.reset()
+    calls = []
+
+    def runner(host, role, task_id, env):
+        calls.append(host)
+        return 1 if host == "bad" else 0
+
+    sched = launch.GangScheduler(["bad", "good"], runner,
+                                 max_attempts=3, blacklist_after=2)
+    sched.run_all(n_workers=2, n_servers=0,
+                  envs={"DMLC_TRACKER_URI": "x", "DMLC_TRACKER_PORT": "1"},
+                  cluster="tpu-vm")
+    counters = telemetry.counters_snapshot()["resilience"]
+    assert counters["task_restarts"] >= 1
+    assert counters["hosts_blacklisted"] == 1
+    assert "bad" in sched.blacklist
+
+
+def test_gang_scheduler_budget_exhaustion_counted():
+    from dmlc_tpu.tracker import launch
+
+    telemetry.reset()
+    sched = launch.GangScheduler(["h0"], lambda *a: 1,
+                                 max_attempts=2, blacklist_after=99)
+    with pytest.raises(RuntimeError, match="after 2 attempts"):
+        sched.run_task("worker", 0, {}, "tpu-vm")
+    counters = telemetry.counters_snapshot()["resilience"]
+    assert counters["task_restarts"] == 1
+    assert counters["task_budget_exhausted"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the full chain: fault-injected death -> detection -> restart -> recover
+# ---------------------------------------------------------------------------
+
+def test_chaos_smoke_end_to_end():
+    """Runs scripts/chaos_smoke.py (ci.sh stage 7) as a subprocess: a
+    fault-injected kill of rank 1 at a barrier must end in a completed
+    job with death/restart/readmission all visible on /metrics."""
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("DMLC_FAULT_SPEC", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "chaos_smoke.py")],
+        capture_output=True, text=True, timeout=150, env=env)
+    assert r.returncode == 0, (r.stdout[-3000:], r.stderr[-3000:])
+    assert "chaos smoke OK" in r.stdout
+
+
+def test_recover_after_timeout_flagged_peer():
+    """A peer socket that times out (not just closes) must surface as
+    OSError so the recover path catches it — socket.timeout IS an
+    OSError; guard the contract the chaos path relies on."""
+    assert issubclass(socket.timeout, OSError)
+    assert issubclass(FaultInjected, OSError)
+
+
+def test_threads_dont_leak_from_failure_detector():
+    from dmlc_tpu.tracker.rendezvous import RabitTracker
+
+    before = threading.active_count()
+    tracker = RabitTracker("127.0.0.1", 1, miss_window_s=0.2)
+    tracker.start(1)
+    tracker.close()
+    deadline = time.time() + 5
+    while threading.active_count() > before + 1 and time.time() < deadline:
+        time.sleep(0.05)
+    # accept thread may linger on its dying socket; the monitor must be
+    # gone (stop event set by close)
+    assert not any(t.name == "tracker-failure-detector" and t.is_alive()
+                   and not tracker._monitor_stop.is_set()
+                   for t in threading.enumerate())
