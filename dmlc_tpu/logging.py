@@ -84,6 +84,13 @@ def log(level: str, msg: str) -> None:
     # never reached the installed sink at all
     _emit(_format(level, msg))
     if level == "FATAL":
+        # a FATAL is this process's last words: when DMLC_POSTMORTEM_DIR
+        # is configured, dump the flight record (snapshot + open spans +
+        # event tail) before the raise unwinds anything (no-op + never
+        # raises otherwise — dying must not become hanging)
+        from .telemetry import postmortem
+
+        postmortem.dump(f"FATAL: {msg}")
         raise DMLCError(msg)
 
 

@@ -193,6 +193,9 @@ def block_attend_flash(q, k, v, *, scale: float, causal: bool,
     _block_attend in ring_attention.  Differentiable: the forward runs
     the Pallas kernel, the backward rematerializes through the lax twin.
     """
+    from .. import telemetry
+
+    telemetry.inc("flash", "ring_step_calls")
     qoff = jnp.asarray(q_offset, jnp.int32).reshape(1)
     kvoff = jnp.asarray(kv_offset, jnp.int32).reshape(1)
     static = (float(scale), bool(causal), int(block_q), int(block_k),
@@ -578,9 +581,26 @@ def flash_attention(q, k, v, *, causal: bool = True,
     """
     import os
 
-    d = q.shape[-1]
+    from .. import telemetry
+
+    b, tq, h, d = q.shape
+    tk = k.shape[1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
+    # trace-time accounting: attention FLOPs are static in the shapes
+    # (2 matmuls of [tq,tk]x[tk,d] per head; causal halves the visited
+    # area), so the counter is exact per compiled call — MFU math reads
+    # it straight off /metrics without re-deriving shapes
+    flops = 4.0 * b * h * tq * tk * d * (0.5 if causal else 1.0)
+    telemetry.inc("flash", "fwd_calls")
+    telemetry.inc("flash", "fwd_flops", flops)
+    telemetry.observe("flash", "seq_len_q", float(tq),
+                      bounds=tuple(float(2 ** i) for i in range(22)))
+    with telemetry.span("flash_attention.trace", stage="flash",
+                        args={"b": int(b), "t_q": int(tq), "t_kv": int(tk),
+                              "heads": int(h), "d": int(d),
+                              "causal": bool(causal)}):
+        pass
     # explicit caller blocks bind BOTH passes (a caller sizing for VMEM
     # must not get surprise-larger backward tiles); env/defaults fill
     # whatever remains

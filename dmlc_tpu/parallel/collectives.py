@@ -21,7 +21,42 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import telemetry
+
 AxisName = Union[str, Sequence[str]]
+
+# payload-size buckets for collective byte histograms: 64 B .. 8 GB
+# (doubling) — latency buckets would be useless here, the wrappers run
+# at TRACE time (see _note below)
+BYTE_BOUNDS = tuple(64.0 * 2.0 ** i for i in range(28))
+
+
+def _note(op: str, x, axis) -> None:
+    """Telemetry for one collective call site.
+
+    These wrappers execute while XLA TRACES the enclosing program (the
+    device-side op runs later, inside the compiled step, where Python
+    cannot observe it) — so what is knowable and recorded here is the
+    static story: which collectives the program uses, over which axis,
+    moving how many bytes per call.  That is exactly what the byte
+    histograms and the per-op counters carry; wall-time skew between
+    ranks comes from the host-side spans (TrackerClient collectives,
+    feed/step spans) on the tracker's corrected /trace timeline, not
+    from timing traced code."""
+    try:
+        nbytes = float(x.size * x.dtype.itemsize)
+    except (AttributeError, TypeError):
+        return  # abstract tracer without static shape: nothing to record
+    telemetry.inc("collective", f"{op}_calls")
+    telemetry.inc("collective", f"{op}_bytes", nbytes)
+    telemetry.observe("collective", f"{op}_bytes_per_call", nbytes,
+                      bounds=BYTE_BOUNDS)
+    # a trace-time marker span: args carry the op/axis/byte tags so the
+    # merged timeline shows WHAT was being traced/compiled when
+    with telemetry.span(f"collective.{op}.trace", stage="collective",
+                        args={"op": op, "axis": str(axis),
+                              "bytes": int(nbytes)}):
+        pass
 
 
 def axis_size(axis: AxisName) -> int:
@@ -40,6 +75,7 @@ def all_reduce(x, axis: AxisName, op: str = "sum"):
     The TPU-native analog of rabit's tree+ring Allreduce; XLA emits the
     ICI-optimal reduction, no overlay required.
     """
+    _note("all_reduce", x, axis)
     if op == "sum":
         return lax.psum(x, axis)
     if op == "max":
@@ -53,11 +89,13 @@ def all_reduce(x, axis: AxisName, op: str = "sum"):
 
 def all_gather(x, axis: AxisName, *, tiled: bool = True, gather_axis: int = 0):
     """Gather shards along ``axis``; tiled=True concatenates on gather_axis."""
+    _note("all_gather", x, axis)
     return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis: AxisName, *, scatter_axis: int = 0, tiled: bool = True):
     """Reduce-scatter: psum then keep this rank's shard of ``scatter_axis``."""
+    _note("reduce_scatter", x, axis)
     return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=tiled)
 
 
@@ -66,6 +104,7 @@ def broadcast(x, axis: AxisName, root: int = 0):
     # Select root's contribution and sum: zero elsewhere.  XLA folds this
     # into an efficient broadcast; avoids gather-then-index materialising
     # the full world.
+    _note("broadcast", x, axis)
     is_root = lax.axis_index(axis) == root
     contrib = jnp.where(is_root, x, jnp.zeros_like(x))
     return lax.psum(contrib, axis)
@@ -78,6 +117,7 @@ def ppermute_ring(x, axis: AxisName, shift: int = 1):
     replaces the reference tracker's explicitly-computed ring
     (tracker.py:193-225) with a compiler-lowered neighbour exchange.
     """
+    _note("ppermute", x, axis)
     n = lax.axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
@@ -89,6 +129,7 @@ def all_to_all(x, axis: AxisName, *, split_axis: int, concat_axis: int, tiled: b
     Used for Ulysses-style sequence↔head re-sharding and MoE token
     routing.
     """
+    _note("all_to_all", x, axis)
     return lax.all_to_all(
         x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled
     )
@@ -113,6 +154,7 @@ def match_vma(x, ref):
 
 def barrier_sum(axis: AxisName):
     """A cheap synchronisation point: psum of a scalar 1 (returns world size)."""
+    telemetry.inc("collective", "barrier_sum_calls")
     return lax.psum(jnp.ones((), jnp.int32), axis)
 
 

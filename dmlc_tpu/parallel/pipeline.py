@@ -35,12 +35,26 @@ def pipeline_spmd(
     Returns [M, mb, ...] outputs, valid on every rank (broadcast from the
     last stage).
     """
+    from .. import telemetry
     from .collectives import match_vma
 
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     m = x_microbatches.shape[0]
     total = m + n - 1
+    # the GPipe bubble is fully determined by the schedule: each stage
+    # idles n-1 of the m+n-1 steps (warmup on early ranks, drain on late
+    # ones).  Recorded at trace time — the device-side fori_loop is
+    # opaque to Python — so the gauges describe the COMPILED schedule;
+    # multiply bubble_fraction by measured step wall time (train.step
+    # histograms) for bubble seconds per step.
+    telemetry.inc("pipeline", "runs_traced")
+    telemetry.set_gauge("pipeline", "stages", n)
+    telemetry.set_gauge("pipeline", "microbatches", m)
+    telemetry.set_gauge("pipeline", "bubble_steps_per_stage", n - 1)
+    telemetry.set_gauge("pipeline", "bubble_fraction",
+                        (n - 1) / total if total else 0.0)
+    telemetry.observe("pipeline", "microbatches_per_run", float(m))
     # carries vary over the input's axes AND pp (my-dependent writes,
     # ppermuted state): match x's vma then add pp via `my`, which is
     # already pp-varying — keeping match_vma's version-compat guard.
@@ -72,17 +86,34 @@ def pipeline_spmd(
 
 
 def make_pipeline(mesh, stage_fn, *, axis_name: str = "pp"):
-    """shard_map wrapper: params stacked on leading stage axis, sharded pp."""
+    """shard_map wrapper: params stacked on leading stage axis, sharded pp.
+
+    The returned callable is span-wrapped (``pipeline.run``, tagged with
+    stage count and microbatch count): host-side dispatch of each
+    pipelined step lands on the flight-recorder timeline even though the
+    stage loop itself runs device-side.
+    """
     from jax.sharding import PartitionSpec as P
+
+    from .. import telemetry
 
     def inner(params_stacked, x_mb):
         local = jax.tree.map(lambda p: p[0], params_stacked)
         return pipeline_spmd(stage_fn, local, x_mb, axis_name=axis_name)
 
-    return jax.shard_map(
+    mapped = jax.shard_map(
         inner,
         mesh=mesh,
         in_specs=(P(axis_name), P()),
         out_specs=P(),
         check_vma=False,
     )
+    n_stages = int(mesh.shape[axis_name])
+
+    def run(params_stacked, x_mb):
+        with telemetry.span("pipeline.run", stage="pipeline",
+                            args={"stages": n_stages,
+                                  "microbatches": int(x_mb.shape[0])}):
+            return mapped(params_stacked, x_mb)
+
+    return run

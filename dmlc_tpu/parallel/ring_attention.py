@@ -63,7 +63,24 @@ def ring_attention(
     if scale is None:
         scale = 1.0 / (d ** 0.5)
 
+    from .. import telemetry
     from ..ops import flash_attention as _flash
+
+    # trace-time accounting (the ring loop runs device-side): each of
+    # the n ring steps rotates the full local K+V block over ICI, so
+    # bytes_rotated = 2 * |k| * (n - 1) per call — the DCN/ICI budget a
+    # capacity planner reads off /metrics
+    kv_bytes = float(2 * k.size * k.dtype.itemsize)
+    telemetry.inc("ring_attention", "calls")
+    telemetry.inc("ring_attention", "bytes_rotated",
+                  kv_bytes * max(0, n - 1))
+    telemetry.observe("ring_attention", "kv_block_bytes", kv_bytes,
+                      bounds=tuple(64.0 * 2.0 ** i for i in range(28)))
+    with telemetry.span("ring_attention.trace", stage="ring",
+                        args={"steps": int(n), "t_local": int(t_local),
+                              "heads": int(h), "kv_block_bytes":
+                              int(kv_bytes), "impl": impl}):
+        pass
 
     interpret = False
     if impl == "auto":
@@ -155,13 +172,26 @@ def ring_attention_reference(q, k, v, *, causal: bool = True, scale=None):
 
 def make_sharded_ring_attention(mesh, *, causal: bool = True,
                                 impl: str = "auto"):
-    """Wrap ring_attention in shard_map over (sp sequence, tp heads)."""
+    """Wrap ring_attention in shard_map over (sp sequence, tp heads).
+
+    The returned callable is span-wrapped (``ring_attention.run``) so
+    host-side dispatch shows on the flight-recorder timeline."""
     from jax.sharding import PartitionSpec as P
+
+    from .. import telemetry
 
     spec = P(None, "sp", "tp", None)
     fn = functools.partial(ring_attention, axis_name="sp", causal=causal,
                           impl=impl)
-    return jax.shard_map(
+    mapped = jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,
     )
+    sp = int(mesh.shape["sp"])
+
+    def run(q, k, v):
+        with telemetry.span("ring_attention.run", stage="ring",
+                            args={"sp": sp, "t": int(q.shape[1])}):
+            return mapped(q, k, v)
+
+    return run

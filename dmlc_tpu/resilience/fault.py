@@ -155,6 +155,9 @@ class FaultInjector:
         from .. import telemetry
 
         telemetry.inc("resilience", "faults_injected")
+        telemetry.record_event("fault_injected", site=site,
+                               action=r.action,
+                               **{k: str(v) for k, v in ctx.items()})
         logger.warning("fault injection: %s at %s ctx=%s", r.action, site, ctx)
         if r.action == "delay":
             time.sleep(float(r.arg) if r.arg else 0.1)
@@ -163,7 +166,11 @@ class FaultInjector:
                 f"fault injected at {site}" + (f": {r.arg}" if r.arg else ""))
         elif r.action == "kill":
             # die the way a preempted host dies: no cleanup, no
-            # shutdown handshake, no atexit — peers see a dropped link
+            # shutdown handshake, no atexit — peers see a dropped link.
+            # A real SIGKILL is unhookable, so the injector writes the
+            # postmortem itself: this dump IS the simulated-preemption
+            # flight record the chaos harness asserts on.
+            telemetry.postmortem.dump(f"fault.kill at {site}")
             logging.shutdown()
             os._exit(int(r.arg) if r.arg else 137)
 
@@ -222,7 +229,15 @@ def reset_injector() -> None:
 
 def fault_point(site: str, **ctx) -> None:
     """Instrumented-site hook: fires any armed error/delay/kill rule.
-    Near-free when no spec is armed."""
+    Near-free when no spec is armed.  ``barrier.*`` sites additionally
+    land in the structured event log — barrier entries are exactly the
+    "where was everyone" markers a crash postmortem reads, and they are
+    control-plane-rare by construction."""
+    if site.startswith("barrier."):
+        from .. import telemetry
+
+        telemetry.record_event("barrier_enter", site=site,
+                               **{k: str(v) for k, v in ctx.items()})
     inj = get_injector()
     if inj.enabled:
         inj.fire(site, **ctx)

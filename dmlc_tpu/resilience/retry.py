@@ -145,6 +145,9 @@ class RetryPolicy:
             telemetry.inc("resilience", f"retries_{self.name}")
         if error is not None:
             telemetry.inc("resilience", "retryable_errors")
+        telemetry.record_event(
+            "retry", policy=self.name or "anonymous",
+            error=repr(error) if error is not None else None)
 
     # ---- the loop -------------------------------------------------------
     def call(self, fn: Callable, on_retry: Optional[Callable] = None):

@@ -9,8 +9,16 @@ counters, which remains as a compatible shim over this package):
   * ``exporters``  Chrome trace-event JSON (Perfetto-loadable),
                    Prometheus text exposition, JSON snapshot embedding
   * ``heartbeat``  worker heartbeats over the rendezvous protocol,
-                   tracker-side aggregation, /metrics + /healthz HTTP,
-                   straggler flagging
+                   tracker-side aggregation, /metrics + /healthz +
+                   /trace HTTP, straggler flagging
+  * ``clock``      NTP-style per-rank clock-offset estimation (one
+                   cluster timeline from N uncorrected wall clocks)
+  * ``flight``     tracker-side flight recorder: per-rank span store,
+                   clock-corrected merged Chrome trace (/trace)
+  * ``events``     bounded structured event log (retries, faults,
+                   restarts, declared-dead, barrier entries)
+  * ``postmortem`` crash dumps (snapshot + open/last spans + event
+                   tail) to DMLC_POSTMORTEM_DIR on signals/fatals
 
 Typical use::
 
@@ -23,23 +31,41 @@ Typical use::
     open("trace.json", "w").write(telemetry.to_chrome_trace_json())
 """
 
-from . import core, exporters, heartbeat  # noqa: F401
+from . import (  # noqa: F401
+    clock,
+    core,
+    events,
+    exporters,
+    flight,
+    heartbeat,
+    postmortem,
+)
+from .clock import ClockOffsetEstimator  # noqa: F401
 from .core import (  # noqa: F401
     DEFAULT_BOUNDS,
     Histogram,
+    anchor_epoch,
     annotate,
     counters_snapshot,
     inc,
     observe,
     observe_duration,
+    open_spans,
     reset,
     set_gauge,
     snapshot,
     span,
     spans,
+    spans_since,
     timed,
     trace,
 )
+from .events import (  # noqa: F401
+    events_tail,
+    record_event,
+    reset_events,
+)
+from .flight import FlightRecorder  # noqa: F401
 from .exporters import (  # noqa: F401
     export_json,
     to_chrome_trace,
@@ -54,23 +80,31 @@ from .heartbeat import (  # noqa: F401
 )
 
 __all__ = [
+    "ClockOffsetEstimator",
     "DEFAULT_BOUNDS",
     "DEFAULT_STRAGGLER_KEYS",
+    "FlightRecorder",
     "Histogram",
     "HeartbeatSender",
     "TelemetryAggregator",
     "TelemetryHTTPServer",
+    "anchor_epoch",
     "annotate",
     "counters_snapshot",
+    "events_tail",
     "export_json",
     "inc",
     "observe",
     "observe_duration",
+    "open_spans",
+    "record_event",
     "reset",
+    "reset_events",
     "set_gauge",
     "snapshot",
     "span",
     "spans",
+    "spans_since",
     "timed",
     "to_chrome_trace",
     "to_chrome_trace_json",

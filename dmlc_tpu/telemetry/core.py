@@ -44,6 +44,9 @@ __all__ = [
     "timed",
     "span",
     "spans",
+    "spans_since",
+    "open_spans",
+    "anchor_epoch",
     "annotate",
     "trace",
     "snapshot",
@@ -164,8 +167,16 @@ _counters: Dict[str, Dict[str, float]] = defaultdict(lambda: defaultdict(float))
 _gauges: Dict[str, Dict[str, float]] = defaultdict(dict)
 _hists: Dict[str, Dict[str, Histogram]] = defaultdict(dict)
 _spans: deque = deque(maxlen=_MAX_SPANS)
+_span_seq = 0  # monotone id per recorded span (incremental trace shipping)
 _T0 = time.perf_counter()  # session-relative span clock (µs in exports)
+# wall-clock moment of _T0: span ts + _T0_EPOCH places a span on this
+# process's wall clock, which the tracker's per-rank clock offset then
+# maps onto ONE cluster timeline (telemetry.clock / telemetry.flight)
+_T0_EPOCH = time.time()
 _tls = threading.local()
+# tid -> (thread, open-span stack); lets the postmortem dumper see the
+# spans every thread is INSIDE at crash time, not just finished ones
+_open_stacks: Dict[int, tuple] = {}
 
 
 def inc(stage: str, name: str, value: float = 1.0) -> None:
@@ -217,10 +228,13 @@ def timed(stage: str, name: str):
 # span tracer
 # ---------------------------------------------------------------------------
 
-def _span_stack() -> List[str]:
+def _span_stack() -> List[Dict]:
     stack = getattr(_tls, "stack", None)
     if stack is None:
         stack = _tls.stack = []
+        th = threading.current_thread()
+        with _lock:
+            _open_stacks[th.ident] = (th, stack)
     return stack
 
 
@@ -232,9 +246,11 @@ def span(name: str, stage: str = "dmlc", args: Optional[Dict] = None):
     same thread records ``depth`` = enclosing count); Perfetto nests by
     ts/dur containment per tid, so exports render the tree directly.
     """
+    global _span_seq
     stack = _span_stack()
-    stack.append(name)
     t0 = time.perf_counter()
+    stack.append({"name": name, "cat": stage, "ts": (t0 - _T0) * 1e6,
+                  "args": dict(args) if args else None})
     try:
         yield
     finally:
@@ -253,6 +269,8 @@ def span(name: str, stage: str = "dmlc", args: Optional[Dict] = None):
         if args:
             rec["args"] = dict(args)
         with _lock:
+            _span_seq += 1
+            rec["seq"] = _span_seq
             _spans.append(rec)
 
 
@@ -260,6 +278,52 @@ def spans() -> List[Dict]:
     """Copy of the span ring, oldest first."""
     with _lock:
         return list(_spans)
+
+
+def spans_since(after_seq: int, limit: Optional[int] = None) -> tuple:
+    """(new_spans, last_seq): spans recorded after ``after_seq``, oldest
+    first, capped at the OLDEST ``limit`` — a shipper that falls behind
+    catches up over subsequent calls instead of losing the middle.  The
+    incremental-shipping primitive behind HeartbeatSender's trace push:
+    resume from the returned ``last_seq``.  When nothing was truncated,
+    ``last_seq`` is the high-water mark INCLUDING ring-evicted spans,
+    so a slow shipper skips the evicted gap (gone from the ring, not
+    recoverable) rather than resending the whole ring forever; when
+    ``limit`` truncated, it is the last RETURNED span's seq, so the
+    still-retained remainder ships next call."""
+    with _lock:
+        out = [r for r in _spans if r["seq"] > after_seq]
+        last = _span_seq
+    if limit is not None and len(out) > limit:
+        out = out[:limit]
+        last = out[-1]["seq"]
+    return out, last
+
+
+def open_spans() -> List[Dict]:
+    """Spans currently OPEN on any thread (innermost last per thread) —
+    what every thread was doing right now; the postmortem dumper's view
+    of a crashing process."""
+    now_ts = (time.perf_counter() - _T0) * 1e6
+    with _lock:
+        stacks = [(th, list(stack)) for th, stack in _open_stacks.values()]
+    out = []
+    for th, stack in stacks:
+        for depth, rec in enumerate(stack):
+            if not isinstance(rec, dict):  # torn mid-append: skip
+                continue
+            out.append({
+                "name": rec["name"], "cat": rec["cat"], "ts": rec["ts"],
+                "open_us": now_ts - rec["ts"], "tid": th.ident,
+                "thread": th.name, "depth": depth,
+                **({"args": rec["args"]} if rec.get("args") else {}),
+            })
+    return out
+
+
+def anchor_epoch() -> float:
+    """Wall-clock time (time.time) corresponding to span ts == 0."""
+    return _T0_EPOCH
 
 
 _ANNOTATION = False  # False = unresolved; None = jax unavailable
@@ -329,9 +393,13 @@ def snapshot(include_buckets: bool = True) -> Dict:
 
 def reset() -> None:
     """Clear every counter, gauge, histogram, and recorded span
-    (test isolation)."""
+    (test isolation).  Open-span stacks of LIVE threads are left alone
+    (they own their list objects mid-span); dead threads' are pruned."""
     with _lock:
         _counters.clear()
         _gauges.clear()
         _hists.clear()
         _spans.clear()
+        for tid in [t for t, (th, _s) in _open_stacks.items()
+                    if not th.is_alive() and th is not threading.main_thread()]:
+            del _open_stacks[tid]

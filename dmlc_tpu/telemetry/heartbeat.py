@@ -20,12 +20,13 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
-from . import exporters
+from . import core, exporters
 from .core import Histogram
 
 __all__ = [
@@ -91,6 +92,21 @@ def _sanitize(snap: Dict) -> Dict:
     return out
 
 
+def _build_info_line() -> str:
+    """``dmlc_build_info`` gauge: constant 1 with version/platform
+    labels — the standard Prometheus idiom for joining build metadata
+    onto any alert expression."""
+    import platform
+
+    from .. import __version__
+
+    plat = f"{platform.system()}-{platform.machine()}".lower()
+    py = platform.python_version()
+    return ("# TYPE dmlc_build_info gauge\n"
+            f'dmlc_build_info{{version="{__version__}",platform="{plat}",'
+            f'python="{py}"}} 1\n')
+
+
 def _median(vals: List[float]) -> float:
     """Lower median: with an even rank count the smaller middle element
     is the baseline, so an inflated rank cannot drag the comparison
@@ -122,7 +138,11 @@ class TelemetryAggregator:
         self.extra_health = None
         self._lock = threading.Lock()
         self._ranks: Dict[int, Dict] = {}      # rank -> snapshot dict
-        self._seen: Dict[int, float] = {}      # rank -> last heartbeat time
+        # rank -> last heartbeat, on time.monotonic(): heartbeat AGE is a
+        # duration, and measuring it on the wall clock let any backward
+        # wall step (NTP correction, manual set) inflate every age at
+        # once and mass-declare ranks dead through the failure detector
+        self._seen: Dict[int, float] = {}
         self._flagged: set = set()             # (rank, stage, name) warned
 
     # ---- ingest ---------------------------------------------------------
@@ -131,7 +151,7 @@ class TelemetryAggregator:
             return  # heartbeat from an unassigned worker: nothing to key on
         with self._lock:
             self._ranks[rank] = _sanitize(snap)
-            self._seen[rank] = time.time()
+            self._seen[rank] = time.monotonic()
         for w in self.check_stragglers():
             self._log.warning("%s", w)
 
@@ -156,12 +176,13 @@ class TelemetryAggregator:
         if rank < 0:
             return
         with self._lock:
-            self._seen[rank] = time.time()
+            self._seen[rank] = time.monotonic()
 
     # ---- views ----------------------------------------------------------
     def ranks(self) -> Dict[int, float]:
-        """rank → heartbeat age in seconds."""
-        now = time.time()
+        """rank → heartbeat age in seconds (monotonic-clock based, so a
+        wall-clock step can never inflate or deflate the ages)."""
+        now = time.monotonic()
         with self._lock:
             return {r: now - t for r, t in self._seen.items()}
 
@@ -224,6 +245,15 @@ class TelemetryAggregator:
                 self._log.warning("local telemetry snapshot failed: %r", e)
         n = len(snaps)
         parts.append(f"dmlc_tracker_ranks_reporting {n}\n")
+        parts.append(_build_info_line())
+        # per-rank staleness as a first-class gauge: scrapers alert on
+        # max(dmlc_heartbeat_age_seconds) without parsing /healthz JSON
+        ages = self.ranks()
+        if ages:
+            parts.append("# TYPE dmlc_heartbeat_age_seconds gauge\n")
+            for r, age in sorted(ages.items()):
+                parts.append(
+                    f'dmlc_heartbeat_age_seconds{{rank="{r}"}} {age:.3f}\n')
         return "".join(parts)
 
     def healthz(self) -> Dict:
@@ -284,10 +314,16 @@ class TelemetryAggregator:
 
 
 class TelemetryHTTPServer:
-    """Lightweight /metrics + /healthz HTTP surface over an aggregator."""
+    """Lightweight /metrics + /healthz (+ /trace) HTTP surface.
+
+    ``trace_source`` (zero-arg callable returning a Chrome-trace dict,
+    e.g. ``FlightRecorder.to_chrome_trace``) enables ``GET /trace``:
+    the cluster-merged, clock-corrected timeline, downloadable straight
+    into Perfetto / chrome://tracing."""
 
     def __init__(self, aggregator: TelemetryAggregator,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 trace_source=None):
         agg = aggregator
 
         class Handler(BaseHTTPRequestHandler):
@@ -307,6 +343,15 @@ class TelemetryHTTPServer:
                 elif path == "/healthz":
                     self._send(200, "application/json",
                                json.dumps(agg.healthz()).encode())
+                elif path == "/trace" and trace_source is not None:
+                    try:
+                        body = json.dumps(trace_source()).encode()
+                    except Exception as e:  # noqa: BLE001 - no 500s
+                        logger.warning("/trace render failed: %r", e)
+                        self._send(503, "text/plain",
+                                   b"trace render failed\n")
+                        return
+                    self._send(200, "application/json", body)
                 else:
                     self._send(404, "text/plain", b"not found\n")
 
@@ -335,14 +380,36 @@ class HeartbeatSender:
     ``print`` relay) carrying the full local snapshot with histogram
     buckets, so the tracker can merge distributions across ranks.
     ``close()`` sends one final beat so short jobs still report.
+
+    With ``ship_trace`` (default on; ``DMLC_TELEMETRY_SHIP_TRACE=0``
+    disables) each beat also carries a ``trace`` sub-document: the
+    spans recorded since the last successful ship (bounded per beat),
+    this process's span-clock wall anchor, and a fresh NTP-style clock
+    sample against the tracker (``TrackerClient.clock_ping``) — the
+    worker half of the cluster flight recorder (telemetry.flight).
+    Armed heartbeats also install the postmortem crash hooks when
+    ``DMLC_POSTMORTEM_DIR`` is set: the heartbeat is the one object
+    every instrumented worker constructs.
     """
 
+    MAX_SPANS_PER_BEAT = 2048
+
     def __init__(self, client, interval: float = 5.0,
-                 auto_start: bool = True):
+                 auto_start: bool = True, ship_trace: Optional[bool] = None):
         self._client = client
         self.interval = float(interval)
+        if ship_trace is None:
+            ship_trace = os.environ.get(
+                "DMLC_TELEMETRY_SHIP_TRACE", "1") != "0"
+        self.ship_trace = bool(ship_trace)
+        self._last_seq = 0
+        self._clock: Optional[Tuple[float, float]] = None  # (offset, rtt)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        from . import postmortem
+
+        postmortem.install()  # no-op unless DMLC_POSTMORTEM_DIR is set
+        postmortem.set_rank(getattr(client, "rank", None))
         if auto_start:
             self.start()
 
@@ -362,9 +429,31 @@ class HeartbeatSender:
                 return
 
     def send_once(self) -> None:
-        payload = json.dumps(
-            exporters.export_json(include_buckets=True))
-        self._client.send_metrics(payload)
+        doc = exporters.export_json(include_buckets=True)
+        if self.ship_trace:
+            doc["trace"] = self._trace_doc()
+        self._client.send_metrics(json.dumps(doc))
+        if self.ship_trace:
+            # only a delivered beat advances the ship cursor: a torn
+            # send re-ships the same spans next beat (tracker dedups
+            # by seq) instead of losing them
+            self._last_seq = doc["trace"]["seq"]
+
+    def _trace_doc(self) -> Dict:
+        spans, last = core.spans_since(self._last_seq,
+                                       limit=self.MAX_SPANS_PER_BEAT)
+        clock = getattr(self._client, "clock_ping", None)
+        if clock is not None:
+            try:
+                self._clock = clock()
+            except (OSError, ValueError, KeyError) as e:
+                logger.debug("clock ping failed: %s", e)  # keep last sample
+        doc: Dict = {"anchor": core.anchor_epoch(), "seq": last,
+                     "spans": spans}
+        if self._clock is not None:
+            doc["clock"] = {"offset_s": self._clock[0],
+                            "rtt_s": self._clock[1]}
+        return doc
 
     def close(self) -> None:
         self._stop.set()

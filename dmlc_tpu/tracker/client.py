@@ -12,8 +12,10 @@ connect.
 
 from __future__ import annotations
 
+import json
 import os
 import socket
+import time
 from typing import Dict, Optional
 
 import numpy as np
@@ -220,6 +222,33 @@ class TrackerClient:
         fs.send_str(payload)
         fs.close()
 
+    def clock_ping(self) -> tuple:
+        """One NTP-style clock exchange with the tracker: returns
+        ``(offset_s, rtt_s)`` where ``tracker_time = local_time +
+        offset_s``.  The tracker stamps receipt/reply times in its
+        accept loop (``clock`` session); the sample ships with the next
+        telemetry heartbeat so the tracker can place this rank's spans
+        on the cluster timeline (telemetry.clock / telemetry.flight)."""
+        from ..telemetry.clock import offset_from_timestamps
+
+        # connect + handshake happen BEFORE t0 is stamped: the dial can
+        # pay reconnect backoff and 4 handshake frames, and folding that
+        # into the forward path would bias every offset sample positive
+        # by ~half the setup cost (the tracker stamps t1 only when the
+        # payload frame lands).  t0..t3 must bracket ONLY the ping
+        # round-trip itself.
+        fs = self._session("clock", self.rank, -1)
+        try:
+            t0 = time.time()
+            fs.send_str(json.dumps({"t0": t0}))
+            reply_raw = fs.recv_str()
+            t3 = time.time()
+        finally:
+            fs.close()
+        reply = json.loads(reply_raw)
+        return offset_from_timestamps(
+            t0, float(reply["t1"]), float(reply["t2"]), t3)
+
     def shutdown(self) -> None:
         fs = self._session("shutdown", self.rank, -1)
         fs.close()
@@ -241,35 +270,65 @@ class TrackerClient:
 
     def allreduce(self, arr: np.ndarray, op: str = "sum") -> np.ndarray:
         """Binomial-tree allreduce (reduce to root, broadcast back).
-        op ∈ {sum, max, min}."""
+        op ∈ {sum, max, min}.
+
+        Fully instrumented: a ``collective.allreduce`` span (op/byte/rank
+        tags) plus a ``barrier_enter`` event — on the tracker's corrected
+        /trace timeline these spans line up across ranks, so the rank
+        whose span STARTS last is the straggler by direct reading, and
+        the ``barrier_wait_secs`` histogram (time blocked on the reduce
+        wave) quantifies how long everyone else paid for it."""
+        from .. import telemetry
+
         fold = {"sum": np.add, "max": np.maximum, "min": np.minimum}[op]
         arr = np.ascontiguousarray(arr)
         if self.world_size <= 1:
             return arr.copy()
-        children = [r for r in self.tree_nbrs if r != self.parent]
-        acc = arr.astype(arr.dtype, copy=True)
-        for c in children:
-            acc = fold(acc, self._recv_array(self.links[c], acc))
-        if self.parent >= 0:
-            self._send_array(self.links[self.parent], acc)
-            acc = self._recv_array(self.links[self.parent], acc)
-        for c in children:
-            self._send_array(self.links[c], acc)
+        telemetry.record_event("barrier_enter", site="allreduce", op=op,
+                               rank=self.rank, bytes=int(arr.nbytes))
+        with telemetry.span("collective.allreduce", stage="collective",
+                            args={"op": op, "bytes": int(arr.nbytes),
+                                  "rank": self.rank}):
+            children = [r for r in self.tree_nbrs if r != self.parent]
+            acc = arr.astype(arr.dtype, copy=True)
+            t0 = time.perf_counter()
+            for c in children:
+                acc = fold(acc, self._recv_array(self.links[c], acc))
+            if self.parent >= 0:
+                self._send_array(self.links[self.parent], acc)
+                acc = self._recv_array(self.links[self.parent], acc)
+            # the reduce wave completes here: everything this rank spent
+            # blocked on slower subtree/parent progress is barrier wait
+            telemetry.observe_duration("collective", "barrier_wait",
+                                       time.perf_counter() - t0)
+            for c in children:
+                self._send_array(self.links[c], acc)
         return acc
 
     def allreduce_sum(self, arr: np.ndarray) -> np.ndarray:
         return self.allreduce(arr, "sum")
 
     def broadcast(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
-        """Tree broadcast from root (root's value wins everywhere)."""
+        """Tree broadcast from root (root's value wins everywhere).
+        Instrumented like :meth:`allreduce` (span + barrier event)."""
+        from .. import telemetry
+
         arr = np.ascontiguousarray(arr)
         if self.world_size <= 1:
             return arr.copy()
         assert root == 0, "tree broadcast is rooted at rank 0"
-        children = [r for r in self.tree_nbrs if r != self.parent]
-        out = arr
-        if self.parent >= 0:
-            out = self._recv_array(self.links[self.parent], arr)
-        for c in children:
-            self._send_array(self.links[c], out)
+        telemetry.record_event("barrier_enter", site="broadcast",
+                               rank=self.rank, bytes=int(arr.nbytes))
+        with telemetry.span("collective.broadcast", stage="collective",
+                            args={"bytes": int(arr.nbytes),
+                                  "rank": self.rank}):
+            children = [r for r in self.tree_nbrs if r != self.parent]
+            out = arr
+            if self.parent >= 0:
+                t0 = time.perf_counter()
+                out = self._recv_array(self.links[self.parent], arr)
+                telemetry.observe_duration("collective", "barrier_wait",
+                                           time.perf_counter() - t0)
+            for c in children:
+                self._send_array(self.links[c], out)
         return out.copy() if out is arr else out

@@ -33,6 +33,52 @@ PASS_ENVS = [
 ]
 
 
+_postmortem_scan_lock = threading.Lock()
+
+
+def collect_postmortems(seen: set, role: str, task_id,
+                        log=logger) -> List[str]:
+    """Collect postmortem dumps that appeared since the last scan.
+
+    Called after a task attempt fails: any fresh dump in
+    ``DMLC_POSTMORTEM_DIR`` is a dead incarnation's flight record — its
+    reason, recorded rank, open spans, and event tail are summarized
+    into the launcher log (the full JSON stays on disk) and counted as
+    ``resilience.postmortems_collected``.  Best-effort: a no-op when no
+    directory is configured, and an unreadable dump is reported, not
+    fatal.  ``seen`` must be ONE set shared by every task of the job
+    (the directory is shared too): the claim under the module lock is
+    what keeps concurrent failing tasks from double-counting each
+    other's dumps.  Attribution in the log comes from the dump's own
+    recorded rank — the scanning task merely noticed it; which rank
+    died is the dump's to say."""
+    import json as _json
+
+    from .. import telemetry
+    from ..telemetry import postmortem
+
+    with _postmortem_scan_lock:
+        fresh = [p for p in postmortem.list_dumps() if p not in seen]
+        seen.update(fresh)
+    for p in fresh:
+        summary = ""
+        try:
+            with open(p) as f:
+                doc = _json.load(f)
+            open_names = [s.get("name") for s in doc.get("open_spans", [])]
+            tail = [e.get("kind") for e in doc.get("events", [])[-5:]]
+            summary = (f": rank={doc.get('rank')} "
+                       f"reason={doc.get('reason')!r} "
+                       f"open_spans={open_names} event_tail={tail}")
+        except (OSError, ValueError) as e:
+            summary = f" (unreadable: {e})"
+        log.warning("postmortem collected (scan after %s %s failed) %s%s",
+                    role, task_id, p, summary)
+    if fresh:
+        telemetry.inc("resilience", "postmortems_collected", len(fresh))
+    return fresh
+
+
 def task_env(base: Dict[str, str], role: str, task_id: Optional[int],
              attempt: int, cluster: str,
              extra: Optional[Dict[str, str]] = None,
@@ -113,6 +159,8 @@ def submit_local(args):
     procs: List[subprocess.Popen] = []
 
     def fun_submit(n_workers, n_servers, envs):
+        collected: set = set()  # shared: ONE claim set for the whole job
+
         def run_task(role, task_id):
             from .. import telemetry
 
@@ -128,11 +176,19 @@ def submit_local(args):
                     return
                 logger.warning("%s %d attempt %d exited %d", role, task_id,
                                attempt, ret)
+                # a failed task may have left its flight record behind
+                collect_postmortems(collected, role, task_id)
                 if attempt + 1 < args.max_attempts:
                     # supervised restart: visible on the tracker's
                     # /metrics as dmlc_resilience_task_restarts
                     telemetry.inc("resilience", "task_restarts")
+                    telemetry.record_event("task_restart", role=role,
+                                           task_id=task_id,
+                                           attempt=attempt, exit=ret)
             telemetry.inc("resilience", "task_budget_exhausted")
+            telemetry.record_event("task_budget_exhausted", role=role,
+                                   task_id=task_id,
+                                   attempts=args.max_attempts)
             failures.append((role, task_id, args.max_attempts))
 
         for role, tid in _roles(n_workers, n_servers):
@@ -220,6 +276,7 @@ class GangScheduler:
         self.blacklist_after = blacklist_after
         self.host_failures: Dict[str, int] = {}
         self.blacklist: set = set()
+        self._collected: set = set()  # postmortems: one claim set per job
         self._lock = threading.Lock()
 
     def _pick_host(self, idx: int) -> str:
@@ -268,11 +325,21 @@ class GangScheduler:
                 return
             logger.warning("%s %d attempt %d on %s exited %d",
                            role, task_id, attempt, host, ret)
+            # only finds dumps on a filesystem this process can see
+            # (shared FS, or local-transport tests); remote-only dumps
+            # stay on the failing host for manual collection
+            collect_postmortems(self._collected, role, task_id)
             if attempt + 1 < self.max_attempts:
                 # supervised restart onto a (possibly different) healthy
                 # host; surfaces as dmlc_resilience_task_restarts
                 telemetry.inc("resilience", "task_restarts")
+                telemetry.record_event("task_restart", role=role,
+                                       task_id=task_id, attempt=attempt,
+                                       host=host, exit=ret)
         telemetry.inc("resilience", "task_budget_exhausted")
+        telemetry.record_event("task_budget_exhausted", role=role,
+                               task_id=task_id,
+                               attempts=self.max_attempts)
         raise RuntimeError(
             f"{role} {task_id} failed after {self.max_attempts} attempts")
 

@@ -9,6 +9,7 @@ re-issuing topology to restarted workers, job wall-time logging.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import socket
@@ -247,7 +248,8 @@ class RabitTracker:
         self._registry: Optional[AcceptRegistry] = None
         self._monitor: Optional[threading.Thread] = None
         self._monitor_stop = threading.Event()
-        from ..telemetry import TelemetryAggregator, exporters
+        from ..telemetry import (FlightRecorder, TelemetryAggregator,
+                                 exporters, spans)
 
         # local_snapshot: the tracker process IS the launcher for local
         # jobs — its own registry carries restart/retry counters that no
@@ -257,7 +259,12 @@ class RabitTracker:
             local_snapshot=lambda: exporters.export_json(
                 include_buckets=True))
         self.telemetry.extra_health = lambda: {
-            "dead_ranks": self._dead_snapshot()}
+            "dead_ranks": self._dead_snapshot(),
+            "clock_offsets": self._clock_snapshot()}
+        # flight recorder: workers ship span rings incrementally with
+        # their heartbeats; /trace serves the clock-corrected merge,
+        # with the tracker's own spans riding along as the reference row
+        self.flight = FlightRecorder(local_spans=spans, log=logger)
         self.metrics_server = None
         self.metrics_port: Optional[int] = None
         if metrics_port is None:
@@ -267,9 +274,10 @@ class RabitTracker:
             from ..telemetry import TelemetryHTTPServer
 
             self.metrics_server = TelemetryHTTPServer(
-                self.telemetry, host=host_ip, port=metrics_port)
+                self.telemetry, host=host_ip, port=metrics_port,
+                trace_source=self.flight.to_chrome_trace)
             self.metrics_port = self.metrics_server.port
-            logger.info("tracker /metrics on %s:%d", host_ip,
+            logger.info("tracker /metrics + /trace on %s:%d", host_ip,
                         self.metrics_port)
         logger.info("tracker listening on %s:%d", host_ip, self.port)
 
@@ -324,8 +332,21 @@ class RabitTracker:
                     continue
                 if w.cmd == "metrics":
                     # telemetry heartbeat: latest snapshot for this rank
-                    # (short session, like print; never fails the job)
-                    self.telemetry.update_json(w.rank, w.sock.recv_str())
+                    # (short session, like print; never fails the job);
+                    # any shipped trace sub-document feeds the flight
+                    # recorder's per-rank span store
+                    payload = w.sock.recv_str()
+                    self.telemetry.update_json(w.rank, payload)
+                    self.flight.ingest_json(w.rank, payload, host=w.host)
+                    continue
+                if w.cmd == "clock":
+                    # NTP-style ping: stamp receipt (t1) and reply send
+                    # (t2) on the tracker's clock; the worker computes
+                    # the offset sample and ships it with its next beat
+                    w.sock.recv_str()  # worker's t0 (it keeps its own)
+                    t1 = time.time()
+                    w.sock.send_str(json.dumps(
+                        {"t1": t1, "t2": time.time()}))
                     continue
             except (OSError, UnicodeDecodeError) as e:
                 # pre-registration garbage (port scans, torn handshakes,
@@ -405,6 +426,9 @@ class RabitTracker:
         with self._dead_lock:  # the monitor mutates the set concurrently
             return sorted(self.dead_ranks)
 
+    def _clock_snapshot(self) -> Dict[str, Dict[str, float]]:
+        return {str(r): s for r, s in self.flight.clock.snapshot().items()}
+
     def _note_admitted(self, rank: int, cmd: str) -> None:
         """A worker finished brokering under ``rank``: if that rank was
         declared dead, this is the supervised-restart re-admission."""
@@ -417,6 +441,7 @@ class RabitTracker:
             from .. import telemetry
 
             telemetry.inc("resilience", "worker_readmitted")
+            telemetry.record_event("worker_readmitted", rank=rank, cmd=cmd)
             logger.info("rank %d re-admitted via %r after being declared "
                         "dead", rank, cmd)
 
@@ -428,6 +453,9 @@ class RabitTracker:
                 return
             self.dead_ranks.add(rank)
         telemetry.inc("resilience", "worker_declared_dead")
+        telemetry.record_event("declared_dead", rank=rank,
+                               age_s=round(age, 3),
+                               miss_window_s=self.miss_window_s)
         logger.warning(
             "rank %d declared dead: no heartbeat for %.1fs (miss window "
             "%.1fs); dropping its connection and awaiting a replacement",

@@ -17,7 +17,13 @@ self-healing chain:
      ``TrackerClient.recover`` and the job's allreduce completes with
      the correct sum on BOTH ranks;
   5. the restart/death/readmission events are visible as telemetry
-     counters on the tracker's /metrics surface (rank="tracker").
+     counters on the tracker's /metrics surface (rank="tracker");
+  6. the killed incarnation left a POSTMORTEM dump in
+     DMLC_POSTMORTEM_DIR (the fault injector's kill action writes the
+     flight record before os._exit, simulating what a preempted host's
+     supervisor would collect) containing the rank's final open spans
+     and its event tail (barrier entry + injected fault), and the
+     launcher collected it (dmlc_resilience_postmortems_collected).
 
 The replacement deliberately delays its re-rendezvous past the miss
 window so the death detection provably fires before re-admission —
@@ -26,6 +32,7 @@ deterministic chaos, no coin flips.
 Exit 0 on success, 1 with a diagnostic on any failure.
 """
 
+import json
 import os
 import re
 import sys
@@ -58,8 +65,12 @@ if attempt > 0:
 c = TrackerClient().start(world_size=2)
 hb = HeartbeatSender(c, interval=0.2)
 hb.send_once()  # beat immediately: the detector must know this rank
-# the named barrier: DMLC_FAULT_SPEC kills rank 1's first incarnation here
-fault_point("barrier.chaos", rank=c.rank, attempt=attempt)
+from dmlc_tpu import telemetry
+with telemetry.span("chaos.step", stage="chaos", args={{"rank": c.rank}}):
+    # the named barrier: DMLC_FAULT_SPEC kills rank 1's first
+    # incarnation INSIDE this span — it must appear in the postmortem's
+    # open_spans as the rank's final act
+    fault_point("barrier.chaos", rank=c.rank, attempt=attempt)
 out = None
 for _ in range(10):
     try:
@@ -96,12 +107,17 @@ def main() -> None:
     spec = "barrier.chaos@rank:1@attempt:0=kill:137:1"
     with tempfile.TemporaryDirectory() as tmp:
         out = os.path.join(tmp, "result")
+        pm_dir = os.path.join(tmp, "postmortem")
+        # the launcher (this process) reads the same env to COLLECT the
+        # dumps failed tasks leave behind
+        os.environ["DMLC_POSTMORTEM_DIR"] = pm_dir
         args = get_opts([
             "--cluster", "local", "--num-workers", "2",
             "--max-restarts", "2", "--host-ip", "127.0.0.1",
             "--env", f"DMLC_FAULT_SPEC={spec}",
             "--env", f"CHAOS_OUT={out}",
             "--env", f"CHAOS_RESTART_DELAY_S={RESTART_DELAY_S}",
+            "--env", f"DMLC_POSTMORTEM_DIR={pm_dir}",
             "--", sys.executable, "-c", WORKER_CODE.format(repo=REPO),
         ])
         tracker = launch.submit_local(args)
@@ -129,16 +145,48 @@ def main() -> None:
                 fail(f"rank {rank} got a wrong allreduce: {text!r}")
         print(f"chaos smoke: job self-healed (rank 1 killed at barrier, "
               f"replacement on attempt 1) -> {results[1]!r}")
+        check_postmortem(pm_dir)
 
     for name, want in (("dmlc_resilience_task_restarts", 1),
                        ("dmlc_resilience_worker_declared_dead", 1),
-                       ("dmlc_resilience_worker_readmitted", 1)):
+                       ("dmlc_resilience_worker_readmitted", 1),
+                       ("dmlc_resilience_postmortems_collected", 1)):
         got = metric(body, name)
         if got < want:
             fail(f"/metrics {name} = {got} (< {want}); payload:\n"
                  f"{body[:3000]}")
         print(f"chaos smoke: {name} = {got:g} OK")
     print("chaos smoke OK")
+
+
+def check_postmortem(pm_dir: str) -> None:
+    """The killed incarnation's flight record: its final open spans and
+    event tail must be on disk (the chaos acceptance criterion)."""
+    from dmlc_tpu.telemetry import postmortem
+
+    dumps = postmortem.list_dumps(pm_dir)
+    if not dumps:
+        fail(f"no postmortem dump in {pm_dir} after the injected kill")
+    docs = [json.load(open(p)) for p in dumps]
+    killed = [d for d in docs if "fault.kill" in d.get("reason", "")]
+    if not killed:
+        fail(f"no fault.kill postmortem; reasons: "
+             f"{[d.get('reason') for d in docs]}")
+    doc = killed[0]
+    if doc.get("rank") != "1":
+        fail(f"postmortem rank = {doc.get('rank')!r} (expected '1')")
+    open_names = [s.get("name") for s in doc.get("open_spans", [])]
+    if "chaos.step" not in open_names:
+        fail(f"killed rank's final open spans {open_names} lack "
+             f"'chaos.step'")
+    kinds = [e.get("kind") for e in doc.get("events", [])]
+    for want in ("barrier_enter", "fault_injected"):
+        if want not in kinds:
+            fail(f"postmortem event tail {kinds} lacks {want!r}")
+    if not doc.get("telemetry", {}).get("counters"):
+        fail("postmortem carries no telemetry snapshot")
+    print(f"chaos smoke: postmortem OK ({os.path.basename(dumps[0])}: "
+          f"open_spans={open_names} event_tail={kinds[-4:]})")
 
 
 if __name__ == "__main__":

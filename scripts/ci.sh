@@ -15,13 +15,17 @@
 #      real launcher, leak detection on — the shm/KV code is the one
 #      native surface with nontrivial object lifecycle
 #   6. telemetry smoke: 2-worker local rendezvous pushing heartbeats,
-#      tracker /metrics scraped + validated as Prometheus text, Chrome
-#      trace export validated as JSON with >= 1 complete event
+#      tracker /metrics scraped + validated as Prometheus text (incl.
+#      build-info/heartbeat-age gauges), /trace validated as a 2-rank
+#      clock-corrected merged Chrome trace (distinct pids, labeled
+#      rank rows), local Chrome trace export validated as JSON
 #   7. chaos smoke: FaultInjector kills rank 1 at a barrier mid-job;
 #      the tracker's heartbeat failure detector declares it dead, the
 #      launcher restarts it within its budget, the replacement rejoins
-#      via recover, the job completes, and the restart/death/readmit
-#      counters appear on /metrics
+#      via recover, the job completes, the restart/death/readmit
+#      counters appear on /metrics, and the killed incarnation's
+#      postmortem dump (final open spans + event tail) is collected
+#      from DMLC_POSTMORTEM_DIR
 #
 # Usage: scripts/ci.sh [pytest-args...]
 set -u

@@ -7,9 +7,13 @@ telemetry registries are genuinely per-rank) push heartbeats over the
 rendezvous protocol, then:
 
   1. scrapes /metrics and validates every line parses as Prometheus
-     text exposition, with samples from BOTH ranks plus the merged view;
+     text exposition, with samples from BOTH ranks plus the merged view
+     and the build-info / heartbeat-age gauges;
   2. checks /healthz reports >= 2 ranks;
-  3. exports the smoke process's own spans as Chrome trace JSON and
+  3. scrapes /trace and validates the cluster-merged Chrome trace:
+     spans from BOTH ranks under DISTINCT pids, labeled rank process
+     rows, and monotone non-negative clock-corrected timestamps;
+  4. exports the smoke process's own spans as Chrome trace JSON and
      validates it is well-formed with >= 1 complete ("X") event.
 
 Exit 0 on success, 1 with a diagnostic on any failure.
@@ -43,6 +47,10 @@ for i in range(20):
     telemetry.observe_duration("feed", "producer_stall",
                                0.001 * (c.rank + 1) * (i % 5 + 1))
     telemetry.inc("smoke", "beats")
+# per-rank spans: these ship with the heartbeats (incremental trace
+# push + NTP clock sample) and must appear on the tracker's /trace
+with telemetry.span("smoke.work.r%d" % c.rank, stage="smoke"):
+    time.sleep(0.05)
 hb = HeartbeatSender(c, interval=0.2)
 time.sleep(1.0)
 hb.close()
@@ -72,6 +80,36 @@ def validate_prometheus(body: str) -> int:
     return n
 
 
+def validate_merged_trace(url: str) -> None:
+    """Scrape /trace: a valid Chrome trace with spans from BOTH worker
+    ranks under distinct pids, labeled rank rows, and monotone
+    non-negative corrected timestamps."""
+    doc = json.loads(urllib.request.urlopen(f"{url}/trace").read())
+    evs = [e for e in doc.get("traceEvents", []) if e.get("ph") == "X"]
+    for ev in evs:
+        for k in ("name", "ts", "dur", "pid", "tid"):
+            if k not in ev:
+                fail(f"/trace event missing {k!r}: {ev}")
+    # workers are pid rank+1; the tracker's own row is pid 0
+    worker_pids = sorted({e["pid"] for e in evs if e["pid"] >= 1})
+    if len(worker_pids) < 2:
+        fail(f"/trace has spans from pids {worker_pids} (< 2 worker "
+             f"ranks); events:\n{json.dumps(evs)[:2000]}")
+    names = {e["name"] for e in evs}
+    for want in ("smoke.work.r0", "smoke.work.r1"):
+        if want not in names:
+            fail(f"/trace missing worker span {want!r}; got {sorted(names)}")
+    if any(e["ts"] < 0 for e in evs):
+        fail("/trace has negative corrected timestamps")
+    procs = {e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    for r in (0, 1):
+        if not any(p.startswith(f"rank {r}") for p in procs):
+            fail(f"/trace has no labeled process row for rank {r}: {procs}")
+    print(f"telemetry smoke: /trace OK ({len(evs)} spans from "
+          f"pids {worker_pids})")
+
+
 def main() -> None:
     tracker = RabitTracker("127.0.0.1", 2, metrics_port=0)
     tracker.start(2)
@@ -88,9 +126,13 @@ def main() -> None:
     with telemetry.span("smoke.scrape", stage="smoke"):
         deadline = time.time() + 30
         body = ""
+        # wait for real snapshot samples from both ranks (the heartbeat
+        # AGE gauges appear at brokering time, before any data arrives —
+        # matching bare rank="N" would race the first beat)
         while time.time() < deadline:
             body = urllib.request.urlopen(f"{url}/metrics").read().decode()
-            if 'rank="0"' in body and 'rank="1"' in body:
+            if ('dmlc_smoke_beats{rank="0"}' in body
+                    and 'dmlc_smoke_beats{rank="1"}' in body):
                 break
             time.sleep(0.1)
         else:
@@ -99,7 +141,10 @@ def main() -> None:
     n = validate_prometheus(body)
     for want in ('rank="0"', 'rank="1"', 'rank="all"',
                  "dmlc_feed_producer_stall_secs_bucket",
-                 "dmlc_tracker_ranks_reporting 2"):
+                 "dmlc_tracker_ranks_reporting 2",
+                 "dmlc_build_info{",
+                 'dmlc_heartbeat_age_seconds{rank="0"}',
+                 'dmlc_heartbeat_age_seconds{rank="1"}'):
         if want not in body:
             fail(f"missing {want!r} in /metrics payload")
     print(f"telemetry smoke: /metrics OK ({n} samples)")
@@ -113,6 +158,7 @@ def main() -> None:
         if w.wait(timeout=60) != 0:
             fail(f"worker exited {w.returncode}")
     tracker.join(timeout=30)
+    validate_merged_trace(url)
     tracker.close()
 
     trace = json.loads(telemetry.to_chrome_trace_json())

@@ -1,0 +1,466 @@
+"""Cluster flight recorder (ISSUE 3): clock-offset estimation, merged
+clock-corrected /trace, structured event log, crash postmortems, and the
+monotonic-heartbeat + build-info/staleness-gauge satellites."""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from dmlc_tpu import telemetry
+from dmlc_tpu.telemetry import (ClockOffsetEstimator, FlightRecorder,
+                                TelemetryAggregator, events, postmortem)
+from dmlc_tpu.telemetry.clock import offset_from_timestamps
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    telemetry.reset()
+    telemetry.reset_events()
+    yield
+    telemetry.reset()
+    telemetry.reset_events()
+    postmortem.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# clock-offset estimation
+# ---------------------------------------------------------------------------
+
+def test_offset_from_timestamps_recovers_known_skew():
+    # worker clock runs 5.0s BEHIND the tracker; symmetric 10ms wire
+    skew, wire = 5.0, 0.010
+    t0 = 1000.0                      # worker clock
+    t1 = t0 + skew + wire            # tracker receives
+    t2 = t1 + 0.001                  # tracker replies 1ms later
+    t3 = t2 - skew + wire            # worker receives
+    off, rtt = offset_from_timestamps(t0, t1, t2, t3)
+    assert off == pytest.approx(skew, abs=1e-9)
+    assert rtt == pytest.approx(2 * wire, abs=1e-9)
+
+
+def test_offset_exact_even_with_asymmetric_error_bounded_by_rtt():
+    # asymmetric path (3ms out, 17ms back): NTP's error bound is rtt/2
+    skew = -2.5
+    t0 = 50.0
+    t1 = t0 + skew + 0.003
+    t2 = t1 + 0.0005
+    t3 = t2 - skew + 0.017
+    off, rtt = offset_from_timestamps(t0, t1, t2, t3)
+    assert abs(off - skew) <= rtt / 2 + 1e-12
+
+
+def test_estimator_prefers_low_rtt_and_windows_out_stale_samples():
+    est = ClockOffsetEstimator(window=4)
+    est.update(0, offset_s=1.00, rtt_s=0.050)   # loose early sample
+    est.update(0, offset_s=1.20, rtt_s=0.002)   # tight: wins
+    est.update(0, offset_s=0.90, rtt_s=0.030)
+    assert est.offset(0) == pytest.approx(1.20)
+    assert est.rtt(0) == pytest.approx(0.002)
+    # slide the tight sample out of the window: best follows the window
+    for _ in range(4):
+        est.update(0, offset_s=2.0, rtt_s=0.010)
+    assert est.offset(0) == pytest.approx(2.0)
+    # garbage and impossible samples are rejected
+    est.update(1, offset_s="nope", rtt_s=0.001)
+    est.update(1, offset_s=0.5, rtt_s=-0.001)
+    est.update(-1, offset_s=0.5, rtt_s=0.001)
+    assert est.offset(1) is None and est.offset(-1) is None
+    est.drop(0)
+    assert est.offset(0) is None
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: merged clock-corrected chrome trace
+# ---------------------------------------------------------------------------
+
+def _ship(fr, rank, anchor, offset, names, step_s=1.0, seq0=0):
+    spans = [{"name": n, "ts": i * step_s * 1e6, "dur": 1000.0,
+              "tid": 7, "seq": seq0 + i + 1, "cat": "t",
+              "thread": f"w{rank}"}
+             for i, n in enumerate(names)]
+    fr.ingest(rank, {
+        "anchor": anchor, "seq": seq0 + len(names), "spans": spans,
+        "clock": {"offset_s": offset, "rtt_s": 0.001},
+    }, host=f"host{rank}")
+
+
+def test_merged_trace_distinct_pids_and_corrected_monotone_timestamps():
+    fr = FlightRecorder()
+    # two ranks whose wall clocks disagree by 100s; events REALLY
+    # happened interleaved: rank0 at tracker-time 1000+0,2; rank1 at
+    # 1000+1,3 (anchor+offset both = 1000 after correction)
+    _ship(fr, 0, anchor=1000.0, offset=0.0, names=["a0", "a1"], step_s=2.0)
+    _ship(fr, 1, anchor=901.0, offset=100.0, names=["b0", "b1"],
+          step_s=2.0)
+    doc = fr.to_chrome_trace()
+    evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    pids = {e["pid"] for e in evs}
+    assert pids == {1, 2}  # one pid per rank, rank r -> pid r+1
+    by_name = {e["name"]: e for e in evs}
+    # corrected interleave: a0 < b0 < a1 < b1, each 1s apart
+    order = sorted(by_name, key=lambda n: by_name[n]["ts"])
+    assert order == ["a0", "b0", "a1", "b1"]
+    ts = [by_name[n]["ts"] for n in order]
+    assert ts == sorted(ts)
+    assert ts[0] == 0.0  # rebased to start at 0
+    for a, b in zip(ts, ts[1:]):
+        assert b - a == pytest.approx(1e6, rel=1e-6)  # 1s in µs
+    # rank metadata rows are present and labeled
+    meta = {(e["pid"], e["name"]): e for e in doc["traceEvents"]
+            if e["ph"] == "M"}
+    assert meta[(1, "process_name")]["args"]["name"] == "rank 0 (host0)"
+    assert meta[(2, "process_name")]["args"]["name"] == "rank 1 (host1)"
+    assert (2, "thread_name") in meta
+
+
+def test_merged_trace_within_tolerance_of_true_skew():
+    # the estimator's error is bounded by rtt/2: corrected timestamps of
+    # simultaneous events on two skewed clocks must land within that
+    fr = FlightRecorder()
+    true_off0, true_off1 = 3.0, -7.0
+    meas_err = 0.004  # 8ms rtt -> ±4ms worst case
+    _ship(fr, 0, anchor=500.0 - true_off0, offset=true_off0 + meas_err,
+          names=["x"])
+    _ship(fr, 1, anchor=500.0 - true_off1, offset=true_off1 - meas_err,
+          names=["y"])
+    evs = {e["name"]: e for e in fr.to_chrome_trace()["traceEvents"]
+           if e["ph"] == "X"}
+    # both events happened at tracker-time 500.0 exactly
+    dt_us = abs(evs["x"]["ts"] - evs["y"]["ts"])
+    assert dt_us <= 2 * meas_err * 1e6 + 1
+
+
+def test_flight_ingest_dedups_by_seq_and_bounds_per_rank():
+    fr = FlightRecorder(max_spans_per_rank=8)
+    _ship(fr, 0, anchor=100.0, offset=0.0, names=["s0", "s1"])
+    _ship(fr, 0, anchor=100.0, offset=0.0, names=["s0", "s1"])  # re-ship
+    assert fr.span_counts()[0] == 2  # dedup'd, not doubled
+    _ship(fr, 0, anchor=100.0, offset=0.0,
+          names=[f"t{i}" for i in range(20)], seq0=2)
+    assert fr.span_counts()[0] == 8  # bounded ring per rank
+
+
+def test_flight_ingest_restart_resets_rank_store():
+    fr = FlightRecorder()
+    _ship(fr, 0, anchor=100.0, offset=5.0, names=["old0", "old1"])
+    # replacement incarnation: NEW anchor, seq restarts at 1
+    _ship(fr, 0, anchor=333.0, offset=0.5, names=["new0"])
+    evs = [e["name"] for e in fr.to_chrome_trace()["traceEvents"]
+           if e["ph"] == "X"]
+    assert evs == ["new0"]  # dead incarnation's spans dropped
+
+
+def test_flight_ingest_survives_garbage():
+    fr = FlightRecorder()
+    fr.ingest_json(0, "{not json")
+    fr.ingest_json(0, json.dumps({"trace": {"spans": "nope"}}))
+    fr.ingest_json(0, json.dumps({"trace": {"anchor": "NaNope",
+                                            "spans": []}}))
+    fr.ingest_json(1, json.dumps(
+        {"trace": {"anchor": 1.0,
+                   "spans": [{"bogus": 1}, "str", None,
+                             {"name": "ok", "ts": 0.0, "dur": 1.0,
+                              "tid": 1, "seq": 1}]}}))
+    fr.ingest(-1, {"anchor": 1.0, "spans": []})
+    counts = fr.span_counts()
+    assert counts.get(1) == 1 and 0 not in counts
+    assert json.loads(fr.to_chrome_trace_json())["traceEvents"]
+
+
+def test_local_spans_ride_along_as_tracker_pid():
+    from dmlc_tpu.telemetry.flight import TRACKER_PID
+
+    with telemetry.span("tracker.side", stage="t"):
+        pass
+    fr = FlightRecorder(local_spans=telemetry.spans)
+    _ship(fr, 0, anchor=time.time(), offset=0.0, names=["w"])
+    evs = [e for e in fr.to_chrome_trace()["traceEvents"]
+           if e["ph"] == "X"]
+    assert {e["pid"] for e in evs} == {TRACKER_PID, 1}
+    assert any(e["name"] == "tracker.side" and e["pid"] == TRACKER_PID
+               for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# span core additions: seq, incremental shipping, open spans
+# ---------------------------------------------------------------------------
+
+def test_spans_since_is_incremental_and_bounded():
+    with telemetry.span("a"):
+        pass
+    first, seq1 = telemetry.spans_since(0)
+    assert [r["name"] for r in first] == ["a"]
+    with telemetry.span("b"):
+        pass
+    fresh, seq2 = telemetry.spans_since(seq1)
+    assert [r["name"] for r in fresh] == ["b"] and seq2 > seq1
+    assert telemetry.spans_since(seq2)[0] == []
+    for i in range(10):
+        with telemetry.span(f"c{i}"):
+            pass
+    # a truncating limit keeps the OLDEST and hands back a resumable
+    # cursor: repeated calls catch up without losing the middle
+    capped, cur = telemetry.spans_since(seq2, limit=3)
+    assert [r["name"] for r in capped] == ["c0", "c1", "c2"]
+    rest, cur = telemetry.spans_since(cur, limit=1000)
+    assert [r["name"] for r in rest] == [f"c{i}" for i in range(3, 10)]
+    assert telemetry.spans_since(cur)[0] == []
+
+
+def test_open_spans_sees_inside_of_running_spans():
+    ready = threading.Event()
+    release = threading.Event()
+
+    def worker():
+        with telemetry.span("w.outer", stage="t"):
+            with telemetry.span("w.stuck", stage="t", args={"k": 1}):
+                ready.set()
+                release.wait(5)
+
+    t = threading.Thread(target=worker, name="stuck-worker")
+    t.start()
+    assert ready.wait(5)
+    try:
+        opened = {s["name"]: s for s in telemetry.open_spans()}
+        assert {"w.outer", "w.stuck"} <= set(opened)
+        assert opened["w.stuck"]["depth"] == 1
+        assert opened["w.stuck"]["thread"] == "stuck-worker"
+        assert opened["w.stuck"]["open_us"] >= 0
+        assert opened["w.stuck"]["args"] == {"k": 1}
+    finally:
+        release.set()
+        t.join()
+    assert "w.stuck" not in {s["name"] for s in telemetry.open_spans()}
+
+
+# ---------------------------------------------------------------------------
+# event log
+# ---------------------------------------------------------------------------
+
+def test_event_log_records_bounded_ordered_jsonl():
+    telemetry.record_event("retry", policy="s3", error="timeout")
+    telemetry.record_event("fault_injected", site="barrier.x",
+                           action="kill")
+    tail = telemetry.events_tail(10)
+    assert [e["kind"] for e in tail] == ["retry", "fault_injected"]
+    assert tail[0]["policy"] == "s3" and tail[0]["seq"] < tail[1]["seq"]
+    assert all("t" in e and "mono" in e for e in tail)
+    lines = events.to_jsonl(tail).splitlines()
+    assert len(lines) == 2 and json.loads(lines[0])["kind"] == "retry"
+    cap = events._MAX_EVENTS
+    for i in range(cap + 10):
+        telemetry.record_event("spam", i=i)
+    assert len(events.events()) == cap
+
+
+def test_resilience_paths_land_in_event_log():
+    from dmlc_tpu.resilience import RetryPolicy, fault_point
+    from dmlc_tpu.resilience.fault import install_injector, reset_injector
+
+    calls = []
+    policy = RetryPolicy(attempts=3, base_s=0.0, jitter=0.0,
+                         sleep=lambda s: None, name="evt")
+    policy.call(lambda: calls.append(1) or (None if len(calls) > 1
+                                            else (_ for _ in ()).throw(
+                                                ConnectionError("x"))))
+    install_injector("barrier.evt@rank:0=delay:0")
+    try:
+        fault_point("barrier.evt", rank=0, attempt=0)
+    finally:
+        reset_injector()
+    kinds = [e["kind"] for e in telemetry.events_tail(10)]
+    assert "retry" in kinds
+    assert "barrier_enter" in kinds
+    assert "fault_injected" in kinds
+
+
+# ---------------------------------------------------------------------------
+# postmortem
+# ---------------------------------------------------------------------------
+
+def test_postmortem_dump_contains_snapshot_open_spans_and_events(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv(postmortem.ENV_DIR, str(tmp_path))
+    telemetry.inc("train", "steps", 7)
+    telemetry.record_event("barrier_enter", site="barrier.z", rank="0")
+    with telemetry.span("dying.op", stage="t"):
+        path = postmortem.dump("unit test")
+    assert path and os.path.exists(path)
+    doc = json.load(open(path))
+    assert doc["reason"] == "unit test"
+    assert doc["telemetry"]["counters"]["train"]["steps"] == 7.0
+    assert [s["name"] for s in doc["open_spans"]] == ["dying.op"]
+    assert any(e["kind"] == "barrier_enter" for e in doc["events"])
+    assert doc["spans"] is not None and "anchor_epoch" in doc
+    assert path in postmortem.list_dumps()
+
+
+def test_postmortem_noop_without_dir(monkeypatch):
+    monkeypatch.delenv(postmortem.ENV_DIR, raising=False)
+    assert postmortem.dump("nothing") is None
+    assert postmortem.install() is False
+    assert postmortem.list_dumps() == []
+
+
+def test_postmortem_excepthook_and_fatal_hook(tmp_path, monkeypatch):
+    import sys
+
+    from dmlc_tpu import logging as dlog
+    from dmlc_tpu.base import DMLCError
+
+    monkeypatch.setenv(postmortem.ENV_DIR, str(tmp_path))
+    assert postmortem.install() is True
+    try:
+        # the chained excepthook dumps, then defers to the previous hook
+        sys.excepthook(ValueError, ValueError("boom"), None)
+        dumps = postmortem.list_dumps()
+        assert len(dumps) == 1
+        assert "ValueError" in json.load(open(dumps[0]))["reason"]
+        with pytest.raises(DMLCError):
+            dlog.fatal("last words")
+        dumps = postmortem.list_dumps()
+        assert len(dumps) == 2
+        assert "last words" in json.load(open(dumps[-1]))["reason"]
+    finally:
+        postmortem.uninstall()
+
+
+def test_fault_injector_kill_dumps_postmortem(tmp_path):
+    """The injected-kill path (os._exit, no cleanup) must leave a flight
+    record behind — run in a subprocess since it really dies."""
+    import subprocess
+    import sys
+
+    code = f"""
+import os, sys
+sys.path.insert(0, {json.dumps(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))})
+os.environ["DMLC_POSTMORTEM_DIR"] = {json.dumps(str(tmp_path))}
+os.environ["DMLC_FAULT_SPEC"] = "barrier.die=kill:7"
+from dmlc_tpu import telemetry
+from dmlc_tpu.resilience import fault_point
+telemetry.record_event("retry", policy="x")
+with telemetry.span("about.to.die", stage="t"):
+    fault_point("barrier.die", rank=0)
+"""
+    p = subprocess.run([sys.executable, "-c", code], timeout=60)
+    assert p.returncode == 7
+    dumps = postmortem.list_dumps(str(tmp_path))
+    assert len(dumps) == 1
+    doc = json.load(open(dumps[0]))
+    assert "fault.kill" in doc["reason"]
+    assert [s["name"] for s in doc["open_spans"]] == ["about.to.die"]
+    kinds = [e["kind"] for e in doc["events"]]
+    assert kinds == ["retry", "barrier_enter", "fault_injected"]
+
+
+def test_launcher_collects_postmortems(tmp_path, monkeypatch, caplog):
+    import logging as std_logging
+
+    from dmlc_tpu.tracker.launch import collect_postmortems
+
+    caplog.set_level(std_logging.WARNING, logger="dmlc_tpu.tracker")
+    monkeypatch.setenv(postmortem.ENV_DIR, str(tmp_path))
+    telemetry.record_event("fault_injected", site="barrier.q",
+                           action="kill")
+    with telemetry.span("mid.flight", stage="t"):
+        postmortem.dump("crash A")
+    seen: set = set()
+    fresh = collect_postmortems(seen, "worker", 1)
+    assert len(fresh) == 1
+    assert collect_postmortems(seen, "worker", 1) == []  # already seen
+    assert telemetry.counters_snapshot()[
+        "resilience"]["postmortems_collected"] == 1.0
+    rec = [r.message for r in caplog.records if "postmortem" in r.message]
+    assert rec and "crash A" in rec[0] and "mid.flight" in rec[0]
+
+
+# ---------------------------------------------------------------------------
+# satellites: monotonic heartbeat ages, build info / staleness gauges
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_ages_use_monotonic_clock(monkeypatch):
+    agg = TelemetryAggregator()
+    agg.update(0, {"counters": {}, "gauges": {}, "histograms": {}})
+    # step the WALL clock back an hour: ages must not move — on the old
+    # time.time() bookkeeping this produced negative (or, forward-step,
+    # mass-dead) ages through the failure detector
+    real_monotonic = time.monotonic
+    monkeypatch.setattr(
+        "dmlc_tpu.telemetry.heartbeat.time.time",
+        lambda: real_monotonic() - 3600.0)
+    age = agg.ranks()[0]
+    assert 0 <= age < 5.0
+    agg.touch(0)
+    assert 0 <= agg.ranks()[0] <= age + 1.0
+
+
+def test_prometheus_surface_has_build_info_and_age_gauges():
+    import dmlc_tpu
+
+    agg = TelemetryAggregator()
+    agg.update(0, {"counters": {"s": {"c": 1.0}}, "gauges": {},
+                   "histograms": {}})
+    agg.update(3, {"counters": {}, "gauges": {}, "histograms": {}})
+    text = agg.prometheus_text()
+    assert "# TYPE dmlc_build_info gauge" in text
+    assert f'version="{dmlc_tpu.__version__}"' in text
+    assert 'platform="' in text
+    assert "# TYPE dmlc_heartbeat_age_seconds gauge" in text
+    assert 'dmlc_heartbeat_age_seconds{rank="0"}' in text
+    assert 'dmlc_heartbeat_age_seconds{rank="3"}' in text
+
+
+# ---------------------------------------------------------------------------
+# end to end: live tracker serves a merged 2-rank /trace
+# ---------------------------------------------------------------------------
+
+def test_live_tracker_serves_clock_corrected_merged_trace():
+    from dmlc_tpu.telemetry import HeartbeatSender
+    from dmlc_tpu.tracker import RabitTracker, TrackerClient
+
+    tracker = RabitTracker("127.0.0.1", 2, metrics_port=0)
+    tracker.start(2)
+    errors = []
+
+    def work(i):
+        try:
+            c = TrackerClient("127.0.0.1", tracker.port, jobid=f"tr{i}")
+            c.start()
+            off, rtt = c.clock_ping()  # same host: offset ~ 0
+            assert rtt >= 0 and abs(off) < 60.0
+            with telemetry.span(f"work.r{c.rank}", stage="e2e"):
+                time.sleep(0.01)
+            hb = HeartbeatSender(c, interval=30.0, auto_start=False)
+            hb.send_once()
+            c.shutdown()
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errors, errors
+    base = f"http://127.0.0.1:{tracker.metrics_port}"
+    doc = json.loads(urllib.request.urlopen(base + "/trace").read())
+    hz = json.loads(urllib.request.urlopen(base + "/healthz").read())
+    tracker.join(timeout=30)
+    tracker.close()
+    evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    pids = {e["pid"] for e in evs}
+    assert {1, 2} <= pids  # both ranks present under distinct pids
+    names = {e["name"] for e in evs}
+    assert "work.r0" in names and "work.r1" in names
+    ts = sorted(e["ts"] for e in evs)
+    assert ts[0] >= 0.0  # rebased, monotone by construction of sort
+    procs = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert any(p.startswith("rank 0") for p in procs)
+    assert any(p.startswith("rank 1") for p in procs)
+    assert "clock_offsets" in hz
