@@ -20,14 +20,100 @@ from __future__ import annotations
 
 import heapq
 import threading
+import time
 from collections import deque
 from typing import Callable, Generic, Iterator, List, Optional, Tuple, TypeVar
 
 from .base import DMLCError
 
-__all__ = ["ConcurrentBlockingQueue", "ThreadedIter", "MultiThreadedIter"]
+__all__ = ["BufferPool", "ConcurrentBlockingQueue", "ThreadedIter",
+           "MultiThreadedIter"]
 
 T = TypeVar("T")
+
+
+class BufferPool(Generic[T]):
+    """Bounded pool of reusable buffers (the free-list half of the
+    reference's ThreadedIter "Recycle" contract, threadediter.h:170-193,
+    lifted out so multi-stage pipelines can share it).
+
+    ``acquire()`` pops a free buffer, lazily building one via ``factory``
+    while fewer than ``capacity`` exist, and otherwise blocks until a
+    consumer hands one back with ``release()``.  The capacity bound is
+    what turns a pipeline into back-pressure: a producer can run at most
+    ``capacity`` buffers ahead of the consumer and steady state does no
+    allocation at all — exactly what a host→device feed needs (stable
+    host buffers for ``device_put``).
+
+    ``kill()`` wakes every blocked acquirer with ``None`` so pipeline
+    teardown never leaves a thread parked on an empty pool.
+    """
+
+    def __init__(self, factory: Callable[[], T], capacity: int = 2):
+        self._factory = factory
+        self._capacity = max(1, capacity)
+        self._free: List[T] = []
+        self._created = 0
+        self._lock = threading.Lock()
+        self._avail = threading.Condition(self._lock)
+        self._killed = False
+
+    def acquire(self, timeout: Optional[float] = None) -> Optional[T]:
+        """A free buffer, or ``None`` on kill()/timeout."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with self._lock:
+            while not self._killed:
+                if self._free:
+                    return self._free.pop()
+                if self._created < self._capacity:
+                    # build outside the free list but inside the count so
+                    # concurrent acquirers cannot overshoot capacity
+                    self._created += 1
+                    break
+                # wait against an absolute deadline: a wakeup whose
+                # buffer another thread steals must not restart the clock
+                if deadline is None:
+                    self._avail.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._avail.wait(remaining):
+                        return None
+            else:
+                return None
+        try:
+            obj = self._factory()
+        except BaseException:
+            with self._lock:
+                self._created -= 1
+                self._avail.notify()
+            raise
+        with self._lock:
+            if self._killed:
+                # kill() raced the (unlocked) build: honor the poison
+                # contract — a killed pool never hands out buffers
+                return None
+        return obj
+
+    def release(self, obj: T) -> None:
+        with self._lock:
+            if self._killed:
+                return
+            self._free.append(obj)
+            self._avail.notify()
+
+    def kill(self) -> None:
+        """Wake all blocked acquirers; subsequent acquires return None."""
+        with self._lock:
+            self._killed = True
+            self._free.clear()
+            self._avail.notify_all()
+
+    @property
+    def created(self) -> int:
+        """Buffers built so far (≤ capacity) — observability for tests."""
+        with self._lock:
+            return self._created
 
 
 class ConcurrentBlockingQueue(Generic[T]):

@@ -25,6 +25,7 @@
 #include <sys/mman.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
+#include <sys/statvfs.h>
 #include <time.h>
 #include <unistd.h>
 
@@ -220,6 +221,51 @@ int fold_bytes(void* acc, const void* in, long count, int dtype, int op) {
   }
 }
 
+// Single-pass N-ary fold: res = srcs[0] op srcs[1] op ... op srcs[n-1].
+// The memcpy + (n-1) sequential two-operand folds it replaces re-read
+// and re-write the accumulator once per source — ~3x the memory traffic
+// of the inputs themselves, which is what bounds a whole-gang fold on a
+// bandwidth-limited host.  Blocking at kFoldBlock keeps the accumulator
+// resident in L1 across the per-source passes, so DRAM traffic drops to
+// one streaming read per source plus one write of the result.
+template <typename T>
+void fold_multi(T* res, const T* const* srcs, int nsrc, long n, int op) {
+  const long kFoldBlock = static_cast<long>(8192 / sizeof(T));
+  for (long lo = 0; lo < n; lo += kFoldBlock) {
+    const long m = std::min(kFoldBlock, n - lo);
+    memcpy(res + lo, srcs[0] + lo, static_cast<size_t>(m) * sizeof(T));
+    for (int s = 1; s < nsrc; ++s) fold(res + lo, srcs[s] + lo, m, op);
+  }
+}
+
+int fold_multi_bytes(void* res, const void* const* srcs, int nsrc, long count,
+                     int dtype, int op) {
+  if (nsrc <= 0) return -2;
+  switch (dtype) {
+    case DMLC_F32:
+      fold_multi(static_cast<float*>(res),
+                 reinterpret_cast<const float* const*>(srcs), nsrc, count, op);
+      return 0;
+    case DMLC_F64:
+      fold_multi(static_cast<double*>(res),
+                 reinterpret_cast<const double* const*>(srcs), nsrc, count,
+                 op);
+      return 0;
+    case DMLC_I32:
+      fold_multi(static_cast<int32_t*>(res),
+                 reinterpret_cast<const int32_t* const*>(srcs), nsrc, count,
+                 op);
+      return 0;
+    case DMLC_I64:
+      fold_multi(static_cast<int64_t*>(res),
+                 reinterpret_cast<const int64_t* const*>(srcs), nsrc, count,
+                 op);
+      return 0;
+    default:
+      return -2;
+  }
+}
+
 // ---------------------------------------------------------------------
 // Shared-memory transport (same-host gangs).
 //
@@ -268,11 +314,16 @@ struct ShmCtrl {
 };
 
 long shm_chunk_bytes() {
-  // 512 KB won the sweep (128 KB..8 MB): the per-chunk working set is
-  // world x chunk, and 8 x 512 KB keeps the fold inside the LLC — 64 MB
-  // allreduce busbw measured 868 (512 KB) vs 523 (4 MB) vs 816 (128 KB)
+  // Re-tuned with the single-pass fold_multi reduce: the old 512 KB
+  // default was picked to keep the memcpy+(w-1)-fold accumulator
+  // traffic inside the LLC, but the blocked N-ary fold streams each
+  // input once, so larger chunks now win by amortizing the 3 gang
+  // barriers per chunk (64 MB allreduce on an oversubscribed 2-core
+  // host: 302 busbw at 512 KB vs 433 at 4 MB).  Segment cost is
+  // world x 4 x chunk bytes of /dev/shm; a failed ftruncate falls back
+  // to TCP, and DMLC_COLL_SHM_CHUNK_KB overrides either way.
   static const long v =
-      std::max(4096L, env_long("DMLC_COLL_SHM_CHUNK_KB", 512) << 10) &
+      std::max(4096L, env_long("DMLC_COLL_SHM_CHUNK_KB", 4096) << 10) &
       ~7L;
   return v;
 }
@@ -602,18 +653,30 @@ bool shm_wait_all(DmlcComm* c, ShmField f, long target) {
                            : f == SHM_DONE ? ct->done
                                            : ct->cons;
     int spins = 0;
+    int yields = 0;
     while (a.load(std::memory_order_acquire) < target) {
       // stop counting at the threshold: a multi-minute stall would
       // otherwise push the counter past INT_MAX (signed-overflow UB)
       // and silence the deadline check until it wrapped positive again
       if (spins <= 256) ++spins;
       if (spins > 256) {
-        sched_yield();  // gangs share cores; never busy-burn a slice
-        if (now_seconds() > deadline) {
+        // gangs share cores; never busy-burn a slice.  After a while,
+        // sched_yield itself becomes a context-switch storm on an
+        // oversubscribed host (every waiter re-queues instantly), so
+        // back off to a real sleep — the waits here are chunk-scale
+        // (100s of µs to ms), far above the 50 µs granularity.  The
+        // deadline syscall is amortized over 64 iterations.
+        if (++yields <= 64) {
+          sched_yield();
+        } else {
+          usleep(50);
+        }
+        if ((yields & 63) == 0 && now_seconds() > deadline) {
           c->error = "shm collective timed out waiting on rank " +
                      std::to_string(r) + " (peer died mid-collective?)";
           return false;
         }
+        if (yields > (1 << 20)) yields = 65;  // avoid wrap, keep sleeping
       }
     }
   }
@@ -666,14 +729,18 @@ int shm_allreduce(DmlcComm* c, char* p, long nbytes, long esize, int dtype,
     c->ctrl(me)->pub.store(s + 1, std::memory_order_release);
     if (!shm_wait_all(c, SHM_PUB, s + 1)) return -1;
     if (off == 0 && !shm_agree(c, s, desc)) return -1;
-    // reduce my 1/w slice of this chunk across every rank's input
+    // reduce my 1/w slice of this chunk across every rank's input in ONE
+    // blocked pass (fold_multi_bytes); my own contribution reads from
+    // the private payload, not its shm copy, saving one shm stream
     const long elems = n / esize;
     const long lo = elems * me / w, cnt = elems * (me + 1) / w - lo;
     if (cnt > 0) {
       char* res = c->res_slot(me, slot) + lo * esize;
-      memcpy(res, c->in_slot(0, slot) + lo * esize, cnt * esize);
-      for (int r = 1; r < w; ++r)
-        fold_bytes(res, c->in_slot(r, slot) + lo * esize, cnt, dtype, op);
+      std::vector<const void*> srcs(w);
+      for (int r = 0; r < w; ++r)
+        srcs[r] = r == me ? p + off + lo * esize
+                          : c->in_slot(r, slot) + lo * esize;
+      fold_multi_bytes(res, srcs.data(), w, cnt, dtype, op);
     }
     c->ctrl(me)->done.store(s + 1, std::memory_order_release);
     if (!shm_wait_all(c, SHM_DONE, s + 1)) return -1;
@@ -756,6 +823,21 @@ void shm_setup(DmlcComm* c) {
   bool ok = enabled;
   if (c->rank == 0 && enabled) {
     ann.chunk = shm_chunk_bytes();
+    // Unless the operator pinned the chunk size, fit the segment into
+    // the /dev/shm actually available: the 4 MB default means 16 MB of
+    // segment per rank, which overflows e.g. Docker's default 64 MB
+    // /dev/shm at world 8 and would silently drop the gang onto the
+    // slow TCP path.  Cap at half the free space, floor 64 KB.
+    if (getenv("DMLC_COLL_SHM_CHUNK_KB") == nullptr) {
+      struct statvfs vfs;
+      if (statvfs("/dev/shm", &vfs) == 0) {
+        const long avail = static_cast<long>(vfs.f_bavail) *
+                           static_cast<long>(vfs.f_frsize);
+        const long cap =
+            (avail / 2 / (static_cast<long>(c->world) * 4)) & ~7L;
+        ann.chunk = std::max(64L << 10, std::min(ann.chunk, cap));
+      }
+    }
     const size_t size = sizeof(ShmCtrl) * c->world +
                         static_cast<size_t>(c->world) * 4 * ann.chunk;
     snprintf(ann.name, sizeof ann.name, "/dmlc-coll-%d-%lx", getpid(),

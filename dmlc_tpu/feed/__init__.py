@@ -3,8 +3,12 @@
 The TPU bridge the reference never had (SURVEY.md §7 stage 7): RowBlocks
 and RecordIO payloads stream from partitioned ingestion straight into
 device memory with ICI-topology-aware sharding — part_index is the
-flattened (dp, sp) mesh coordinate (parallel.mesh.MeshConfig) — and
-double-buffered prefetch mirroring ThreadedInputSplit.
+flattened (dp, sp) mesh coordinate (parallel.mesh.MeshConfig).
+DMLC_FEED_WORKERS parser threads assemble each global batch in place
+inside a pooled staging buffer and a placer thread ships it shard by
+shard to its addressable devices (DMLC_FEED_DEPTH-deep double
+buffering), so parse overlaps transfer and steady state allocates
+nothing — see device_feed.DeviceFeed and README "Feed pipeline".
 """
 
 from .device_feed import (  # noqa: F401

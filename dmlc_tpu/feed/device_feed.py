@@ -7,25 +7,41 @@ Design (TPU-first):
     src/io/input_split_base.cc:30-64, lifted onto the mesh);
   * batches are packed into STATIC shapes (pad/truncate) so XLA compiles
     one program — no data-dependent shapes;
-  * a producer thread assembles the next global batch and dispatches
-    device transfer while the consumer computes on the current one
-    (double buffering, capacity-2 queue — ThreadedInputSplit behavior,
-    src/io/threaded_input_split.h:23-101);
+  * DMLC_FEED_WORKERS parser threads each write their partitions' batches
+    straight into their slice of a pooled staging buffer
+    (concurrency.BufferPool), so global-batch assembly allocates nothing
+    and never concatenates;
+  * each host shard is placed on its own addressable device
+    (jax.device_put per device + make_array_from_single_device_arrays
+    against the mesh NamedSharding) instead of round-tripping through one
+    global host array, and DMLC_FEED_DEPTH staging buffers double-buffer
+    the pipeline so step N's parse overlaps step N-1's transfer;
   * throughput is logged every 10 MB like the reference's iterators
     (src/data/basic_row_iter.h:68-75).
+
+Batch-borrowing contract: a partition iterator's yielded dict is only
+read BETWEEN the yield and the next ``next()`` call on that same
+iterator — the feed copies it into the staging buffer immediately — so
+iterators may reuse one output buffer per step (the in-repo feeds do;
+see recordio_packed_feed) instead of allocating fresh arrays on the hot
+path.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 import threading
+import time
 from queue import Queue
-from typing import Dict, Iterator, Optional
+from typing import Dict, Iterator, List, Optional
 
 import numpy as np
 
-from ..base import check
-from ..parallel.mesh import AXIS_DP, AXIS_SP, mesh_config
+from ..base import check, get_env
+from ..concurrency import BufferPool
+from ..parallel.mesh import AXIS_DP, AXIS_SP, addressable_shards, \
+    mesh_config
 
 
 class _ProducerError:
@@ -35,7 +51,8 @@ class _ProducerError:
         self.exc = exc
 
 
-def pack_rowblock(blk, batch_size: int, max_nnz: int, num_col: int = 0):
+def pack_rowblock(blk, batch_size: int, max_nnz: int, num_col: int = 0,
+                  out: Optional[Dict[str, np.ndarray]] = None):
     """RowBlock (CSR) → fixed-shape dense-index batch dict.
 
     Returns {label [B], value [B,K], index [B,K], mask [B,K]} float32/int32,
@@ -43,17 +60,29 @@ def pack_rowblock(blk, batch_size: int, max_nnz: int, num_col: int = 0):
     XLA from recompiling per batch.  When num_col > 0, feature indices are
     clamped to [0, num_col) so downstream gathers into a [num_col] weight
     vector are always in bounds.
+
+    ``out`` (same keys/shapes/dtypes as the return value) is filled in
+    place and returned, so a hot loop that copies batches onward anyway
+    — the DeviceFeed staging pipeline — reuses one output buffer per
+    iterator instead of allocating four arrays per batch.
     """
+    if out is None:
+        out = {"label": np.empty(batch_size, np.float32),
+               "value": np.empty((batch_size, max_nnz), np.float32),
+               "index": np.empty((batch_size, max_nnz), np.int32),
+               "mask": np.empty((batch_size, max_nnz), np.float32)}
+    label, value = out["label"], out["value"]
+    index, mask = out["index"], out["mask"]
     b = min(batch_size, blk.size)
-    label = np.zeros(batch_size, np.float32)
+    label[b:] = 0
     label[:b] = blk.label[:b]
     src_val = np.asarray(blk.value)
     src_idx = np.asarray(blk.index)
     if b == 0 or src_val.size == 0:
-        zeros = np.zeros((batch_size, max_nnz), np.float32)
-        return {"label": label, "value": zeros,
-                "index": np.zeros((batch_size, max_nnz), np.int32),
-                "mask": zeros.copy()}
+        value[:] = 0
+        index[:] = 0
+        mask[:] = 0
+        return out
     # vectorized CSR -> padded batch via a broadcast GATHER (each cell
     # reads offset[row] + column, masked past the row length) — no
     # per-row Python loop, no fancy scatter
@@ -62,19 +91,47 @@ def pack_rowblock(blk, batch_size: int, max_nnz: int, num_col: int = 0):
     ar = np.arange(max_nnz, dtype=np.int64)
     sel = ar[None, :] < lens[:, None]                        # [b, K]
     src = np.minimum(offsets[:-1, None] + ar[None, :], src_val.size - 1)
-    value = src_val[src].astype(np.float32, copy=False)
-    index = src_idx[src].astype(np.int32)
-    mask = sel.astype(np.float32)
-    value = value * mask
-    index *= sel
-    if b < batch_size:
-        pad = batch_size - b
-        value = np.vstack([value, np.zeros((pad, max_nnz), np.float32)])
-        index = np.vstack([index, np.zeros((pad, max_nnz), np.int32)])
-        mask = np.vstack([mask, np.zeros((pad, max_nnz), np.float32)])
+    value[b:] = 0
+    value[:b] = src_val[src]
+    value[:b] *= sel
+    index[b:] = 0
+    index[:b] = src_idx[src]
+    index[:b] *= sel
+    mask[b:] = 0
+    mask[:b] = sel
     if num_col > 0:
         np.minimum(index, num_col - 1, out=index)
-    return {"label": label, "value": value, "index": index, "mask": mask}
+    return out
+
+
+class _StagingBuf:
+    """One pooled global host batch: per-key arrays of shape
+    ``(n_parts * per_part_dim0, *rest)``.  A drained partition's slice
+    is simply left stale — placement substitutes a cached device-resident
+    zero shard, so nothing ever reads it."""
+
+    __slots__ = ("bufs",)
+
+    def __init__(self, template: Dict[str, np.ndarray], n_parts: int):
+        self.bufs = {
+            k: np.empty((n_parts * v.shape[0],) + v.shape[1:], v.dtype)
+            for k, v in template.items()
+        }
+
+
+class _Slot:
+    """A staging buffer bound to one pipeline step: complete (ready to
+    place) once every parser worker has checked its partitions in."""
+
+    __slots__ = ("step", "sbuf", "alive", "workers_left", "done")
+
+    def __init__(self, step: int, sbuf: _StagingBuf, n_parts: int,
+                 n_workers: int):
+        self.step = step
+        self.sbuf = sbuf
+        self.alive = np.zeros(n_parts, bool)
+        self.workers_left = n_workers
+        self.done = False
 
 
 class DeviceFeed:
@@ -88,11 +145,32 @@ class DeviceFeed:
     for single-epoch use.  Batches are stacked on the leading axis and
     placed with a NamedSharding over the data axes, so the leading dim
     of the global batch is n_parts * per_part_batch.
+
+    Pipeline: ``num_workers`` (DMLC_FEED_WORKERS) threads parse
+    partitions — worker w owns partitions ``p ≡ w (mod W)``, so each
+    partition's batch order is preserved — writing every batch directly
+    into its slice of a pooled staging buffer; a placer thread ships
+    completed buffers shard-by-shard to their addressable devices and
+    recycles them through a ``queue_depth`` (DMLC_FEED_DEPTH) deep
+    BufferPool, overlapping parse with transfer.
+
+    Every yielded batch carries a ``parts_alive`` float32 host array of
+    shape ``[n_parts]``: 1.0 where the partition contributed real rows,
+    0.0 where a drained partition was padded with (cached, pre-placed)
+    zero shards — consumers down-weight epoch-tail padding with it.
     """
 
-    def __init__(self, mesh, part_sources, *, queue_depth: int = 2,
-                 axes=(AXIS_DP, AXIS_SP), log_every_mb: int = 10):
+    def __init__(self, mesh, part_sources, *,
+                 queue_depth: Optional[int] = None,
+                 axes=(AXIS_DP, AXIS_SP), log_every_mb: int = 10,
+                 num_workers: int = 0):
         import jax
+
+        if queue_depth is not None:
+            # the staging pool must be bounded; the pre-pipeline
+            # queue_depth=0 "unbounded queue" spelling is gone
+            check(queue_depth >= 1,
+                  f"queue_depth must be >= 1, got {queue_depth}")
 
         self.mesh = mesh
         cfg = mesh_config(mesh)
@@ -101,76 +179,231 @@ class DeviceFeed:
             n_parts *= cfg.axis_size(a)
         check(len(part_sources) == n_parts,
               f"need {n_parts} partition sources, got {len(part_sources)}")
+        self._n_parts = n_parts
         self._multi_epoch = all(callable(s) for s in part_sources)
         self._sources = part_sources
         self._epochs_started = 0
         self.sharding = jax.sharding.NamedSharding(
             mesh, jax.sharding.PartitionSpec(axes)
         )
-        self._depth = queue_depth
-        self._queue: Queue = Queue(maxsize=queue_depth)
+        self._depth = (queue_depth if queue_depth is not None
+                       else max(1, get_env("DMLC_FEED_DEPTH", 2)))
+        self._workers = max(1, min(n_parts, num_workers
+                            or get_env("DMLC_FEED_WORKERS",
+                                       min(4, os.cpu_count() or 2))))
+        self._queue: Queue = Queue(maxsize=self._depth)
         self.part_iters: list = []
-        self._part_done = [False] * len(part_sources)
+        self._part_done = [False] * n_parts
+        self._n_dead = 0
         self._template: Optional[Dict[str, np.ndarray]] = None
-        self._thread: Optional[threading.Thread] = None
+        self._pool: Optional[BufferPool] = None
+        self._pending: Dict[int, _Slot] = {}
+        self._cv = threading.Condition()
+        self._error: Optional[BaseException] = None
+        self._empty_epoch = False
+        self._thread: Optional[threading.Thread] = None  # placer
+        self._parsers: List[threading.Thread] = []
         self._stop = threading.Event()
+        self._shard_maps: Dict[str, list] = {}
+        self._zero_shards: Dict[tuple, object] = {}
+        self._host_aliasing: Optional[bool] = None
         self._log_every = log_every_mb << 20
         self._bytes = 0
         self._last_log = 0
         self._t0 = None
 
-    # ---- producer ------------------------------------------------------
-    def _assemble(self) -> Optional[Dict[str, "np.ndarray"]]:
-        """Next global batch, or None at epoch end.
+    # ---- parser workers ------------------------------------------------
+    def _fail(self, exc: BaseException) -> None:
+        with self._cv:
+            if self._error is None:
+                self._error = exc
+            self._cv.notify_all()
+        self._stop.set()
+        if self._pool is not None:
+            self._pool.kill()
 
-        Byte-range partitions hold unequal record counts, so shards drain
-        at different times; drained partitions contribute all-zero
-        (masked-out) batches until every partition is done — SPMD shards
-        step in lockstep AND no records are dropped at the epoch tail."""
-        parts: list = [None] * len(self.part_iters)
-        alive = 0
-        for i, it in enumerate(self.part_iters):
-            if not self._part_done[i]:
-                batch = next(it, None)
-                if batch is None:
-                    self._part_done[i] = True
-                else:
-                    parts[i] = batch
-                    alive += 1
-                    if self._template is None:
-                        self._template = {
-                            k: np.zeros_like(v) for k, v in batch.items()
-                        }
-        if alive == 0:
+    def _parse_part(self, p: int):
+        """Next batch of partition ``p`` (None once drained).  Sets the
+        feed-wide template from the first batch ever seen."""
+        from .. import telemetry
+
+        if self._part_done[p]:
             return None
-        for i, p in enumerate(parts):
-            if p is None:
-                parts[i] = self._template
-        keys = parts[0].keys()
-        return {k: np.concatenate([p[k] for p in parts], axis=0)
-                for k in keys}
+        with telemetry.span("feed.parse", stage="feed", args={"part": p}):
+            batch = next(self.part_iters[p], None)
+        if batch is None:
+            with self._cv:
+                self._part_done[p] = True
+                self._n_dead += 1
+                if self._n_dead == self._n_parts:
+                    self._cv.notify_all()
+            return None
+        if self._template is None:
+            with self._cv:
+                if self._template is None:
+                    self._template = {
+                        k: np.zeros_like(v) for k, v in batch.items()
+                    }
+                    self._cv.notify_all()
+        return batch
 
-    def _produce(self):
-        import time
+    def _checkin_slot(self, step: int) -> Optional[_Slot]:
+        """The staging slot for ``step``, creating it from the pool if
+        this worker arrives first.  None on stop/error/empty epoch."""
+        from .. import telemetry
 
+        with self._cv:
+            while self._template is None:
+                # nothing parsed yet anywhere: either another worker is
+                # about to set the template, or the whole epoch is empty
+                if self._error is not None or self._stop.is_set():
+                    return None
+                if self._n_dead == self._n_parts:
+                    self._empty_epoch = True
+                    self._cv.notify_all()
+                    return None
+                self._cv.wait(0.1)
+            slot = self._pending.get(step)
+        if slot is not None:
+            return slot
+        # stage stall: parsing ran ahead of the transfer pipeline and is
+        # waiting for a staging buffer to come back from the placer.
+        # The acquire must stay a poll loop: while this worker waits,
+        # another worker may create this very step's slot with the last
+        # free buffer — blocking without re-checking _pending deadlocks.
+        t0 = time.perf_counter()
+        try:
+            while True:
+                sbuf = self._pool.acquire(timeout=0.05)
+                if sbuf is not None:
+                    break
+                if self._stop.is_set() or self._error is not None:
+                    return None
+                with self._cv:
+                    slot = self._pending.get(step)
+                if slot is not None:
+                    return slot
+        finally:
+            telemetry.observe_duration("feed", "stage_stall",
+                                       time.perf_counter() - t0)
+        with self._cv:
+            slot = self._pending.get(step)
+            if slot is not None:  # another worker won the race
+                self._pool.release(sbuf)
+                return slot
+            slot = _Slot(step, sbuf, self._n_parts, self._workers)
+            self._pending[step] = slot
+            return slot
+
+    def _write_part(self, slot: _Slot, p: int, batch) -> None:
+        from .. import telemetry
+
+        sbuf = slot.sbuf
+        if batch is None:
+            return  # drained: placement serves a cached zero shard
+        with telemetry.span("feed.stage", stage="feed", args={"part": p}):
+            for k, t in self._template.items():
+                d0 = t.shape[0]
+                dst = sbuf.bufs[k][p * d0:(p + 1) * d0]
+                src = batch[k]
+                check(dst.shape == src.shape and dst.dtype == src.dtype,
+                      f"partition {p} batch key '{k}' is "
+                      f"{src.shape}/{src.dtype}, expected "
+                      f"{dst.shape}/{dst.dtype}")
+                np.copyto(dst, src)
+        slot.alive[p] = True
+
+    # ---- placer --------------------------------------------------------
+    def _shard_map(self, key: str) -> list:
+        m = self._shard_maps.get(key)
+        if m is None:
+            shape = self._staging_shape(key)
+            m = addressable_shards(self.sharding, shape)
+            self._shard_maps[key] = m
+        return m
+
+    def _staging_shape(self, key: str) -> tuple:
+        t = self._template[key]
+        return (self._n_parts * t.shape[0],) + t.shape[1:]
+
+    def _place(self, slot: _Slot) -> Dict[str, "object"]:
+        """Per-shard placement: each partition's slice goes straight to
+        its addressable device(s); drained partitions reuse a cached,
+        already-placed zero shard (no bytes shipped for padding)."""
+        import jax
+
+        if self._host_aliasing is None:
+            # jax's CPU backend zero-copies device_put of an aligned
+            # host array: the "device" buffer IS the staging memory, so
+            # recycling the staging buffer would mutate already-yielded
+            # batches.  Accelerator backends DMA a real copy and keep
+            # the zero-copy hand-off.
+            self._host_aliasing = jax.devices()[0].platform == "cpu"
+        out = {}
+        for k, t in self._template.items():
+            d0 = t.shape[0]
+            buf = slot.sbuf.bufs[k]
+            arrs = []
+            for pos, (dev, idx) in enumerate(self._shard_map(k)):
+                p = (idx[0].start or 0) // d0
+                if slot.alive[p]:
+                    src = buf[idx]
+                    if self._host_aliasing:
+                        src = src.copy()
+                    arrs.append(jax.device_put(src, dev))
+                else:
+                    z = self._zero_shards.get((k, pos))
+                    if z is None:
+                        z = jax.device_put(np.zeros_like(buf[idx]), dev)
+                        self._zero_shards[(k, pos)] = z
+                    arrs.append(z)
+            out[k] = jax.make_array_from_single_device_arrays(
+                buf.shape, self.sharding, arrs)
+        return out
+
+    def _place_loop(self) -> None:
         import jax
 
         from .. import telemetry
 
         self._t0 = time.perf_counter()
+        step = 0
         try:
-            while not self._stop.is_set():
+            while True:
                 with telemetry.span("feed.assemble", stage="feed"), \
-                        telemetry.timed("feed", "assemble"):
-                    host = self._assemble()
-                if host is None:
+                        telemetry.timed("feed", "assemble"), self._cv:
+                    # "assembly" = waiting for the parser workers to
+                    # complete this step's staging buffer
+                    while not (self._error is not None
+                               or self._empty_epoch
+                               or (step in self._pending
+                                   and self._pending[step].done)):
+                        if self._stop.is_set():
+                            return
+                        self._cv.wait(0.1)
+                    if self._error is not None:
+                        raise self._error
+                    if self._empty_epoch:
+                        slot = None
+                    else:
+                        slot = self._pending.pop(step)
+                if slot is None or not slot.alive.any():
+                    # every partition drained: end of epoch
+                    self._stop.set()
+                    if self._pool is not None:
+                        self._pool.kill()  # wake workers parked ahead
                     self._queue.put(None)
                     return
-                with telemetry.annotate("dmlc_feed_batch"), \
+                with telemetry.span("feed.place", stage="feed"), \
+                        telemetry.annotate("dmlc_feed_batch"), \
                         telemetry.timed("feed", "device_put"):
-                    dev = {k: jax.device_put(v, self.sharding)
-                           for k, v in host.items()}
-                nbytes = sum(v.nbytes for v in host.values())
+                    dev = self._place(slot)
+                dev["parts_alive"] = slot.alive.astype(np.float32)
+                # count bytes actually shipped: drained partitions ride
+                # cached device-resident zero shards, not the link
+                nbytes = (sum(v.nbytes // self._n_parts
+                              for v in slot.sbuf.bufs.values())
+                          * int(slot.alive.sum()))
                 self._bytes += nbytes
                 telemetry.inc("feed", "batches")
                 telemetry.inc("feed", "bytes_to_device", nbytes)
@@ -186,22 +419,32 @@ class DeviceFeed:
                 # a full queue means the consumer is the bottleneck
                 with telemetry.timed("feed", "producer_stall"):
                     self._queue.put(dev)
+                # the transfers must land before the staging buffer is
+                # recycled for a later step (device arrays never alias
+                # host staging memory after this point)
+                jax.block_until_ready(
+                    [dev[k] for k in self._template.keys()])
+                self._pool.release(slot.sbuf)
+                step += 1
         except BaseException as e:  # surface on the consumer side
+            self._fail(e)
             self._queue.put(_ProducerError(e))
 
     # ---- consumer ------------------------------------------------------
     def __iter__(self) -> Iterator[Dict[str, "object"]]:
-        if self._thread is not None:
-            # A producer that already delivered its None sentinel is done
+        threads = ([self._thread] if self._thread else []) + self._parsers
+        for t in threads:
+            # A pipeline that already delivered its None sentinel is done
             # but may not have exited yet; give it a moment rather than
             # spuriously refusing an immediate epoch restart.
-            self._thread.join(timeout=2.0)
-            if self._thread.is_alive():
+            t.join(timeout=2.0)
+            if t.is_alive():
                 raise RuntimeError(
                     "previous DeviceFeed epoch still in flight: exhaust "
                     "the iterator or close() before starting a new epoch"
                 )
-            self._thread = None
+        self._thread = None
+        self._parsers = []
         if self._epochs_started > 0 and not self._multi_epoch:
             raise RuntimeError(
                 "DeviceFeed built from plain iterators is single-epoch: "
@@ -209,10 +452,24 @@ class DeviceFeed:
             )
         self._epochs_started += 1
         self.part_iters = [s() if callable(s) else s for s in self._sources]
-        self._part_done = [False] * len(self._sources)
+        self._part_done = [False] * self._n_parts
+        self._n_dead = 0
+        self._pending = {}
+        self._error = None
+        self._empty_epoch = False
         self._queue = Queue(maxsize=self._depth)
         self._stop.clear()
-        self._thread = threading.Thread(target=self._produce, daemon=True)
+        self._pool = BufferPool(
+            functools.partial(self._make_staging), capacity=self._depth)
+        self._parsers = [
+            threading.Thread(target=self._parser_worker, args=(w,),
+                             daemon=True)
+            for w in range(self._workers)
+        ]
+        for t in self._parsers:
+            t.start()
+        self._thread = threading.Thread(target=self._place_loop,
+                                        daemon=True)
         self._thread.start()
         from .. import telemetry
 
@@ -226,30 +483,60 @@ class DeviceFeed:
                 raise item.exc
             yield item
 
-    def close(self):
-        import time
+    def _make_staging(self) -> _StagingBuf:
+        return _StagingBuf(self._template, self._n_parts)
 
+    def _parser_worker(self, w: int) -> None:
+        my_parts = list(range(w, self._n_parts, self._workers))
+        step = 0
+        try:
+            while not self._stop.is_set():
+                # parse first, then stage: the slot (and the staging
+                # shapes) only exist once SOME batch defined the template
+                produced = {p: self._parse_part(p) for p in my_parts}
+                slot = self._checkin_slot(step)
+                if slot is None:
+                    return
+                for p in my_parts:
+                    self._write_part(slot, p, produced[p])
+                with self._cv:
+                    slot.workers_left -= 1
+                    if slot.workers_left == 0:
+                        slot.done = True
+                        self._cv.notify_all()
+                step += 1
+        except BaseException as e:  # noqa: BLE001 - surfaced to consumer
+            self._fail(e)
+
+    def close(self):
         self._stop.set()
-        # drain so a producer blocked on a full queue can observe the stop
+        if self._pool is not None:
+            self._pool.kill()
+        with self._cv:
+            self._cv.notify_all()
+        # drain so a placer blocked on a full queue can observe the stop
         # flag, then actually join it — close() must leave no live thread
-        t = self._thread
+        threads = ([self._thread] if self._thread else []) + self._parsers
         deadline = time.monotonic() + 5.0
-        while t is not None and t.is_alive() and time.monotonic() < deadline:
+        while (any(t.is_alive() for t in threads)
+               and time.monotonic() < deadline):
             while not self._queue.empty():
                 try:
                     self._queue.get_nowait()
                 except Exception:
                     break
-            t.join(timeout=0.05)
-        if t is None or not t.is_alive():
+            for t in threads:
+                t.join(timeout=0.05)
+        if not any(t.is_alive() for t in threads):
             self._thread = None
+            self._parsers = []
         else:
             # keep _thread set so __iter__'s in-flight guard still
-            # refuses to start a second producer over live shared state
+            # refuses to start a second pipeline over live shared state
             from ..logging import warning
 
             warning(
-                "DeviceFeed.close(): producer thread still alive after "
+                "DeviceFeed.close(): pipeline thread still alive after "
                 "5s (likely a hung device_put); leaking a daemon thread")
 
     @property
@@ -258,7 +545,7 @@ class DeviceFeed:
 
 
 def libsvm_feed(uri: str, mesh, *, batch_size: int, max_nnz: int,
-                fmt: str = "libsvm", queue_depth: int = 2) -> DeviceFeed:
+                fmt: str = "libsvm", queue_depth: Optional[int] = None) -> DeviceFeed:
     """Sparse text formats (libsvm/csv/libfm) → sharded padded-CSR batches.
 
     ``batch_size`` is per partition; the global leading dim is
@@ -272,11 +559,16 @@ def libsvm_feed(uri: str, mesh, *, batch_size: int, max_nnz: int,
     def part_iter(part: int):
         it = create_row_iter(uri, part, n_parts, fmt)
         ncol = it.num_col()
+        out = None
         for blk in it:
-            # re-slice parser blocks into fixed batches
+            # re-slice parser blocks into fixed batches; the yielded
+            # dict is BORROWED (overwritten on the next batch) per the
+            # DeviceFeed batch-borrowing contract
             for lo in range(0, blk.size, batch_size):
                 sub = blk.slice(lo, min(lo + batch_size, blk.size))
-                yield pack_rowblock(sub, batch_size, max_nnz, ncol)
+                out = pack_rowblock(sub, batch_size, max_nnz, ncol,
+                                    out=out)
+                yield out
 
     # factories, not iterators: each epoch re-creates the row iters (which
     # hit the DiskRowIter/#cachefile cache when the URI requests one)
@@ -357,44 +649,38 @@ def _chunk_record_views(mv: memoryview):
     return out
 
 
-def _recordio_chunk_rows(mv: memoryview, max_bytes: int, group_rows: int):
-    """One record-aligned RecordIO chunk → groups of ([g, max_bytes]
-    uint8 rows, [g] int32 lengths), each a single numpy gather (no
-    per-record Python loop), yielded in ≤ group_rows slices so peak
-    memory is bounded by the caller's batch size, not the chunk's
-    record count (a chunk of tiny records can hold 100k+ of them).
+def _gather_rows_into(mv: memoryview, sp, lo: int, hi: int,
+                      max_bytes: int, out_rows: np.ndarray,
+                      out_lens: np.ndarray) -> None:
+    """Gather span records ``[lo, hi)`` of one RecordIO chunk into the
+    caller-provided ``out_rows [hi-lo, max_bytes]`` / ``out_lens`` —
+    a single broadcast numpy gather straight into the batch buffer (no
+    per-record Python loop, no intermediate row array).
 
-    The native span scan yields (offset, len, flag) per logical record;
-    flag-0 payloads are gathered with a broadcast index, the rare flag-1
+    The span scan yields (offset, len, flag) per logical record; flag-0
+    payloads are gathered with a broadcast index, the rare flag-1
     multi-segment records are reassembled individually afterwards."""
-    sp = _chunk_spans(mv)
     arr = np.frombuffer(mv, np.uint8)
-    all_offs = sp[:, 0].astype(np.int32)   # chunk-local: always < 2^31
-    all_lens = np.minimum(sp[:, 1].astype(np.int64), max_bytes)
-    all_flags = sp[:, 2]
-    ar = np.arange(max_bytes, dtype=np.int32)
-    # keep the transient gather index ≲16 MB even for MB-sized records
-    group = max(1, min(group_rows, (16 << 20) // max(max_bytes, 1)))
-    for lo in range(0, all_offs.shape[0], group):
-        hi = min(lo + group, all_offs.shape[0])
-        offs, lens = all_offs[lo:hi], all_lens[lo:hi].copy()
-        idx = offs[:, None] + ar[None, :]
-        np.minimum(idx, arr.size - 1, out=idx)
-        rows = arr[idx]
-        rows *= ar[None, :].astype(np.int64) < lens[:, None]
-        for i in np.nonzero(all_flags[lo:hi] == 1)[0]:  # escaped magic
-            payload = _reassemble_region(mv, int(offs[i]),
-                                         int(sp[lo + i, 1]))
-            n = min(len(payload), max_bytes)
-            rows[i, :n] = np.frombuffer(payload, np.uint8, n)
-            rows[i, n:] = 0
-            lens[i] = n
-        yield rows, lens.astype(np.int32)
+    offs = sp[lo:hi, 0].astype(np.int32)   # chunk-local: always < 2^31
+    lens = np.minimum(sp[lo:hi, 1].astype(np.int64), max_bytes)
+    g = hi - lo
+    idx = offs[:, None] + np.arange(max_bytes, dtype=np.int32)[None, :]
+    np.minimum(idx, arr.size - 1, out=idx)
+    np.take(arr, idx, out=out_rows[:g])
+    out_rows[:g] *= (np.arange(max_bytes, dtype=np.int64)[None, :]
+                     < lens[:, None])
+    for i in np.nonzero(sp[lo:hi, 2] == 1)[0]:  # escaped magic
+        payload = _reassemble_region(mv, int(offs[i]), int(sp[lo + i, 1]))
+        n = min(len(payload), max_bytes)
+        out_rows[i, :n] = np.frombuffer(payload, np.uint8, n)
+        out_rows[i, n:] = 0
+        lens[i] = n
+    out_lens[:g] = lens
 
 
 def recordio_packed_feed(uri: str, mesh, *, buf_bytes: int,
                          max_records: int = 4096,
-                         queue_depth: int = 2) -> DeviceFeed:
+                         queue_depth: Optional[int] = None) -> DeviceFeed:
     """RecordIO shards → packed batches with NO per-record padding:
     {data [buf_bytes] uint8, offsets [max_records+1] int32, count [1]}.
 
@@ -420,26 +706,29 @@ def recordio_packed_feed(uri: str, mesh, *, buf_bytes: int,
             # pending-payload array, no concat chain, no second copy.
             # The round-4 producer profile showed exactly those copies
             # as the remaining Python-side cost of the packed path.
+            # The batch dict is BORROWED (DeviceFeed copies it into the
+            # staging buffer before resuming this generator), so ONE
+            # data/offsets/count buffer serves the whole epoch — zero
+            # steady-state allocation.
             data = np.empty(buf_bytes, np.uint8)
+            offsets = np.empty(max_records + 1, np.int32)
+            count_arr = np.empty(1, np.int32)
             ends = np.empty(max_records, np.int64)
             count = 0
             pos = 0
 
             def emit():
-                nonlocal data, count, pos
+                nonlocal count, pos
                 data[pos:] = 0  # zero tail only, not the whole buffer
-                offsets = np.zeros(max_records + 1, np.int64)
+                np.minimum(ends[:count], buf_bytes, out=ends[:count])
+                offsets[0] = 0
                 offsets[1: count + 1] = ends[:count]
-                np.minimum(offsets, buf_bytes, out=offsets)
                 offsets[count + 1:] = offsets[count]
-                batch = {"data": data,
-                         "offsets": offsets.astype(np.int32),
-                         "count": np.array([count], np.int32)}
-                # fresh buffer: the shipped one may still be in flight
-                data = np.empty(buf_bytes, np.uint8)
+                count_arr[0] = count
                 count = 0
                 pos = 0
-                return batch
+                return {"data": data, "offsets": offsets,
+                        "count": count_arr}
 
             while True:
                 mv = split.next_chunk()
@@ -479,7 +768,7 @@ def recordio_packed_feed(uri: str, mesh, *, buf_bytes: int,
 
 
 def recordio_feed(uri: str, mesh, *, batch_records: int, max_bytes: int,
-                  queue_depth: int = 2) -> DeviceFeed:
+                  queue_depth: Optional[int] = None) -> DeviceFeed:
     """RecordIO shards → {data [B, max_bytes] uint8, length [B] int32}.
 
     Payload decode (e.g. images) happens on device or downstream; this
@@ -495,37 +784,37 @@ def recordio_feed(uri: str, mesh, *, batch_records: int, max_bytes: int,
     def part_iter(part: int):
         split = input_split.create(uri, part, n_parts, "recordio")
         try:
-            pend_rows = pend_lens = None
-
-            def groups():
-                while True:
-                    mv = split.next_chunk()
-                    if mv is None:
-                        return
-                    yield from _recordio_chunk_rows(mv, max_bytes,
-                                                    batch_records)
-
-            for rows, lens in groups():
-                if pend_rows is not None and pend_rows.shape[0]:
-                    rows = np.concatenate([pend_rows, rows])
-                    lens = np.concatenate([pend_lens, lens])
-                pend_rows = pend_lens = None
-                n = rows.shape[0]
-                full = (n // batch_records) * batch_records
-                for lo in range(0, full, batch_records):
-                    yield {"data": rows[lo:lo + batch_records],
-                           "length": lens[lo:lo + batch_records]}
-                if full < n:  # rows are copies (gather output): safe to hold
-                    pend_rows = rows[full:]
-                    pend_lens = lens[full:]
-            if pend_rows is not None and pend_rows.shape[0]:
+            # ONE batch buffer per iterator, filled in place chunk by
+            # chunk and yielded BORROWED (the DeviceFeed staging copy
+            # happens before this generator resumes) — no pending-row
+            # concat chain, no per-group row allocation.
+            data = np.empty((batch_records, max_bytes), np.uint8)
+            length = np.empty(batch_records, np.int32)
+            batch = {"data": data, "length": length}
+            # bound the transient gather index ≲16 MB even for MB-sized
+            # records by splitting a chunk's spans into groups
+            group_cap = max(1, (16 << 20) // max(max_bytes, 1))
+            r = 0
+            while True:
+                mv = split.next_chunk()
+                if mv is None:
+                    break
+                sp = _chunk_spans(mv)
+                i, n_spans = 0, sp.shape[0]
+                while i < n_spans:
+                    g = min(n_spans - i, batch_records - r, group_cap)
+                    _gather_rows_into(mv, sp, i, i + g, max_bytes,
+                                      data[r:], length[r:])
+                    i += g
+                    r += g
+                    if r == batch_records:
+                        yield batch
+                        r = 0
+            if r:
                 # zero-pad the epoch's final short batch
-                data = np.zeros((batch_records, max_bytes), np.uint8)
-                length = np.zeros(batch_records, np.int32)
-                r = pend_rows.shape[0]
-                data[:r] = pend_rows
-                length[:r] = pend_lens
-                yield {"data": data, "length": length}
+                data[r:] = 0
+                length[r:] = 0
+                yield batch
         finally:
             split.close()
 

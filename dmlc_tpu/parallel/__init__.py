@@ -16,6 +16,7 @@ from .mesh import (  # noqa: F401
     AXIS_TP,
     MESH_AXES,
     MeshConfig,
+    addressable_shards,
     build_mesh,
     factorize_devices,
 )

@@ -141,3 +141,18 @@ def build_mesh(
 
 def mesh_config(mesh) -> MeshConfig:
     return MeshConfig(shape={a: mesh.shape[a] for a in mesh.axis_names})
+
+
+def addressable_shards(sharding, global_shape: Sequence[int]):
+    """``[(device, index)]`` for every addressable device of ``sharding``.
+
+    ``index`` is the tuple of slices selecting that device's shard of a
+    host array of ``global_shape`` — the enumeration a zero-copy feed
+    needs to ``jax.device_put`` each host shard straight onto its device
+    and reassemble with ``jax.make_array_from_single_device_arrays``
+    (devices replicated over non-data axes legitimately repeat an index).
+    The order is stable for a given sharding, so per-device caches keyed
+    by position are safe across steps.
+    """
+    imap = sharding.addressable_devices_indices_map(tuple(global_shape))
+    return list(imap.items())

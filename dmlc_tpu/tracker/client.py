@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import json
 import os
+import select
 import socket
 import time
 from typing import Dict, Optional
@@ -24,6 +25,25 @@ from ..resilience import RetryPolicy, fault_point
 from .protocol import MAGIC, FrameSocket
 
 __all__ = ["TrackerClient"]
+
+
+def _ring_min_bytes() -> int:
+    """Payload size at which allreduce cuts over from the binomial tree
+    to the chunked ring (DMLC_COLL_RING_MIN_BYTES, default 1 MB; 0
+    forces the ring whenever links exist, negative disables it).
+
+    The tree finishes in 2·log2(n) hops but moves the FULL payload
+    through every tree level — its per-link traffic does not shrink
+    with n.  The ring pays 2·(n-1) latency rounds but each rank only
+    ever sends 2·(n-1)/n of the payload, all links busy at once, so it
+    wins as soon as bandwidth dominates latency.  Small control-plane
+    messages stay on the tree."""
+    from ..base import get_env
+
+    return get_env("DMLC_COLL_RING_MIN_BYTES", 1 << 20)
+
+
+_RING_PIECE = 1 << 20  # sub-chunk granularity for the duplex transfer
 
 
 def _connect_timeout() -> Optional[float]:
@@ -268,42 +288,163 @@ class TrackerClient:
         n = fs.recv_int()
         return np.frombuffer(fs.recv_all(n), dtype=like.dtype).reshape(like.shape)
 
-    def allreduce(self, arr: np.ndarray, op: str = "sum") -> np.ndarray:
-        """Binomial-tree allreduce (reduce to root, broadcast back).
-        op ∈ {sum, max, min}.
+    def allreduce(self, arr: np.ndarray, op: str = "sum",
+                  algo: Optional[str] = None) -> np.ndarray:
+        """Host-side allreduce, op ∈ {sum, max, min}.
+
+        Small payloads ride the binomial tree (reduce to root, broadcast
+        back — 2·log2(n) hops); payloads at or above
+        DMLC_COLL_RING_MIN_BYTES cut over to a chunked ring
+        (reduce-scatter + allgather over the tracker-brokered
+        ``ring_prev``/``ring_next`` links) whose per-rank traffic is
+        2·(n-1)/n of the payload instead of the tree's full payload per
+        level.  ``algo`` ∈ {None, "tree", "ring"} pins the choice (the
+        benchmark reports both side by side).
 
         Fully instrumented: a ``collective.allreduce`` span (op/byte/rank
-        tags) plus a ``barrier_enter`` event — on the tracker's corrected
-        /trace timeline these spans line up across ranks, so the rank
-        whose span STARTS last is the straggler by direct reading, and
-        the ``barrier_wait_secs`` histogram (time blocked on the reduce
-        wave) quantifies how long everyone else paid for it."""
+        /algo tags) plus a ``barrier_enter`` event — on the tracker's
+        corrected /trace timeline these spans line up across ranks, so
+        the rank whose span STARTS last is the straggler by direct
+        reading, and the ``barrier_wait_secs`` histogram (time blocked on
+        the reduce wave) quantifies how long everyone else paid for it."""
         from .. import telemetry
 
-        fold = {"sum": np.add, "max": np.maximum, "min": np.minimum}[op]
+        if algo not in (None, "tree", "ring"):
+            raise ValueError(f"unknown allreduce algo {algo!r} "
+                             "(expected None, 'tree' or 'ring')")
         arr = np.ascontiguousarray(arr)
         if self.world_size <= 1:
             return arr.copy()
+        if algo is None:
+            # NB: the cutover must be gang-uniform — every rank has to
+            # pick the same algorithm for the same collective or the
+            # byte streams desynchronize (the launcher propagates one
+            # env to all workers, so DMLC_COLL_RING_MIN_BYTES is uniform
+            # unless an operator splits it on purpose).  Selection is
+            # therefore a pure function of (env, payload size); a rank
+            # whose ring links are missing fails loudly below instead of
+            # silently diverging onto the tree.
+            min_bytes = _ring_min_bytes()
+            algo = ("ring" if min_bytes >= 0 and arr.nbytes >= min_bytes
+                    else "tree")
+        if algo == "ring" and (self.ring_prev not in self.links
+                               or self.ring_next not in self.links):
+            raise ConnectionError(
+                f"rank {self.rank}: ring allreduce selected but ring "
+                f"links ({self.ring_prev}, {self.ring_next}) are not "
+                "established — topology bug or partial recovery")
         telemetry.record_event("barrier_enter", site="allreduce", op=op,
                                rank=self.rank, bytes=int(arr.nbytes))
         with telemetry.span("collective.allreduce", stage="collective",
                             args={"op": op, "bytes": int(arr.nbytes),
-                                  "rank": self.rank}):
-            children = [r for r in self.tree_nbrs if r != self.parent]
-            acc = arr.astype(arr.dtype, copy=True)
-            t0 = time.perf_counter()
-            for c in children:
-                acc = fold(acc, self._recv_array(self.links[c], acc))
-            if self.parent >= 0:
-                self._send_array(self.links[self.parent], acc)
-                acc = self._recv_array(self.links[self.parent], acc)
-            # the reduce wave completes here: everything this rank spent
-            # blocked on slower subtree/parent progress is barrier wait
-            telemetry.observe_duration("collective", "barrier_wait",
-                                       time.perf_counter() - t0)
-            for c in children:
-                self._send_array(self.links[c], acc)
+                                  "rank": self.rank, "algo": algo}):
+            if algo == "ring":
+                return self._ring_allreduce(arr, op)
+            return self._tree_allreduce(arr, op)
+
+    def _tree_allreduce(self, arr: np.ndarray, op: str) -> np.ndarray:
+        from .. import telemetry
+
+        fold = {"sum": np.add, "max": np.maximum, "min": np.minimum}[op]
+        children = [r for r in self.tree_nbrs if r != self.parent]
+        acc = arr.astype(arr.dtype, copy=True)
+        t0 = time.perf_counter()
+        for c in children:
+            acc = fold(acc, self._recv_array(self.links[c], acc))
+        if self.parent >= 0:
+            self._send_array(self.links[self.parent], acc)
+            acc = self._recv_array(self.links[self.parent], acc)
+        # the reduce wave completes here: everything this rank spent
+        # blocked on slower subtree/parent progress is barrier wait
+        telemetry.observe_duration("collective", "barrier_wait",
+                                   time.perf_counter() - t0)
+        for c in children:
+            self._send_array(self.links[c], acc)
         return acc
+
+    def _ring_duplex(self, send_mv: memoryview, recv_mv: memoryview):
+        """Push ``send_mv`` to ring_next while pulling ``recv_mv`` from
+        ring_prev, progressing whichever direction is ready — full-duplex
+        on blocking sockets without helper threads, and deadlock-free
+        when the chunk exceeds the socket buffers (every rank sends and
+        receives simultaneously).  The two links are the same socket at
+        world == 2."""
+        snd = self.links[self.ring_next].sock
+        rcv = self.links[self.ring_prev].sock
+        # Non-blocking for the duplex, whatever the op-timeout setting:
+        # with DMLC_CLIENT_OP_TIMEOUT_S=0 the sockets are fully blocking
+        # and send() of a piece larger than the free socket buffer would
+        # park until the PEER drains — but every rank is in the same
+        # loop, so nobody would ever reach its recv and the whole ring
+        # would deadlock.  Non-blocking send() enqueues what fits and
+        # returns; progress then strictly follows select() readiness.
+        prev_timeouts = (snd.gettimeout(), rcv.gettimeout())
+        snd.setblocking(False)
+        rcv.setblocking(False)
+        ns, ng = len(send_mv), len(recv_mv)
+        sent, got = 0, 0
+        try:
+            while sent < ns or got < ng:
+                rs, ws, _ = select.select(
+                    [rcv] if got < ng else [],
+                    [snd] if sent < ns else [], [],
+                    _op_timeout() or None)
+                if not rs and not ws:
+                    raise socket.timeout("ring allreduce stalled")
+                if rs:
+                    try:
+                        k = rcv.recv_into(recv_mv[got:got + _RING_PIECE])
+                    except BlockingIOError:
+                        k = None  # spurious readiness; retry via select
+                    if k == 0:
+                        raise ConnectionError(
+                            "ring peer closed mid-collective")
+                    if k:
+                        got += k
+                if ws:
+                    try:
+                        sent += snd.send(send_mv[sent:sent + _RING_PIECE])
+                    except BlockingIOError:
+                        pass
+        finally:
+            snd.settimeout(prev_timeouts[0])
+            rcv.settimeout(prev_timeouts[1])
+
+    def _ring_allreduce(self, arr: np.ndarray, op: str) -> np.ndarray:
+        """Chunked ring: n-1 reduce-scatter steps (each rank ends up
+        owning the full reduction of one payload slice) followed by n-1
+        allgather steps circulating the reduced slices."""
+        from .. import telemetry
+
+        fold = {"sum": np.add, "max": np.maximum, "min": np.minimum}[op]
+        n, rank = self.world_size, self.rank
+        out = arr.copy()
+        flat = out.view(np.uint8).reshape(-1)
+        item = out.itemsize
+        per = ((out.size + n - 1) // n) * item
+        bounds = [min(i * per, flat.size) for i in range(n + 1)]
+        scratch = np.empty(per, np.uint8)
+        t0 = time.perf_counter()
+        for s in range(n - 1):  # reduce-scatter
+            si, ri = (rank - s) % n, (rank - s - 1) % n
+            slo, shi = bounds[si], bounds[si + 1]
+            rlo, rhi = bounds[ri], bounds[ri + 1]
+            self._ring_duplex(memoryview(flat[slo:shi]),
+                              memoryview(scratch[: rhi - rlo]))
+            if rhi > rlo:
+                dst = flat[rlo:rhi].view(out.dtype)
+                fold(dst, scratch[: rhi - rlo].view(out.dtype), out=dst)
+        # every rank now owns the reduced slice (rank+1) % n; the
+        # reduce wave completes here (straggler wait, as in the tree)
+        telemetry.observe_duration("collective", "barrier_wait",
+                                   time.perf_counter() - t0)
+        for s in range(n - 1):  # allgather
+            si, ri = (rank + 1 - s) % n, (rank - s) % n
+            slo, shi = bounds[si], bounds[si + 1]
+            rlo, rhi = bounds[ri], bounds[ri + 1]
+            self._ring_duplex(memoryview(flat[slo:shi]),
+                              memoryview(flat[rlo:rhi]))
+        return out
 
     def allreduce_sum(self, arr: np.ndarray) -> np.ndarray:
         return self.allreduce(arr, "sum")

@@ -3,10 +3,19 @@ tree-allreduce a vector, report through the tracker's print relay.
 
 Run under the launcher:
     bin/dmlc-submit --cluster local --num-workers 4 -- python examples/allreduce_worker.py
+
+With ``bench <bytes> <reps>`` arguments it becomes the host-collective
+microbench: every rank allreduces the same f64 payload through the
+binomial tree and the chunked ring (tracker/client.py), and rank 0
+prints one JSON line per algorithm in the test_collective.c convention
+(busbw = 2·(n-1)/n · algbw) — scripts/bench_collective.py runs it to
+report tree-vs-ring side by side.
 """
 
+import json
 import os
 import sys
+import time
 
 import numpy as np
 
@@ -15,13 +24,40 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from dmlc_tpu.tracker.client import TrackerClient  # noqa: E402
 
 
+def bench(client, nbytes, reps):
+    count = nbytes // 8
+    arr = np.full(count, 1.0, np.float64)
+    for algo in ("tree", "ring"):
+        out = client.allreduce(arr, "sum", algo=algo)  # warmup + sync
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = client.allreduce(arr, "sum", algo=algo)
+        dt = time.perf_counter() - t0
+        assert abs(out[0] - client.world_size) < 1e-9, out[0]
+        if client.rank == 0:
+            algbw = nbytes * reps / dt / 1e6
+            busbw = algbw * 2 * (client.world_size - 1) / client.world_size
+            print(json.dumps({
+                "op": f"host_allreduce_{algo}", "bytes": nbytes,
+                "algbw_MBps": round(algbw, 1),
+                "busbw_MBps": round(busbw, 1),
+                "world": client.world_size,
+            }), flush=True)
+
+
 def main():
     client = TrackerClient()
     client.start()
-    out = client.allreduce_sum(np.full(4, float(client.rank + 1)))
-    expected = client.world_size * (client.world_size + 1) / 2
-    assert np.allclose(out, expected), (out, expected)
-    client.log(f"rank {client.rank}/{client.world_size}: allreduce OK -> {out[0]}")
+    if len(sys.argv) > 1 and sys.argv[1] == "bench":
+        nbytes = int(sys.argv[2]) if len(sys.argv) > 2 else 64 << 20
+        reps = int(sys.argv[3]) if len(sys.argv) > 3 else 3
+        bench(client, nbytes, reps)
+    else:
+        out = client.allreduce_sum(np.full(4, float(client.rank + 1)))
+        expected = client.world_size * (client.world_size + 1) / 2
+        assert np.allclose(out, expected), (out, expected)
+        client.log(f"rank {client.rank}/{client.world_size}: "
+                   f"allreduce OK -> {out[0]}")
     client.shutdown()
 
 

@@ -27,18 +27,28 @@ sys.path.insert(0, REPO)
 def build(work):
     lib = os.path.join(work, "libdmlc_collective.so")
     exe = os.path.join(work, "test_collective")
+    # -lrt: shm_open lives in librt on glibc < 2.34 (a no-op stub after)
     subprocess.run(
         ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-         os.path.join(CPP, "dmlc_collective.cc"), "-o", lib], check=True)
+         os.path.join(CPP, "dmlc_collective.cc"), "-o", lib, "-lrt"],
+        check=True)
     subprocess.run(
         ["gcc", "-O2", "-std=c99", "-I", CPP,
          os.path.join(CPP, "test_collective.c"), lib, "-o", exe, "-lm",
-         f"-Wl,-rpath,{work}"], check=True)
+         "-lrt", f"-Wl,-rpath,{work}"], check=True)
     return exe
 
 
-def loopback_line_rate(nbytes=256 << 20):
-    """One-directional TCP throughput through 127.0.0.1 (MB/s)."""
+def loopback_line_rate(nbytes=256 << 20, trials=3):
+    """One-directional TCP throughput through 127.0.0.1 (MB/s), best of
+    ``trials`` — a single shot measured anywhere from 0.3 to 2.5 GB/s
+    on a 2-core host depending on how the scheduler placed the
+    sender/sink threads, and a capacity figure (the denominator of the
+    busbw ratios below) wants the unimpeded rate, not scheduler luck."""
+    return max(_loopback_once(nbytes) for _ in range(max(1, trials)))
+
+
+def _loopback_once(nbytes):
     srv = socket.socket()
     srv.bind(("127.0.0.1", 0))
     srv.listen(1)
@@ -72,6 +82,23 @@ def loopback_line_rate(nbytes=256 << 20):
     return got[0] / 1e6 / dt
 
 
+def host_collective_bench(world, nbytes=64 << 20, reps=2):
+    """Python host-collective allreduce (tracker/client.py) at ``nbytes``
+    through BOTH algorithms — binomial tree vs the chunked ring over the
+    tracker-brokered ring links — under the real local launcher.  Rank 0
+    prints one JSON line per algorithm (examples/allreduce_worker.py)."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bin", "dmlc-submit"),
+         "--cluster", "local", "--num-workers", str(world), "--",
+         sys.executable, os.path.join(REPO, "examples",
+                                      "allreduce_worker.py"),
+         "bench", str(nbytes), str(reps)],
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return [json.loads(line) for line in r.stdout.splitlines()
+            if line.startswith("{")]
+
+
 def main():
     from dmlc_tpu import telemetry
 
@@ -91,22 +118,48 @@ def main():
         assert r.returncode == 0, r.stderr[-2000:]
         results = [json.loads(line) for line in r.stdout.splitlines()
                    if line.startswith("{")]
+    with telemetry.span("collective.host_run", stage="bench",
+                        args={"world": world}), \
+            telemetry.timed("collective_bench", "host_run"):
+        host_results = host_collective_bench(world)
+    results += host_results
     with telemetry.span("collective.loopback_probe", stage="bench"), \
             telemetry.timed("collective_bench", "loopback_probe"):
         line_rate = loopback_line_rate()
     big = next((x for x in results
                 if x["op"] == "allreduce" and x["bytes"] == 64 << 20), None)
+    h_tree = next((x for x in host_results
+                   if x["op"] == "host_allreduce_tree"), None)
+    h_ring = next((x for x in host_results
+                   if x["op"] == "host_allreduce_ring"), None)
     out = {
         "world": world,
+        # busbw/loopback ratios are NOT comparable across hosts with
+        # different core counts: loopback saturates with 2 threads while
+        # the collective splits the same cores `world` ways (a DRAM-bound
+        # allreduce on a 2-core container cannot reach the ratio a
+        # many-core host produces with identical code) — compare ratios
+        # only against artifacts with the same host_cpus
+        "host_cpus": os.cpu_count(),
+        "busbw_ratio_caveat": "ratio valid only vs same host_cpus",
         "loopback_MBps": round(line_rate, 1),
         "results": results,
-        # NB: this host exposes ONE cpu core to all `world` workers AND
-        # the loopback measurement, so the honest saturation figure is
+        # NB: few cpu cores are shared by all `world` workers AND the
+        # loopback measurement, so the honest saturation figure is
         # aggregate bytes moved through the transport vs line rate
         "allreduce_64MB_busbw_vs_loopback":
             round(big["busbw_MBps"] / line_rate, 3) if big else None,
         "allreduce_64MB_link_vs_loopback":
             round(big["aggregate_link_MBps"] / line_rate, 3) if big else None,
+        # host-side (tracker/client.py) tree vs ring at 64 MB: the ring
+        # should win wherever bandwidth dominates latency
+        "host_allreduce_64MB_busbw_tree_MBps":
+            h_tree["busbw_MBps"] if h_tree else None,
+        "host_allreduce_64MB_busbw_ring_MBps":
+            h_ring["busbw_MBps"] if h_ring else None,
+        "host_allreduce_64MB_ring_vs_tree":
+            round(h_ring["busbw_MBps"] / h_tree["busbw_MBps"], 3)
+            if h_ring and h_tree else None,
         # harness-phase wall-time attribution (build vs run vs probe)
         "telemetry": telemetry.export_json(),
     }

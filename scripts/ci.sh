@@ -26,6 +26,10 @@
 #      counters appear on /metrics, and the killed incarnation's
 #      postmortem dump (final open spans + event tail) is collected
 #      from DMLC_POSTMORTEM_DIR
+#   8. perf smoke: packed-feed shipped efficiency >= 0.90 through the
+#      overlapped DeviceFeed pipeline, and the chunked ring allreduce
+#      beating the binomial tree on busbw at a bandwidth-dominated
+#      payload under the real local launcher
 #
 # Usage: scripts/ci.sh [pytest-args...]
 set -u
@@ -100,12 +104,12 @@ if command -v g++ >/dev/null 2>&1 && command -v gcc >/dev/null 2>&1; then
            2>/dev/null && "$ASAN_DIR/probe"; then
         g++ -O1 -g -fsanitize=address -std=c++17 -shared -fPIC \
             dmlc_tpu/cpp/dmlc_collective.cc \
-            -o "$ASAN_DIR/libdmlc_collective.so" \
+            -o "$ASAN_DIR/libdmlc_collective.so" -lrt \
             || { echo "FAIL: asan build of collective broke"; exit 1; }
         gcc -O1 -g -fsanitize=address -std=c99 -I dmlc_tpu/cpp \
             dmlc_tpu/cpp/test_collective.c \
             "$ASAN_DIR/libdmlc_collective.so" \
-            -o "$ASAN_DIR/test_collective" -lm -lasan \
+            -o "$ASAN_DIR/test_collective" -lm -lasan -lrt \
             -Wl,-rpath,"$ASAN_DIR" \
             || { echo "FAIL: asan build of collective driver broke"; exit 1; }
         for shm in 1 0; do
@@ -137,5 +141,9 @@ echo "== stage 7: chaos smoke (fault-injected worker death + self-heal) =="
 timeout -k 10 180 python scripts/chaos_smoke.py \
     || { echo "FAIL: chaos smoke"; exit 1; }
 
+echo "== stage 8: perf smoke (feed shipped-efficiency + ring vs tree) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/perf_smoke.py \
+    || { echo "FAIL: perf smoke"; exit 1; }
+
 echo "== CI OK (native=$NATIVE_OK tsan=$TSAN_OK asan=$ASAN_OK" \
-     "telemetry=1 chaos=1) =="
+     "telemetry=1 chaos=1 perf=1) =="

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""CI perf smoke (ci.sh stage 8): cheap, CPU-only guards on the two
+perf properties PR 4 claims, so a regression fails CI rather than
+waiting for the next full bench refresh:
+
+  1. Packed-feed shipped efficiency: RecordIO payload bytes / bytes
+     actually shipped to the device through recordio_packed_feed must
+     stay >= 0.90 (the packed layout's whole point is not paying for
+     padding; a tail-batch or offsets-table regression shows up here).
+  2. Host collective: the chunked ring allreduce must beat the binomial
+     tree on bus bandwidth at a bandwidth-dominated payload, under the
+     real local launcher (tracker-brokered ring links).
+
+Runs in ~1 min on 2 cores.  Usage: python scripts/perf_smoke.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+
+def feed_smoke(tmp):
+    from dmlc_tpu.feed import recordio_packed_feed
+    from dmlc_tpu.io.recordio import RecordIOWriter
+    from dmlc_tpu.io.stream import Stream
+    from dmlc_tpu.parallel import build_mesh
+
+    path = os.path.join(tmp, "smoke.rec")
+    rng = np.random.default_rng(0)
+    payload = 0
+    with Stream.create(path, "w") as s:
+        w = RecordIOWriter(s)
+        while payload < (32 << 20):
+            n = int(rng.integers(4 << 10, 12 << 10))
+            w.write_record(rng.integers(0, 256, n, np.uint8).tobytes())
+            payload += n
+
+    mesh = build_mesh(1, dp=1, sp=1, tp=1, pp=1, ep=1)
+    feed = recordio_packed_feed(path, mesh, buf_bytes=1 << 20,
+                                max_records=512)
+    got = shipped = 0
+    batches = 0
+    for b in feed:
+        count = int(np.asarray(b["count"])[0])
+        got += int(np.asarray(b["offsets"])[count])
+        shipped += sum(v.nbytes for v in b.values())
+        batches += 1
+        assert "parts_alive" in b and b["parts_alive"].shape == (1,)
+    eff = got / shipped
+    print(f"perf_smoke: packed feed eff={eff:.3f} "
+          f"({got / 1e6:.1f} MB payload / {shipped / 1e6:.1f} MB shipped, "
+          f"{batches} batches)")
+    assert got == payload, (got, payload)
+    assert eff >= 0.90, f"packed shipped efficiency regressed: {eff:.3f}"
+
+
+def collective_smoke():
+    from bench_collective import host_collective_bench
+
+    results = host_collective_bench(world=4, nbytes=16 << 20, reps=2)
+    by_op = {r["op"]: r for r in results}
+    tree = by_op["host_allreduce_tree"]["busbw_MBps"]
+    ring = by_op["host_allreduce_ring"]["busbw_MBps"]
+    print(f"perf_smoke: host allreduce 16MB busbw ring={ring} "
+          f"tree={tree} MB/s")
+    assert ring >= tree, (
+        f"ring allreduce ({ring} MB/s) lost to tree ({tree} MB/s) at a "
+        "bandwidth-dominated size")
+
+
+def main():
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        feed_smoke(tmp)
+    collective_smoke()
+    print("perf_smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -91,18 +91,31 @@ def fmt_bench_lines(bench, coll):
                 if r["op"] == "allreduce" and r["bytes"] == 64 << 20), None)
     mid = next((r for r in coll["results"]
                 if r["op"] == "allreduce" and r["bytes"] == 1 << 20), None)
+    cores = coll.get("host_cpus")
+    on = f"on {cores} cores" if cores else "on one core"
     if big and mid:
         lines.append(
-            f"- Native collective ABI, n={coll['world']} on one core: "
+            f"- Native collective ABI, n={coll['world']} {on}: "
             f"allreduce busbw {big['busbw_MBps']:.0f} MB/s at 64 MB / "
             f"{mid['busbw_MBps']:.0f} MB/s at 1 MB via the same-host "
-            f"shared-memory transport (slice-reduce in user space, the "
-            f"NCCL intra-node move rabit never had) — "
+            f"shared-memory transport (single-pass N-ary slice-reduce in "
+            f"user space, the NCCL intra-node move rabit never had) — "
             f"{big['aggregate_link_MBps'] / 1e3:.1f} GB/s aggregate, "
             f"**{coll['allreduce_64MB_link_vs_loopback']:.2f}× the host's "
             f"TCP loopback line rate** "
             f"({coll['loopback_MBps'] / 1e3:.1f} GB/s) that the tuned "
             f"tree/ring TCP fallback (cross-host links) is bounded by.")
+    ring = coll.get("host_allreduce_64MB_busbw_ring_MBps")
+    tree = coll.get("host_allreduce_64MB_busbw_tree_MBps")
+    if ring and tree:
+        lines.append(
+            f"- Host-side (tracker-link) allreduce at 64 MB: chunked "
+            f"ring reduce-scatter+allgather over the brokered ring "
+            f"links reaches {ring:.0f} MB/s busbw vs the binomial "
+            f"tree's {tree:.0f} — "
+            f"**{coll['host_allreduce_64MB_ring_vs_tree']:.1f}×**, with "
+            f"an automatic DMLC_COLL_RING_MIN_BYTES cutover so small "
+            f"control-plane messages keep the tree's 2·log2(n) latency.")
     return lines
 
 

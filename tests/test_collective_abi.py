@@ -22,9 +22,10 @@ def collective_lib(tmp_path_factory):
     """One shared libdmlc_collective.so build for every C consumer."""
     work = tmp_path_factory.mktemp("collective")
     lib = str(work / "libdmlc_collective.so")
+    # -lrt: shm_open lives in librt on glibc < 2.34 (a no-op stub after)
     r = subprocess.run(
         ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-         os.path.join(CPP, "dmlc_collective.cc"), "-o", lib],
+         os.path.join(CPP, "dmlc_collective.cc"), "-o", lib, "-lrt"],
         capture_output=True, text=True)
     assert r.returncode == 0, r.stderr
     return lib
@@ -34,7 +35,7 @@ def _build_c_consumer(lib, src, exe):
     # plain C, compiled with a C compiler: proves ABI purity
     r = subprocess.run(
         ["gcc", "-O2", "-std=c99", "-I", CPP, src, lib, "-o", exe,
-         "-lm", f"-Wl,-rpath,{os.path.dirname(lib)}"],
+         "-lm", "-lrt", f"-Wl,-rpath,{os.path.dirname(lib)}"],
         capture_output=True, text=True)
     assert r.returncode == 0, r.stderr
     return exe

@@ -179,3 +179,88 @@ def test_multithreaded_iter_worker_exception():
             if not ok:
                 break
     mit.destroy()
+
+
+# ---------------------------------------------------------------------------
+# BufferPool: the staging-buffer recycle contract behind DeviceFeed
+# ---------------------------------------------------------------------------
+
+def test_buffer_pool_lazy_creation_and_reuse():
+    from dmlc_tpu.concurrency import BufferPool
+
+    built = []
+
+    def factory():
+        built.append(object())
+        return built[-1]
+
+    pool = BufferPool(factory, capacity=2)
+    a = pool.acquire()
+    b = pool.acquire()
+    assert len(built) == 2 and pool.created == 2
+    pool.release(a)
+    c = pool.acquire()
+    assert c is a          # recycled, not rebuilt
+    assert len(built) == 2  # capacity bounds total construction
+
+
+def test_buffer_pool_blocks_until_release():
+    import threading
+
+    from dmlc_tpu.concurrency import BufferPool
+
+    pool = BufferPool(lambda: object(), capacity=1)
+    first = pool.acquire()
+    got = []
+
+    def taker():
+        got.append(pool.acquire())
+
+    t = threading.Thread(target=taker)
+    t.start()
+    t.join(0.15)
+    assert t.is_alive() and not got  # blocked: capacity exhausted
+    pool.release(first)
+    t.join(5)
+    assert got == [first]
+
+
+def test_buffer_pool_acquire_timeout_and_kill():
+    import threading
+
+    from dmlc_tpu.concurrency import BufferPool
+
+    pool = BufferPool(lambda: object(), capacity=1)
+    pool.acquire()
+    assert pool.acquire(timeout=0.05) is None  # timed out, not deadlocked
+    results = []
+
+    def taker():
+        results.append(pool.acquire())
+
+    t = threading.Thread(target=taker)
+    t.start()
+    t.join(0.1)
+    assert t.is_alive()
+    pool.kill()
+    t.join(5)
+    assert results == [None]       # kill wakes blocked acquirers
+    assert pool.acquire() is None  # and poisons future acquires
+
+
+def test_buffer_pool_factory_failure_releases_capacity():
+    from dmlc_tpu.concurrency import BufferPool
+
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            raise RuntimeError("boom")
+        return object()
+
+    pool = BufferPool(flaky, capacity=1)
+    with pytest.raises(RuntimeError):
+        pool.acquire()
+    # the failed build must not leak its capacity slot
+    assert pool.acquire() is not None
