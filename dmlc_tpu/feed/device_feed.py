@@ -474,8 +474,14 @@ class DeviceFeed:
         from .. import telemetry
 
         while True:
-            # an empty queue means the producer is the bottleneck
-            with telemetry.timed("feed", "consumer_stall"):
+            # an empty queue means the producer is the bottleneck.  The
+            # feed.wait span is the CONSUMER-thread record of this wait:
+            # it is what the step ledger (telemetry.steps) bills as a
+            # step's feed-wait share, since the producer-side
+            # parse/stage/place spans run overlapped on other threads
+            # and do not cost the step anything
+            with telemetry.span("feed.wait", stage="feed"), \
+                    telemetry.timed("feed", "consumer_stall"):
                 item = self._queue.get()
             if item is None:
                 return

@@ -15,5 +15,6 @@ from .transformer import (  # noqa: F401
     make_train_step,
     param_specs,
     train_flops_per_token,
+    train_step_flops,
     unsharded_loss,
 )

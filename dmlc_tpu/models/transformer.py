@@ -116,6 +116,14 @@ def train_flops_per_token(cfg: TransformerConfig, t: int,
     return 3.0 * fwd
 
 
+def train_step_flops(cfg: TransformerConfig, batch: int, t: int,
+                     causal: bool = True) -> float:
+    """Executed FLOPs for ONE train step of a [batch, t] input — the
+    model's declaration to the step ledger (telemetry.steps), from
+    which per-step MFU = flops / wall / peak is accounted."""
+    return train_flops_per_token(cfg, t, causal) * batch * t
+
+
 def init_params(key, cfg: TransformerConfig, n_stages: int = 1):
     """Global (unsharded) parameter pytree; blocks stacked [S, L/S, ...]."""
     assert cfg.n_layers % n_stages == 0
@@ -386,12 +394,21 @@ def unsharded_loss(params, ids, labels, cfg: TransformerConfig):
     return forward_local(params, ids, labels, cfg, ShardAxes())
 
 
-def make_train_step(mesh, cfg: TransformerConfig, optimizer=None):
+def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
+                    ledger: bool = True):
     """Build a jitted SPMD train step over ``mesh``.
 
     Returns (train_step, init_state) where
       train_step(params, opt_state, ids, labels) -> (params, opt_state, loss)
     ids/labels are global [B, T] arrays sharded P(dp, sp).
+
+    With ``ledger`` (default) every call drives the process step ledger
+    (telemetry.steps): the model declares its per-token train FLOPs
+    from the first batch's sequence length, and each step records wall
+    time, feed/collective attribution, goodput, and MFU — the data the
+    tracker watchdog and ``dmlc top`` read.  Wall time is host dispatch
+    time; under steady-state async dispatch that converges to device
+    step time (the dispatch queue is device-throttled).
     """
     import optax
 
@@ -418,4 +435,24 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None):
     def init_state(params):
         return optimizer.init(params)
 
-    return jax.jit(train_step), init_state
+    jitted = jax.jit(train_step)
+    if not ledger:
+        return jitted, init_state
+
+    from .. import telemetry
+
+    declared = []
+
+    def stepped(params, opt_state, ids, labels):
+        if not declared:
+            telemetry.declare_flops_per_token(
+                train_flops_per_token(cfg, int(ids.shape[-1])))
+            declared.append(True)
+        telemetry.step_begin()
+        # a raising dispatch leaves the step open; the next step_begin
+        # abandons it instead of recording a garbage wall time
+        out = jitted(params, opt_state, ids, labels)
+        telemetry.step_end(tokens=float(ids.size))
+        return out
+
+    return stepped, init_state

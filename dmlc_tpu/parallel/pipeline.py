@@ -111,9 +111,16 @@ def make_pipeline(mesh, stage_fn, *, axis_name: str = "pp"):
     n_stages = int(mesh.shape[axis_name])
 
     def run(params_stacked, x_mb):
+        # tokens tag (additive): the step ledger and trace readers can
+        # relate this dispatch to goodput without re-deriving shapes
+        # (x_mb is [M, mb, T, ...] — tokens = M·mb·T when T is present)
+        tokens = 1
+        for d in x_mb.shape[:3]:
+            tokens *= int(d)
         with telemetry.span("pipeline.run", stage="pipeline",
                             args={"stages": n_stages,
-                                  "microbatches": int(x_mb.shape[0])}):
+                                  "microbatches": int(x_mb.shape[0]),
+                                  "tokens": tokens}):
             return mapped(params_stacked, x_mb)
 
     return run

@@ -19,27 +19,40 @@ counters, which remains as a compatible shim over this package):
                    restarts, declared-dead, barrier entries)
   * ``postmortem`` crash dumps (snapshot + open/last spans + event
                    tail) to DMLC_POSTMORTEM_DIR on signals/fatals
+  * ``steps``      per-step performance ledger: wall-time attribution
+                   (feed-wait / host-collective / device-compute),
+                   goodput tokens/s and MFU per step, shipped with
+                   heartbeats
+  * ``anomaly``    tracker-side online watchdog over shipped step
+                   records (stragglers, regressions, feed-stall
+                   dominance, goodput collapse) behind /anomalies
+  * ``metric_names`` the checked-in metric-name contract registry
+                   (scripts/lint.py enforces it)
 
 Typical use::
 
     from dmlc_tpu import telemetry
 
-    with telemetry.span("train.step", stage="train"):
-        ...
-    telemetry.observe_duration("train", "step", dt)
+    telemetry.step_begin()
+    ...train step...
+    telemetry.step_end(tokens=batch * seq)
     telemetry.snapshot()["histograms"]["feed"]["producer_stall_secs"]["p90"]
     open("trace.json", "w").write(telemetry.to_chrome_trace_json())
 """
 
 from . import (  # noqa: F401
+    anomaly,
     clock,
     core,
     events,
     exporters,
     flight,
     heartbeat,
+    metric_names,
     postmortem,
+    steps,
 )
+from .anomaly import Watchdog  # noqa: F401
 from .clock import ClockOffsetEstimator  # noqa: F401
 from .core import (  # noqa: F401
     DEFAULT_BOUNDS,
@@ -78,6 +91,16 @@ from .heartbeat import (  # noqa: F401
     TelemetryAggregator,
     TelemetryHTTPServer,
 )
+from .steps import (  # noqa: F401
+    StepLedger,
+    declare_flops_per_token,
+    declare_peak_flops,
+    detect_peak_flops,
+    ledger,
+    reset_steps,
+    step_begin,
+    step_end,
+)
 
 __all__ = [
     "ClockOffsetEstimator",
@@ -86,25 +109,34 @@ __all__ = [
     "FlightRecorder",
     "Histogram",
     "HeartbeatSender",
+    "StepLedger",
     "TelemetryAggregator",
     "TelemetryHTTPServer",
+    "Watchdog",
     "anchor_epoch",
     "annotate",
     "counters_snapshot",
+    "declare_flops_per_token",
+    "declare_peak_flops",
+    "detect_peak_flops",
     "events_tail",
     "export_json",
     "inc",
+    "ledger",
     "observe",
     "observe_duration",
     "open_spans",
     "record_event",
     "reset",
     "reset_events",
+    "reset_steps",
     "set_gauge",
     "snapshot",
     "span",
     "spans",
     "spans_since",
+    "step_begin",
+    "step_end",
     "timed",
     "to_chrome_trace",
     "to_chrome_trace_json",

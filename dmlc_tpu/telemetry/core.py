@@ -300,6 +300,25 @@ def spans_since(after_seq: int, limit: Optional[int] = None) -> tuple:
     return out, last
 
 
+def span_seq() -> int:
+    """Current span high-water mark (the seq of the newest recorded
+    span, including ring-evicted ones).  A cheap cursor for interval
+    consumers — the step ledger stamps it at ``step_begin`` and asks
+    :func:`spans_since` for everything the step enclosed."""
+    with _lock:
+        return _span_seq
+
+
+def counter_value(stage: str, name: str, default: float = 0.0) -> float:
+    """One counter's current value without copying the whole registry —
+    the step ledger reads per-step deltas (bytes fed, flash FLOPs) on
+    the hot path, where a full ``counters_snapshot()`` per step would
+    be a dict-copy tax proportional to total metric count."""
+    with _lock:
+        vals = _counters.get(stage)
+        return vals.get(name, default) if vals else default
+
+
 def open_spans() -> List[Dict]:
     """Spans currently OPEN on any thread (innermost last per thread) —
     what every thread was doing right now; the postmortem dumper's view

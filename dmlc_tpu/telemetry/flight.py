@@ -41,7 +41,12 @@ class FlightRecorder:
     ``local_spans`` (zero-arg callable returning a span list) adds the
     tracker process's own spans to the merged view under
     :data:`TRACKER_PID`; its clock IS the reference, so no correction
-    applies.  Per-rank capacity: ``DMLC_TRACE_MAX_SPANS_PER_RANK``
+    applies.  ``marker_source`` (zero-arg callable returning
+    ``[{"t": epoch_s, "name": ...}]``, e.g. ``Watchdog.trace_markers``)
+    adds instant-marker rows to the merged trace — anomaly verdicts
+    land as global instants at their wall time, so "when did the
+    straggler flag fire" lines up against the spans that caused it.
+    Per-rank capacity: ``DMLC_TRACE_MAX_SPANS_PER_RANK``
     (default 4096) — bounded so a chatty rank cannot OOM the tracker.
     """
 
@@ -53,6 +58,7 @@ class FlightRecorder:
         self.max_spans_per_rank = max_spans_per_rank
         self.clock = ClockOffsetEstimator()
         self._local_spans = local_spans
+        self.marker_source = None
         self._log = log
         self._lock = threading.Lock()
         self._spans: Dict[int, deque] = {}
@@ -221,6 +227,22 @@ class FlightRecorder:
             for tid, tname in threads.items():
                 events.append({"name": "thread_name", "ph": "M", "pid": pid,
                                "tid": tid, "args": {"name": tname}})
+        # anomaly verdicts as global instant markers: their wall time is
+        # already on the tracker's clock (the watchdog stamps them when
+        # the verdict fires), so they share the same rebase as the
+        # corrected spans and line up against what caused them
+        if self.marker_source is not None:
+            try:
+                for m in self.marker_source():
+                    events.append({
+                        "name": str(m["name"]), "cat": "anomaly",
+                        "ph": "i", "s": "g",
+                        "ts": round(max(float(m["t"]) * 1e6 - t_min, 0.0),
+                                    3),
+                        "pid": TRACKER_PID, "tid": 0,
+                    })
+            except Exception as e:  # noqa: BLE001 - render must not 500
+                self._log.warning("anomaly markers failed: %r", e)
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
     def to_chrome_trace_json(self) -> str:

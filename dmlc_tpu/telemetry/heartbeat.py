@@ -102,9 +102,12 @@ def _build_info_line() -> str:
 
     plat = f"{platform.system()}-{platform.machine()}".lower()
     py = platform.python_version()
-    return ("# TYPE dmlc_build_info gauge\n"
-            f'dmlc_build_info{{version="{__version__}",platform="{plat}",'
-            f'python="{py}"}} 1\n')
+    esc = exporters.escape_label_value
+    return (exporters.help_type_lines(
+                "dmlc_build_info", "gauge",
+                "constant 1 with build metadata labels")
+            + f'dmlc_build_info{{version="{esc(__version__)}",'
+              f'platform="{esc(plat)}",python="{esc(py)}"}} 1\n')
 
 
 def _median(vals: List[float]) -> float:
@@ -124,7 +127,10 @@ class TelemetryAggregator:
     under ``rank="<local_label>"`` — the tracker uses it to publish
     launcher/tracker-side resilience counters (task restarts, declared
     worker deaths) that no worker heartbeat carries.  ``extra_health``
-    (zero-arg callable returning a dict) is merged into /healthz."""
+    (zero-arg callable returning a dict) is merged into /healthz;
+    ``extra_text`` (zero-arg callable returning exposition text) is
+    appended to /metrics — the anomaly watchdog publishes its
+    ``dmlc_anomaly_active`` gauges through it."""
 
     def __init__(self, straggler_factor: float = 3.0,
                  straggler_keys=DEFAULT_STRAGGLER_KEYS,
@@ -136,6 +142,7 @@ class TelemetryAggregator:
         self._local_snapshot = local_snapshot
         self._local_label = local_label
         self.extra_health = None
+        self.extra_text = None
         self._lock = threading.Lock()
         self._ranks: Dict[int, Dict] = {}      # rank -> snapshot dict
         # rank -> last heartbeat, on time.monotonic(): heartbeat AGE is a
@@ -224,36 +231,50 @@ class TelemetryAggregator:
         }
 
     def prometheus_text(self) -> str:
-        """Per-rank samples (rank label) + merged families (rank="all")."""
+        """Per-rank samples (rank label) + merged families (rank="all").
+
+        Every snapshot is collected into ONE family table before
+        rendering, so each family appears as a single group with one
+        ``# HELP``/``# TYPE`` header — per-rank text concatenation
+        would split families across groups, which strict exposition
+        parsers reject."""
         with self._lock:
             snaps = dict(self._ranks)
-        parts = [
-            exporters.to_prometheus_text(
-                snap, labels={"rank": str(r)}, emit_type_lines=(i == 0))
-            for i, (r, snap) in enumerate(sorted(snaps.items()))
-        ]
-        parts.append(exporters.to_prometheus_text(
-            self.merged(), labels={"rank": "all"},
-            emit_type_lines=not parts))
+        fams: Dict = {}
+        for r, snap in sorted(snaps.items()):
+            exporters.collect_prometheus(snap, labels={"rank": str(r)},
+                                         out=fams)
+        exporters.collect_prometheus(self.merged(),
+                                     labels={"rank": "all"}, out=fams)
         if self._local_snapshot is not None:
             try:
-                parts.append(exporters.to_prometheus_text(
+                exporters.collect_prometheus(
                     _sanitize(self._local_snapshot()),
-                    labels={"rank": self._local_label},
-                    emit_type_lines=False))
+                    labels={"rank": self._local_label}, out=fams)
             except Exception as e:  # noqa: BLE001 - scrape must not 500
                 self._log.warning("local telemetry snapshot failed: %r", e)
+        parts = [exporters.render_prometheus(fams)]
         n = len(snaps)
+        parts.append(exporters.help_type_lines(
+            "dmlc_tracker_ranks_reporting", "gauge",
+            "ranks with a telemetry snapshot on the tracker"))
         parts.append(f"dmlc_tracker_ranks_reporting {n}\n")
         parts.append(_build_info_line())
         # per-rank staleness as a first-class gauge: scrapers alert on
         # max(dmlc_heartbeat_age_seconds) without parsing /healthz JSON
         ages = self.ranks()
         if ages:
-            parts.append("# TYPE dmlc_heartbeat_age_seconds gauge\n")
+            parts.append(exporters.help_type_lines(
+                "dmlc_heartbeat_age_seconds", "gauge",
+                "seconds since each rank's last heartbeat"))
             for r, age in sorted(ages.items()):
                 parts.append(
                     f'dmlc_heartbeat_age_seconds{{rank="{r}"}} {age:.3f}\n')
+        if self.extra_text is not None:
+            try:
+                parts.append(self.extra_text())
+            except Exception as e:  # noqa: BLE001 - scrape must not 500
+                self._log.warning("extra metrics text failed: %r", e)
         return "".join(parts)
 
     def healthz(self) -> Dict:
@@ -314,16 +335,19 @@ class TelemetryAggregator:
 
 
 class TelemetryHTTPServer:
-    """Lightweight /metrics + /healthz (+ /trace) HTTP surface.
+    """Lightweight /metrics + /healthz (+ /trace, /anomalies) surface.
 
     ``trace_source`` (zero-arg callable returning a Chrome-trace dict,
     e.g. ``FlightRecorder.to_chrome_trace``) enables ``GET /trace``:
     the cluster-merged, clock-corrected timeline, downloadable straight
-    into Perfetto / chrome://tracing."""
+    into Perfetto / chrome://tracing.  ``anomaly_source`` (zero-arg
+    callable returning a JSON-able dict, e.g. ``Watchdog.report``)
+    enables ``GET /anomalies``: the live per-rank step-health and
+    anomaly-flag document that ``dmlc top`` polls."""
 
     def __init__(self, aggregator: TelemetryAggregator,
                  host: str = "127.0.0.1", port: int = 0,
-                 trace_source=None):
+                 trace_source=None, anomaly_source=None):
         agg = aggregator
 
         class Handler(BaseHTTPRequestHandler):
@@ -350,6 +374,15 @@ class TelemetryHTTPServer:
                         logger.warning("/trace render failed: %r", e)
                         self._send(503, "text/plain",
                                    b"trace render failed\n")
+                        return
+                    self._send(200, "application/json", body)
+                elif path == "/anomalies" and anomaly_source is not None:
+                    try:
+                        body = json.dumps(anomaly_source()).encode()
+                    except Exception as e:  # noqa: BLE001 - no 500s
+                        logger.warning("/anomalies render failed: %r", e)
+                        self._send(503, "text/plain",
+                                   b"anomaly render failed\n")
                         return
                     self._send(200, "application/json", body)
                 else:
@@ -383,16 +416,25 @@ class HeartbeatSender:
 
     With ``ship_trace`` (default on; ``DMLC_TELEMETRY_SHIP_TRACE=0``
     disables) each beat also carries a ``trace`` sub-document: the
-    spans recorded since the last successful ship (bounded per beat),
-    this process's span-clock wall anchor, and a fresh NTP-style clock
-    sample against the tracker (``TrackerClient.clock_ping``) — the
-    worker half of the cluster flight recorder (telemetry.flight).
+    spans AND step-ledger records recorded since the last successful
+    ship (bounded per beat), this process's span-clock wall anchor, and
+    a fresh NTP-style clock sample against the tracker
+    (``TrackerClient.clock_ping``) — the worker half of the cluster
+    flight recorder (telemetry.flight) and of the anomaly watchdog
+    (telemetry.anomaly).
     Armed heartbeats also install the postmortem crash hooks when
     ``DMLC_POSTMORTEM_DIR`` is set: the heartbeat is the one object
     every instrumented worker constructs.
+
+    Beat payloads are capped at ``DMLC_TELEMETRY_MAX_BEAT_BYTES``
+    (default 256 KB): an over-budget beat drops its OLDEST trace spans
+    (then oldest step records) until it fits, counting
+    ``telemetry.beats_truncated`` — a span storm can never bloat a
+    heartbeat past the tracker's frame limits.
     """
 
     MAX_SPANS_PER_BEAT = 2048
+    MAX_STEPS_PER_BEAT = 512
 
     def __init__(self, client, interval: float = 5.0,
                  auto_start: bool = True, ship_trace: Optional[bool] = None):
@@ -402,7 +444,10 @@ class HeartbeatSender:
             ship_trace = os.environ.get(
                 "DMLC_TELEMETRY_SHIP_TRACE", "1") != "0"
         self.ship_trace = bool(ship_trace)
+        self.max_beat_bytes = int(os.environ.get(
+            "DMLC_TELEMETRY_MAX_BEAT_BYTES", str(256 << 10)))
         self._last_seq = 0
+        self._last_step_seq = 0
         self._clock: Optional[Tuple[float, float]] = None  # (offset, rtt)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -432,16 +477,24 @@ class HeartbeatSender:
         doc = exporters.export_json(include_buckets=True)
         if self.ship_trace:
             doc["trace"] = self._trace_doc()
-        self._client.send_metrics(json.dumps(doc))
+            payload = self._capped_payload(doc)
+        else:
+            payload = json.dumps(doc)
+        self._client.send_metrics(payload)
         if self.ship_trace:
-            # only a delivered beat advances the ship cursor: a torn
-            # send re-ships the same spans next beat (tracker dedups
-            # by seq) instead of losing them
+            # only a delivered beat advances the ship cursors: a torn
+            # send re-ships the same spans/steps next beat (tracker
+            # dedups by seq) instead of losing them
             self._last_seq = doc["trace"]["seq"]
+            self._last_step_seq = doc["trace"]["step_seq"]
 
     def _trace_doc(self) -> Dict:
+        from . import steps as steps_mod
+
         spans, last = core.spans_since(self._last_seq,
                                        limit=self.MAX_SPANS_PER_BEAT)
+        step_recs, step_last = steps_mod.ledger().records_since(
+            self._last_step_seq, limit=self.MAX_STEPS_PER_BEAT)
         clock = getattr(self._client, "clock_ping", None)
         if clock is not None:
             try:
@@ -449,11 +502,40 @@ class HeartbeatSender:
             except (OSError, ValueError, KeyError) as e:
                 logger.debug("clock ping failed: %s", e)  # keep last sample
         doc: Dict = {"anchor": core.anchor_epoch(), "seq": last,
-                     "spans": spans}
+                     "spans": spans, "steps": step_recs,
+                     "step_seq": step_last}
         if self._clock is not None:
             doc["clock"] = {"offset_s": self._clock[0],
                             "rtt_s": self._clock[1]}
         return doc
+
+    def _capped_payload(self, doc: Dict) -> str:
+        """Serialize ``doc``, truncating the trace sub-doc oldest-first
+        until the beat fits ``max_beat_bytes``.  Dropped spans/steps are
+        gone (they would have been ring-evicted under the same storm);
+        ``telemetry.beats_truncated`` counts the shrink events so the
+        loss is visible on /metrics."""
+        payload = json.dumps(doc)
+        if self.max_beat_bytes <= 0 or len(payload) <= self.max_beat_bytes:
+            return payload
+        trace = doc["trace"]
+        truncated = False
+        while len(payload) > self.max_beat_bytes:
+            if trace["spans"]:
+                # halve from the OLD end: the newest spans are the ones
+                # the flight recorder has not seen in any form yet
+                trace["spans"] = trace["spans"][len(trace["spans"])
+                                                // 2 + 1:]
+            elif trace["steps"]:
+                trace["steps"] = trace["steps"][len(trace["steps"])
+                                                // 2 + 1:]
+            else:
+                break  # snapshot alone exceeds the cap: ship it anyway
+            truncated = True
+            payload = json.dumps(doc)
+        if truncated:
+            core.inc("telemetry", "beats_truncated")
+        return payload
 
     def close(self) -> None:
         self._stop.set()

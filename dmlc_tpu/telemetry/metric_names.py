@@ -1,0 +1,147 @@
+"""Checked-in registry of every ``dmlc_*`` metric family this codebase
+emits — the metric-name contract.
+
+MIGRATION.md promises the exported metric surface only ever *grows*:
+no renames, additive only.  That promise is only as strong as its
+enforcement, so ``scripts/lint.py`` statically derives every metric
+name the code can emit (``telemetry.inc/set_gauge/observe/
+observe_duration/timed`` call sites with literal stage/name arguments
+resolve to ``dmlc_<stage>_<name>[_secs]``; plus every literal
+``dmlc_*`` string) and fails CI when a name is missing here.  The
+effect: renaming or typo-duplicating a family requires a *visible*
+edit to this file, where review catches it — and a scrape assertion on
+a name nobody emits fails lint instead of silently never matching.
+
+Removing a name from this set is the signal that a dashboard somewhere
+breaks; treat deletions as API breaks (MIGRATION.md entry required).
+"""
+
+from __future__ import annotations
+
+__all__ = ["METRIC_NAMES", "SPAN_ANNOTATIONS", "NON_METRIC_TOKENS"]
+
+#: every exported metric family (base name: the exposition-format
+#: ``_bucket``/``_sum``/``_count`` suffixes of histograms are implied)
+METRIC_NAMES = frozenset({
+    # anomaly watchdog (tracker side)
+    "dmlc_anomaly_active",
+    "dmlc_anomaly_straggler_flags",
+    "dmlc_anomaly_regression_flags",
+    "dmlc_anomaly_feed_stall_flags",
+    "dmlc_anomaly_goodput_collapse_flags",
+    # checkpoint
+    "dmlc_checkpoint_bytes_read",
+    "dmlc_checkpoint_bytes_written",
+    "dmlc_checkpoint_restore_secs",
+    "dmlc_checkpoint_restores",
+    "dmlc_checkpoint_save_secs",
+    "dmlc_checkpoint_saves",
+    # host + device collectives
+    "dmlc_collective_barrier_sum_calls",
+    "dmlc_collective_barrier_wait_secs",
+    "dmlc_collective_bench_build_secs",
+    "dmlc_collective_bench_host_run_secs",
+    "dmlc_collective_bench_loopback_probe_secs",
+    "dmlc_collective_bench_run_secs",
+    # device feed
+    "dmlc_feed_assemble_secs",
+    "dmlc_feed_batches",
+    "dmlc_feed_bytes_to_device",
+    "dmlc_feed_consumer_stall_secs",
+    "dmlc_feed_depth",
+    "dmlc_feed_device_put_secs",
+    "dmlc_feed_producer_stall_secs",
+    "dmlc_feed_queue_depth",
+    "dmlc_feed_stage_stall_secs",
+    # flash attention
+    "dmlc_flash_fwd_calls",
+    "dmlc_flash_fwd_flops",
+    "dmlc_flash_ring_step_calls",
+    "dmlc_flash_seq_len_q",
+    # input split / io
+    "dmlc_input_split_bytes",
+    "dmlc_input_split_chunk_latency_secs",
+    "dmlc_input_split_chunks",
+    "dmlc_input_split_producer_idle_secs",
+    "dmlc_input_split_records",
+    "dmlc_io_read_bytes",
+    "dmlc_io_reads",
+    "dmlc_io_write_bytes",
+    "dmlc_io_writes",
+    # model / moe
+    "dmlc_moe_overflow_checks",
+    "dmlc_moe_overflow_fraction_sum",
+    # data parsers
+    "dmlc_parser_blocks",
+    "dmlc_parser_bytes",
+    "dmlc_parser_parse_secs",
+    "dmlc_parser_rows",
+    # pipeline parallelism
+    "dmlc_pipeline_bubble_fraction",
+    "dmlc_pipeline_bubble_steps_per_stage",
+    "dmlc_pipeline_microbatches",
+    "dmlc_pipeline_microbatches_per_run",
+    "dmlc_pipeline_runs_traced",
+    "dmlc_pipeline_stages",
+    # recordio
+    "dmlc_recordio_bytes",
+    "dmlc_recordio_partition_scan_secs",
+    "dmlc_recordio_records",
+    # resilience
+    "dmlc_resilience_faults_injected",
+    "dmlc_resilience_hosts_blacklisted",
+    "dmlc_resilience_postmortems_collected",
+    "dmlc_resilience_retries",
+    "dmlc_resilience_retryable_errors",
+    "dmlc_resilience_task_budget_exhausted",
+    "dmlc_resilience_task_restarts",
+    "dmlc_resilience_worker_declared_dead",
+    "dmlc_resilience_worker_readmitted",
+    # ring attention
+    "dmlc_ring_attention_bytes_rotated",
+    "dmlc_ring_attention_calls",
+    "dmlc_ring_attention_kv_block_bytes",
+    # step ledger
+    "dmlc_step_collective_secs",
+    "dmlc_step_compute_secs",
+    "dmlc_step_count",
+    "dmlc_step_feed_wait_secs",
+    "dmlc_step_goodput_tokens_per_s",
+    "dmlc_step_mfu_pct",
+    "dmlc_step_time_secs",
+    # telemetry self-accounting
+    "dmlc_telemetry_beats_truncated",
+    # tracker surface (hand-rendered families)
+    "dmlc_build_info",
+    "dmlc_heartbeat_age_seconds",
+    "dmlc_tracker_ranks_reporting",
+    # training loop examples
+    "dmlc_train_steps",
+    # smoke-harness fixtures (scripts/telemetry_smoke.py workers)
+    "dmlc_smoke_beats",
+})
+
+#: span / jax-profiler annotation names that look like metric tokens in
+#: string scans but are trace names, not exposition families
+SPAN_ANNOTATIONS = frozenset({
+    "dmlc_train_step",
+    "dmlc_feed_batch",
+})
+
+#: non-metric ``dmlc_*`` identifiers that legitimately appear in string
+#: literals (package / native-library / ABI-symbol / path names, and
+#: prose prefixes like "dmlc_anomaly_*")
+NON_METRIC_TOKENS = frozenset({
+    "dmlc_tpu",
+    "dmlc_tpu_bench",
+    "dmlc_native",
+    "dmlc_collective",
+    "dmlc_kv",
+    "dmlc_sge",
+    "dmlc_top",
+    "dmlc_tracker",       # reference repo path tracker/dmlc_tracker/…
+    "dmlc_anomaly",       # prose prefix for the dmlc_anomaly_* family
+    "dmlc_recordio_spans",  # native ABI symbol (dmlc_native.cc)
+    "dmlc_pack_spans",      # native ABI symbol
+    "dmlc_comm_allreduce",  # native collective ABI symbol
+})

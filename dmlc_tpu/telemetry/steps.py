@@ -1,0 +1,341 @@
+"""Per-step performance ledger: where did each step's wall time go?
+
+The flight recorder (telemetry.flight) answers "what happened when" at
+span granularity, but production training lives on a coarser question
+asked every few seconds: *is the run healthy* — is step N slow because
+the feed stalled, because a host collective waited on a straggler, or
+because device compute itself regressed, and how much of the hardware
+are we actually using?  The :class:`StepLedger` answers it with one
+bounded record per step:
+
+  * **wall decomposition** — ``step_begin()`` stamps a span cursor;
+    ``step_end()`` classifies every span the step enclosed *on the
+    stepping thread* (``feed.wait`` → feed-wait, ``collective.*`` →
+    host-collective; the remainder is device-compute + dispatch, with
+    ``pipeline.run`` span time reported alongside as the span-derived
+    compute evidence).  Producer-side feed spans (parse/stage/place)
+    run on other threads concurrently and deliberately do NOT count
+    against the step — overlap is the point of the feed pipeline.
+  * **goodput / MFU** — each record carries tokens, bytes fed (counter
+    delta of ``feed.bytes_to_device`` unless given), and model-declared
+    FLOPs (``declare_flops_per_token``, models.transformer wires it),
+    yielding tokens/s and FLOPs/s ÷ peak.  Peak comes from
+    ``DMLC_PEAK_FLOPS`` or the device-kind table
+    (:func:`detect_peak_flops`).
+  * **bounded ring + incremental ship** — records get monotone seq ids
+    and ride the heartbeat ``trace`` sub-doc to the tracker
+    (telemetry.heartbeat), where the anomaly watchdog
+    (telemetry.anomaly) consumes them online.
+
+Every record also lands in the local registry (``step`` stage: time /
+feed_wait / collective / compute histograms, goodput + MFU gauges), so
+per-rank step health is scrapeable from /metrics with no new plumbing.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from . import core
+
+__all__ = [
+    "StepLedger",
+    "StepRecord",
+    "ledger",
+    "step_begin",
+    "step_end",
+    "declare_flops_per_token",
+    "declare_peak_flops",
+    "detect_peak_flops",
+    "DEVICE_PEAK_FLOPS",
+    "reset_steps",
+]
+
+#: dense bf16 peak FLOP/s per chip by jax device_kind (bench.py's MFU
+#: table, promoted here so bench and the ledger share one source)
+DEVICE_PEAK_FLOPS: Dict[str, float] = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def detect_peak_flops() -> Optional[float]:
+    """Peak FLOP/s for MFU accounting: ``DMLC_PEAK_FLOPS`` wins (an
+    operator statement about the hardware), else the device-kind table,
+    else None (MFU unreported rather than wrong)."""
+    env = os.environ.get("DMLC_PEAK_FLOPS")
+    if env:
+        try:
+            v = float(env)
+            return v if v > 0 else None
+        except ValueError:
+            return None
+    try:
+        import jax
+
+        return DEVICE_PEAK_FLOPS.get(jax.devices()[0].device_kind)
+    except Exception:  # noqa: BLE001 - no jax / no backend: no peak
+        return None
+
+
+class StepRecord(dict):
+    """One step's ledger entry — a plain dict (JSON = wire format) with
+    attribute sugar for the hot fields."""
+
+    @property
+    def wall_s(self) -> float:
+        return self["wall_s"]
+
+
+def _classify(rec: Dict) -> Optional[str]:
+    """Span → wall-time bucket, for spans on the stepping thread."""
+    name = rec.get("name", "")
+    cat = rec.get("cat", "")
+    if name == "feed.wait" or cat == "feed":
+        return "feed"
+    if cat == "collective" or name.startswith("collective."):
+        return "collective"
+    if name == "pipeline.run":
+        return "pipeline"
+    return None
+
+
+class StepLedger:
+    """Bounded per-step record ring with incremental shipping.
+
+    Thread-safe, but steps themselves are single-threaded by contract:
+    one ``step_begin``/``step_end`` pair at a time per ledger (the
+    training loop's natural shape).  Capacity: ``DMLC_STEP_LEDGER_MAX``
+    (default 1024) — a week-long run keeps the newest window, and the
+    heartbeat ships increments long before eviction.
+    """
+
+    def __init__(self, capacity: Optional[int] = None,
+                 peak_flops: Optional[float] = None):
+        if capacity is None:
+            capacity = int(os.environ.get("DMLC_STEP_LEDGER_MAX", "1024"))
+        self._lock = threading.Lock()
+        self._records: deque = deque(maxlen=max(1, capacity))
+        self._seq = 0
+        self._flops_per_token: Optional[float] = None
+        self._peak = peak_flops
+        self._peak_resolved = peak_flops is not None
+        self._open: Optional[Dict] = None
+
+    # ---- declarations ---------------------------------------------------
+    def declare_flops_per_token(self, flops: float) -> None:
+        """Model-declared executed FLOPs per token for one step
+        (models.train_flops_per_token); lets ``step_end(tokens=N)``
+        derive step FLOPs without every call site doing the math."""
+        with self._lock:
+            self._flops_per_token = float(flops)
+
+    def declare_peak_flops(self, flops: Optional[float]) -> None:
+        with self._lock:
+            self._peak = flops
+            self._peak_resolved = True
+
+    def peak_flops(self) -> Optional[float]:
+        with self._lock:
+            if not self._peak_resolved:
+                self._peak = detect_peak_flops()
+                self._peak_resolved = True
+            return self._peak
+
+    # ---- the step protocol ---------------------------------------------
+    def step_begin(self) -> None:
+        """Open a step: stamp the clock, the span cursor, and the feed
+        byte counter, and enter the ``step`` span (it records at
+        ``step_end``, so the step itself ships on the flight-recorder
+        timeline).  A dangling open step (caller skipped ``step_end``,
+        e.g. a raised train step) is abandoned, not merged."""
+        if self._open is not None:
+            # abandoned step: close its span so the per-thread stack
+            # cannot grow without bound under a retry loop
+            try:
+                self._open["span"].__exit__(None, None, None)
+            except Exception:  # noqa: BLE001 - best effort unwind
+                pass
+        n = self._seq + 1
+        span = core.span("step", stage="step", args={"n": n})
+        self._open = {
+            "t0": time.perf_counter(),
+            "cursor": core.span_seq(),
+            "bytes0": core.counter_value("feed", "bytes_to_device"),
+            "tid": threading.get_ident(),
+            "span": span,
+        }
+        span.__enter__()
+
+    def step_end(self, tokens: Optional[float] = None,
+                 flops: Optional[float] = None,
+                 bytes_fed: Optional[float] = None) -> Optional[StepRecord]:
+        """Close the open step and append its record; returns it (None
+        when no step was open).  ``tokens``/``flops``/``bytes_fed``
+        default to declared-FLOPs × tokens and the feed-counter delta."""
+        opened = self._open
+        if opened is None:
+            return None
+        self._open = None
+        opened["span"].__exit__(None, None, None)
+        t1 = time.perf_counter()
+        wall = max(t1 - opened["t0"], 1e-9)
+
+        new_spans, _ = core.spans_since(opened["cursor"])
+        tid = opened["tid"]
+        buckets = {"feed": 0.0, "collective": 0.0, "pipeline": 0.0}
+        for rec in new_spans:
+            if rec.get("tid") != tid or rec.get("name") == "step":
+                continue
+            kind = _classify(rec)
+            if kind is not None:
+                buckets[kind] += rec.get("dur", 0.0) / 1e6
+        feed_s = min(buckets["feed"], wall)
+        coll_s = min(buckets["collective"], wall - feed_s)
+        compute_s = max(wall - feed_s - coll_s, 0.0)
+
+        if bytes_fed is None:
+            bytes_fed = (core.counter_value("feed", "bytes_to_device")
+                         - opened["bytes0"])
+        with self._lock:
+            if flops is None and tokens is not None \
+                    and self._flops_per_token is not None:
+                flops = self._flops_per_token * tokens
+        goodput = tokens / wall if tokens else None
+        # peak resolution can import jax (device-kind probe): only pay
+        # it when a FLOPs figure actually needs normalizing
+        peak = self.peak_flops() if flops else None
+        mfu = (flops / wall / peak) if (flops and peak) else None
+
+        with self._lock:
+            self._seq += 1
+            rec = StepRecord(
+                seq=self._seq,
+                t_wall=time.time(),
+                wall_s=wall,
+                feed_wait_s=feed_s,
+                collective_s=coll_s,
+                compute_s=compute_s,
+                pipeline_span_s=min(buckets["pipeline"], wall),
+                bytes_fed=float(bytes_fed),
+                tokens=float(tokens) if tokens is not None else None,
+                flops=float(flops) if flops is not None else None,
+                goodput_tokens_per_s=goodput,
+                mfu=mfu,
+            )
+            self._records.append(rec)
+        self._publish(rec)
+        return rec
+
+    def _publish(self, rec: StepRecord) -> None:
+        """Mirror the record into the local registry so per-rank step
+        health rides the existing heartbeat → /metrics path with no new
+        wire format."""
+        core.inc("step", "count")
+        core.observe_duration("step", "time", rec["wall_s"])
+        core.observe_duration("step", "feed_wait", rec["feed_wait_s"])
+        core.observe_duration("step", "collective", rec["collective_s"])
+        core.observe_duration("step", "compute", rec["compute_s"])
+        if rec["goodput_tokens_per_s"] is not None:
+            core.set_gauge("step", "goodput_tokens_per_s",
+                           rec["goodput_tokens_per_s"])
+        if rec["mfu"] is not None:
+            core.set_gauge("step", "mfu_pct", 100.0 * rec["mfu"])
+
+    # ---- views ----------------------------------------------------------
+    def records(self) -> List[StepRecord]:
+        with self._lock:
+            return list(self._records)
+
+    def records_since(self, after_seq: int,
+                      limit: Optional[int] = None) -> Tuple[list, int]:
+        """(new_records, last_seq): same incremental-ship contract as
+        ``core.spans_since`` — when ``limit`` truncates, ``last_seq`` is
+        the last RETURNED record's seq so the remainder ships next beat;
+        otherwise it is the high-water mark including ring-evicted
+        records."""
+        with self._lock:
+            out = [r for r in self._records if r["seq"] > after_seq]
+            last = self._seq
+        if limit is not None and len(out) > limit:
+            out = out[:limit]
+            last = out[-1]["seq"]
+        return out, last
+
+    def summary(self) -> Dict:
+        """Ledger-derived run summary (bench.py's artifact keys):
+        step-time percentiles over the retained window plus
+        whole-window goodput (Σtokens / Σwall) and mean MFU."""
+        recs = self.records()
+        if not recs:
+            return {}
+        walls = sorted(r["wall_s"] for r in recs)
+
+        def pct(q: float) -> float:
+            return walls[min(int(q / 100.0 * len(walls)), len(walls) - 1)]
+
+        out = {
+            "steps": len(recs),
+            "step_time_p50": pct(50),
+            "step_time_p99": pct(99),
+            "feed_wait_fraction": (sum(r["feed_wait_s"] for r in recs)
+                                   / max(sum(walls), 1e-9)),
+        }
+        toks = [r for r in recs if r["tokens"]]
+        if toks:
+            out["goodput_tokens_per_s"] = (
+                sum(r["tokens"] for r in toks)
+                / max(sum(r["wall_s"] for r in toks), 1e-9))
+        mfus = [r["mfu"] for r in recs if r["mfu"] is not None]
+        out["mfu"] = sum(mfus) / len(mfus) if mfus else None
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._seq = 0
+            self._flops_per_token = None
+            self._open = None
+
+
+# ---------------------------------------------------------------------------
+# process-global default ledger (the one heartbeats ship)
+# ---------------------------------------------------------------------------
+
+_default = StepLedger()
+
+
+def ledger() -> StepLedger:
+    return _default
+
+
+def step_begin() -> None:
+    _default.step_begin()
+
+
+def step_end(tokens: Optional[float] = None, flops: Optional[float] = None,
+             bytes_fed: Optional[float] = None) -> Optional[StepRecord]:
+    return _default.step_end(tokens=tokens, flops=flops,
+                             bytes_fed=bytes_fed)
+
+
+def declare_flops_per_token(flops: float) -> None:
+    _default.declare_flops_per_token(flops)
+
+
+def declare_peak_flops(flops: Optional[float]) -> None:
+    _default.declare_peak_flops(flops)
+
+
+def reset_steps() -> None:
+    """Clear the default ledger (test isolation)."""
+    _default.reset()

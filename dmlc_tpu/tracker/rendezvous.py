@@ -249,7 +249,7 @@ class RabitTracker:
         self._monitor: Optional[threading.Thread] = None
         self._monitor_stop = threading.Event()
         from ..telemetry import (FlightRecorder, TelemetryAggregator,
-                                 exporters, spans)
+                                 Watchdog, exporters, spans)
 
         # local_snapshot: the tracker process IS the launcher for local
         # jobs — its own registry carries restart/retry counters that no
@@ -265,6 +265,12 @@ class RabitTracker:
         # their heartbeats; /trace serves the clock-corrected merge,
         # with the tracker's own spans riding along as the reference row
         self.flight = FlightRecorder(local_spans=spans, log=logger)
+        # anomaly watchdog: consumes the step-ledger records riding the
+        # same heartbeats; its dmlc_anomaly_active gauges join /metrics
+        # and its verdicts mark the merged /trace timeline
+        self.watchdog = Watchdog(log=logger)
+        self.telemetry.extra_text = self.watchdog.prometheus_text
+        self.flight.marker_source = self.watchdog.trace_markers
         self.metrics_server = None
         self.metrics_port: Optional[int] = None
         if metrics_port is None:
@@ -275,10 +281,11 @@ class RabitTracker:
 
             self.metrics_server = TelemetryHTTPServer(
                 self.telemetry, host=host_ip, port=metrics_port,
-                trace_source=self.flight.to_chrome_trace)
+                trace_source=self.flight.to_chrome_trace,
+                anomaly_source=self.watchdog.report)
             self.metrics_port = self.metrics_server.port
-            logger.info("tracker /metrics + /trace on %s:%d", host_ip,
-                        self.metrics_port)
+            logger.info("tracker /metrics + /trace + /anomalies on %s:%d",
+                        host_ip, self.metrics_port)
         logger.info("tracker listening on %s:%d", host_ip, self.port)
 
     def worker_envs(self) -> Dict[str, str]:
@@ -334,10 +341,31 @@ class RabitTracker:
                     # telemetry heartbeat: latest snapshot for this rank
                     # (short session, like print; never fails the job);
                     # any shipped trace sub-document feeds the flight
-                    # recorder's per-rank span store
+                    # recorder's per-rank span store and the anomaly
+                    # watchdog's step-record stream.  Parsed ONCE here —
+                    # beats run up to DMLC_TELEMETRY_MAX_BEAT_BYTES and
+                    # this loop also serves rendezvous/clock traffic, so
+                    # three consumers must not mean three json.loads
                     payload = w.sock.recv_str()
-                    self.telemetry.update_json(w.rank, payload)
-                    self.flight.ingest_json(w.rank, payload, host=w.host)
+                    try:
+                        doc = json.loads(payload)
+                        if not isinstance(doc, dict):
+                            raise TypeError("non-dict telemetry "
+                                            f"({type(doc).__name__})")
+                    except Exception as e:  # noqa: BLE001 - keep serving
+                        logger.warning(
+                            "rank %d sent malformed telemetry: %r",
+                            w.rank, e)
+                        continue
+                    self.telemetry.update(w.rank, doc)
+                    trace = doc.get("trace")
+                    if isinstance(trace, dict):
+                        self.flight.ingest(w.rank, trace, host=w.host)
+                        steps = trace.get("steps")
+                        if steps:
+                            self.watchdog.ingest(
+                                w.rank, steps,
+                                anchor=trace.get("anchor"))
                     continue
                 if w.cmd == "clock":
                     # NTP-style ping: stamp receipt (t1) and reply send
@@ -465,6 +493,9 @@ class RabitTracker:
             entry.sock.close()  # usually already closed by the worker
         if self._registry is not None:
             self._registry.drop(rank)
+        # the replacement's step baselines start over (fresh process,
+        # fresh compile warmup); its anomaly history stays in the ring
+        self.watchdog.drop(rank)
 
     def _monitor_loop(self) -> None:
         interval = max(0.1, min(1.0, self.miss_window_s / 4))

@@ -82,8 +82,12 @@ def main():
         else "float32",
         remat=True)
     params = init_params(jax.random.PRNGKey(0), cfg, n_stages=1)
+    # ledger=False: this loop drives the step ledger ITSELF so the
+    # batch fetch lands inside the step window — feed.wait is then
+    # billed to the step's feed-wait share (make_train_step's built-in
+    # ledger would only see the compute half)
     step, init_state = make_train_step(
-        mesh, cfg, optimizer=optax.adamw(3e-4))
+        mesh, cfg, optimizer=optax.adamw(3e-4), ledger=False)
     opt_state = init_state(params)
 
     manager = start_at = None
@@ -102,47 +106,67 @@ def main():
     per_part = 8  # records per partition per batch
     feed = recordio_feed(uri, mesh, batch_records=per_part,
                          max_bytes=(SEQ + 1) * 4)
+    from dmlc_tpu import telemetry
+    from dmlc_tpu.models import train_flops_per_token
+
+    telemetry.declare_flops_per_token(train_flops_per_token(cfg, SEQ))
     done = 0
     # data fast-forward: this feed is deterministic, so replaying
     # start_at batches puts the stream exactly where the saved run was
     # (a demo-grade skip — it pays full pipeline + transfer cost per
     # discarded batch; production resumes would skip at the host side)
     skip = start_at or 0
+    feed_iter = iter(feed)
     while done < steps:
-        for batch in feed:
-            # epoch-tail short batch: its zero-padded rows would train on
-            # all-zero tokens (garbage targets).  Dropped BEFORE the
-            # resume fast-forward so never-trained batches don't consume
-            # `skip` — step count stays equal to trained-batch count
-            if np.any(np.asarray(batch["length"]) == 0):
-                continue
-            if skip > 0:
-                skip -= 1
-                continue
-            with metrics.annotate("train_step"):
-                data = jnp.asarray(batch["data"])
-                toks = jax.lax.bitcast_convert_type(
-                    data.reshape(-1, SEQ + 1, 4), jnp.int32
-                ).reshape(-1, SEQ + 1)
-                ids, labels = toks[:, :-1], toks[:, 1:]
-                params, opt_state, loss = step(params, opt_state, ids,
-                                               labels)
-            done += 1
-            if done % 10 == 0 or done == 1:
-                print(f"step {done}: loss {float(loss):.4f}", flush=True)
-            if manager is not None and done % 20 == 0:
-                manager.save((start_at or 0) + done,
-                             {"params": params, "opt": opt_state})
-            if done >= steps:
-                break
+        # the step ledger opens BEFORE the batch pull so the feed's
+        # consumer wait (feed.wait span) is billed to this step's
+        # feed-wait share; skipped/tail batches abandon the open step
+        # (the next step_begin unwinds it) and are never recorded
+        telemetry.step_begin()
+        batch = next(feed_iter, None)
+        if batch is None:
+            feed_iter = iter(feed)  # next epoch
+            continue
+        # epoch-tail short batch: its zero-padded rows would train on
+        # all-zero tokens (garbage targets).  Dropped BEFORE the
+        # resume fast-forward so never-trained batches don't consume
+        # `skip` — step count stays equal to trained-batch count
+        if np.any(np.asarray(batch["length"]) == 0):
+            continue
+        if skip > 0:
+            skip -= 1
+            continue
+        with metrics.annotate("train_step"):
+            data = jnp.asarray(batch["data"])
+            toks = jax.lax.bitcast_convert_type(
+                data.reshape(-1, SEQ + 1, 4), jnp.int32
+            ).reshape(-1, SEQ + 1)
+            ids, labels = toks[:, :-1], toks[:, 1:]
+            params, opt_state, loss = step(params, opt_state, ids,
+                                           labels)
+        telemetry.step_end(tokens=int(ids.size))
+        done += 1
+        if done % 10 == 0 or done == 1:
+            print(f"step {done}: loss {float(loss):.4f}", flush=True)
+        if manager is not None and done % 20 == 0:
+            manager.save((start_at or 0) + done,
+                         {"params": params, "opt": opt_state})
     if manager is not None and done % 20 != 0:  # periodic save already hit
         manager.save((start_at or 0) + done,
                      {"params": params, "opt": opt_state})
     snap = metrics.snapshot()
     fed = snap.get("feed", {})
+    led = telemetry.ledger().summary()
     print(f"final loss {float(loss):.4f}; feed moved "
           f"{fed.get('bytes_to_device', 0) / 1e6:.1f} MB in "
           f"{int(fed.get('batches', 0))} batches")
+    if led:
+        mfu = led.get("mfu")
+        print(f"ledger: step p50 {led['step_time_p50'] * 1e3:.1f} ms, "
+              f"p99 {led['step_time_p99'] * 1e3:.1f} ms, feed-wait "
+              f"{led['feed_wait_fraction'] * 100:.0f}%, goodput "
+              f"{led.get('goodput_tokens_per_s', 0):,.0f} tok/s"
+              + (f", MFU {mfu * 100:.1f}%" if mfu is not None else ""))
 
 
 if __name__ == "__main__":

@@ -14,11 +14,15 @@
 #      full correctness suite (shm transport + TCP fallback) under the
 #      real launcher, leak detection on — the shm/KV code is the one
 #      native surface with nontrivial object lifecycle
-#   6. telemetry smoke: 2-worker local rendezvous pushing heartbeats,
-#      tracker /metrics scraped + validated as Prometheus text (incl.
-#      build-info/heartbeat-age gauges), /trace validated as a 2-rank
-#      clock-corrected merged Chrome trace (distinct pids, labeled
-#      rank rows), local Chrome trace export validated as JSON
+#   6. telemetry smoke: 2-worker local rendezvous pushing heartbeats
+#      while driving the step ledger with rank 1 fault-injected slow;
+#      the anomaly watchdog must flag exactly that rank as a straggler
+#      on /anomalies (no false positive on rank 0), dmlc-top renders a
+#      plain refresh against the live tracker, /metrics is validated
+#      as STRICT Prometheus text (grouping, one TYPE per family, incl.
+#      build-info/heartbeat-age/step-ledger/anomaly families), /trace
+#      as a 2-rank clock-corrected merged Chrome trace with the
+#      watchdog's anomaly marker, local Chrome trace export as JSON
 #   7. chaos smoke: FaultInjector kills rank 1 at a barrier mid-job;
 #      the tracker's heartbeat failure detector declares it dead, the
 #      launcher restarts it within its budget, the replacement rejoins

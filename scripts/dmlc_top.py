@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""``dmlc top`` — live cluster step-health view over ssh.
+
+Polls a running tracker's ``/anomalies`` + ``/healthz`` endpoints
+(telemetry.heartbeat.TelemetryHTTPServer; enable with
+``DMLC_TRACKER_METRICS_PORT``) and renders one line per rank:
+
+    RANK  STEP ms  EWMA ms  GOODPUT tok/s  MFU%%  FEED%%  HB AGE  FLAGS
+
+``STEP``/``EWMA`` come from each rank's shipped step-ledger records,
+``FEED%%`` is the watchdog's feed-wait-fraction EWMA, ``FLAGS`` are the
+watchdog's active anomaly verdicts (straggler / regression /
+feed_stall / goodput_collapse), and ``HB AGE`` is heartbeat staleness
+from /healthz (dead ranks render as ``DEAD``).
+
+Runs full-screen (curses) when stdout is a TTY; ``--plain`` prints one
+table per refresh instead (pipe-friendly, and what the CI smoke
+drives).  ``--once`` renders a single refresh and exits.
+
+Usage:
+    dmlc-top <host:port | http://host:port> [--interval 2]
+             [--plain] [--once] [-n N]
+"""
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+__all__ = ["fetch", "render_table", "main"]
+
+COLUMNS = ("RANK", "STEP ms", "EWMA ms", "GOODPUT", "MFU%", "FEED%",
+           "HB AGE", "FLAGS")
+_FMT = "{:>5} {:>9} {:>9} {:>11} {:>6} {:>6} {:>7}  {}"
+
+
+def fetch(base_url: str, timeout: float = 5.0) -> dict:
+    """One poll: {"anomalies": ..., "healthz": ...} (missing endpoint →
+    empty dict, so the view degrades instead of dying mid-watch)."""
+    out = {}
+    for key, path in (("anomalies", "/anomalies"), ("healthz", "/healthz")):
+        try:
+            with urllib.request.urlopen(base_url + path,
+                                        timeout=timeout) as r:
+                out[key] = json.load(r)
+        except Exception:  # noqa: BLE001 - endpoint may be older/absent
+            out[key] = {}
+    return out
+
+
+def _ms(v) -> str:
+    return f"{v * 1e3:.1f}" if isinstance(v, (int, float)) else "-"
+
+
+def _num(v, fmt="{:.0f}") -> str:
+    return fmt.format(v) if isinstance(v, (int, float)) else "-"
+
+
+def render_table(doc: dict, base_url: str = "") -> str:
+    """The poll document as fixed-width text (one refresh)."""
+    an = doc.get("anomalies") or {}
+    hz = doc.get("healthz") or {}
+    ranks = an.get("ranks") or {}
+    ages = hz.get("ranks") or {}
+    dead = {str(r) for r in hz.get("dead_ranks") or []}
+    cluster = an.get("cluster") or {}
+    lines = []
+    med = cluster.get("median_step_s")
+    lines.append(
+        f"dmlc top — {base_url}  {time.strftime('%H:%M:%S')}  "
+        f"ranks={hz.get('ranks_reporting', len(ranks))} "
+        f"dead={sorted(dead) if dead else '[]'} "
+        f"median_step={_ms(med)}ms "
+        f"active_anomalies={len(an.get('active') or [])}")
+    lines.append(_FMT.format(*COLUMNS))
+    for r in sorted(set(ranks) | set(ages), key=lambda x: int(x)):
+        st = ranks.get(r) or {}
+        age = ages.get(r)
+        mfu = st.get("mfu")
+        feed = st.get("feed_stall_frac")
+        flags = ",".join(st.get("flags") or [])
+        if r in dead:
+            flags = ("DEAD," + flags).rstrip(",")
+        lines.append(_FMT.format(
+            r,
+            _ms(st.get("step_time_s")),
+            _ms(st.get("step_time_ewma_s")),
+            _num(st.get("goodput_tokens_per_s"), "{:,.0f}"),
+            _num(mfu * 100 if isinstance(mfu, (int, float)) else None,
+                 "{:.1f}"),
+            _num(feed * 100 if isinstance(feed, (int, float)) else None,
+                 "{:.0f}"),
+            _num(age, "{:.1f}s"),
+            flags or "-"))
+    verdicts = (an.get("recent_verdicts") or [])[-3:]
+    for v in verdicts:
+        lines.append(f"  ! rank {v.get('rank')} {v.get('kind')}: "
+                     f"{v.get('detail', '')}")
+    return "\n".join(lines)
+
+
+def _plain_loop(url: str, interval: float, iterations: int) -> int:
+    n = 0
+    while True:
+        print(render_table(fetch(url), url), flush=True)
+        n += 1
+        if iterations and n >= iterations:
+            return 0
+        print()
+        time.sleep(interval)
+
+
+def _curses_loop(url: str, interval: float, iterations: int) -> int:
+    import curses
+
+    def run(scr):
+        curses.curs_set(0)
+        scr.nodelay(True)
+        n = 0
+        while True:
+            text = render_table(fetch(url), url)
+            scr.erase()
+            maxy, maxx = scr.getmaxyx()
+            for i, line in enumerate(text.splitlines()):
+                if i >= maxy - 1:
+                    break
+                scr.addnstr(i, 0, line, maxx - 1)
+            scr.addnstr(min(maxy - 1, i + 2), 0,
+                        "q to quit", maxx - 1)
+            scr.refresh()
+            n += 1
+            if iterations and n >= iterations:
+                return
+            deadline = time.time() + interval
+            while time.time() < deadline:
+                ch = scr.getch()
+                if ch in (ord("q"), ord("Q")):
+                    return
+                time.sleep(0.05)
+
+    curses.wrapper(run)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dmlc-top", description=__doc__.splitlines()[0])
+    ap.add_argument("tracker", help="tracker metrics endpoint: host:port "
+                    "or http://host:port (DMLC_TRACKER_METRICS_PORT)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period seconds (default 2)")
+    ap.add_argument("--plain", action="store_true",
+                    help="print tables instead of the curses screen")
+    ap.add_argument("--once", action="store_true",
+                    help="render one refresh and exit")
+    ap.add_argument("-n", "--iterations", type=int, default=0,
+                    help="stop after N refreshes (0 = forever)")
+    args = ap.parse_args(argv)
+    url = args.tracker
+    if not url.startswith("http"):
+        url = "http://" + url
+    url = url.rstrip("/")
+    iterations = 1 if args.once else args.iterations
+    use_curses = not args.plain and sys.stdout.isatty()
+    if use_curses:
+        try:
+            return _curses_loop(url, args.interval, iterations)
+        except Exception:  # noqa: BLE001 - no curses/terminal: degrade
+            pass
+    try:
+        return _plain_loop(url, args.interval, iterations)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -10,6 +10,16 @@ AST walk over every repo Python file checking the high-value classes:
   * mutable default args    (shared-state bugs)
   * tabs / trailing whitespace
   * lines over 100 columns
+  * metric-name contract    every ``dmlc_*`` metric family the code can
+                            emit (literal telemetry.inc/observe/... call
+                            sites resolve to ``dmlc_<stage>_<name>``)
+                            and every literal ``dmlc_*`` string must
+                            appear in the checked-in registry
+                            ``dmlc_tpu/telemetry/metric_names.py`` —
+                            MIGRATION.md's "no renames, additive only"
+                            promise, enforced (a typo'd duplicate
+                            family or a scrape assertion on a name
+                            nobody emits fails here, not in prod)
 
 Exit 0 clean, 1 with findings (one per line: path:line: message).
 Usage: python scripts/lint.py [paths...]
@@ -17,12 +27,21 @@ Usage: python scripts/lint.py [paths...]
 
 import ast
 import os
+import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_ROOTS = ["dmlc_tpu", "tests", "scripts", "examples", "bench.py",
-                 "__graft_entry__.py", "bin/dmlc-submit"]
+                 "__graft_entry__.py", "bin/dmlc-submit", "bin/dmlc-top"]
 MAX_COLS = 100
+
+# roots whose telemetry call sites define REAL metric families; tests
+# register throwaway stages ("stage", "smoke") that are not contract
+METRIC_ROOTS = ("dmlc_tpu", "scripts", "examples", "bench.py")
+_METRIC_FUNCS = {"inc", "set_gauge", "observe", "observe_duration",
+                 "timed"}
+_METRIC_TOKEN_RE = re.compile(r"dmlc_[a-z0-9]+(?:_[a-z0-9]+)*")
+_METRIC_SUFFIXES = ("_bucket", "_sum", "_count", "_total")
 
 
 def py_files(roots):
@@ -110,16 +129,86 @@ def check_file(path):
     return findings
 
 
+def _registry():
+    sys.path.insert(0, REPO)
+    from dmlc_tpu.telemetry import metric_names
+
+    return metric_names
+
+
+def _is_registered(token: str, known: set) -> bool:
+    if token in known:
+        return True
+    for suf in _METRIC_SUFFIXES:
+        if token.endswith(suf) and token[: -len(suf)] in known:
+            return True
+    return False
+
+
+def check_metric_contract(paths) -> list:
+    """Cross-file pass: derive every metric family literal call sites
+    can emit, plus every literal ``dmlc_*`` string, and demand each is
+    registered in dmlc_tpu/telemetry/metric_names.py."""
+    reg = _registry()
+    known = (set(reg.METRIC_NAMES) | set(reg.SPAN_ANNOTATIONS)
+             | set(reg.NON_METRIC_TOKENS))
+    registry_path = os.path.join(REPO, "dmlc_tpu", "telemetry",
+                                 "metric_names.py")
+    findings = []
+    for path in paths:
+        if os.path.abspath(path) == registry_path:
+            continue  # the registry trivially contains itself
+        rel = os.path.relpath(path, REPO)
+        in_metric_root = any(
+            rel == r or rel.startswith(r + os.sep) for r in METRIC_ROOTS)
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue  # already reported by check_file
+        for node in ast.walk(tree):
+            # derived families: telemetry.inc("stage", "name", ...) and
+            # friends with literal args resolve to dmlc_<stage>_<name>
+            if in_metric_root and isinstance(node, ast.Call):
+                fn = node.func
+                fname = (fn.attr if isinstance(fn, ast.Attribute)
+                         else fn.id if isinstance(fn, ast.Name) else None)
+                args = node.args
+                if (fname in _METRIC_FUNCS and len(args) >= 2
+                        and all(isinstance(a, ast.Constant)
+                                and isinstance(a.value, str)
+                                for a in args[:2])):
+                    suffix = ("_secs" if fname in ("observe_duration",
+                                                   "timed") else "")
+                    name = f"dmlc_{args[0].value}_{args[1].value}{suffix}"
+                    if not _is_registered(name, known):
+                        findings.append(
+                            f"{rel}:{node.lineno}: metric family "
+                            f"{name!r} not in telemetry/metric_names.py "
+                            f"(add it, or fix the typo'd stage/name)")
+            # literal names: scrape assertions, hand-rendered families
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                for token in _METRIC_TOKEN_RE.findall(node.value):
+                    if not _is_registered(token, known):
+                        findings.append(
+                            f"{rel}:{node.lineno}: dmlc_* token "
+                            f"{token!r} not in telemetry/"
+                            f"metric_names.py")
+    return findings
+
+
 def main():
     roots = sys.argv[1:] or DEFAULT_ROOTS
     all_findings = []
-    n = 0
-    for path in py_files(roots):
-        n += 1
+    paths = list(py_files(roots))
+    for path in paths:
         all_findings += check_file(path)
+    all_findings += check_metric_contract(paths)
     for f in all_findings:
         print(f)
-    print(f"lint: {n} files, {len(all_findings)} findings",
+    print(f"lint: {len(paths)} files, {len(all_findings)} findings",
           file=sys.stderr)
     return 1 if all_findings else 0
 

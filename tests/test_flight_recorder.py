@@ -73,6 +73,63 @@ def test_estimator_prefers_low_rtt_and_windows_out_stale_samples():
     assert est.offset(0) is None
 
 
+def test_negative_offset_worker_ahead_of_tracker():
+    # worker clock runs 3.2s AHEAD of the tracker: offset must come out
+    # negative and the estimator must accept it (only negative RTT is
+    # impossible, not negative offset)
+    skew, wire = -3.2, 0.004
+    t0 = 200.0
+    t1 = t0 + skew + wire
+    t2 = t1 + 0.0002
+    t3 = t2 - skew + wire
+    off, rtt = offset_from_timestamps(t0, t1, t2, t3)
+    assert off == pytest.approx(skew, abs=1e-9)
+    assert rtt > 0
+    est = ClockOffsetEstimator()
+    est.update(0, offset_s=off, rtt_s=rtt)
+    assert est.offset(0) == pytest.approx(skew, abs=1e-9)
+
+
+def test_equal_rtt_tie_keeps_earlier_sample():
+    # two samples with IDENTICAL rtt: min() is stable, so the EARLIER
+    # sample stays the estimate — deterministic, and the earlier sample
+    # has had longer to prove itself against the window
+    est = ClockOffsetEstimator()
+    est.update(0, offset_s=1.5, rtt_s=0.010)
+    est.update(0, offset_s=9.9, rtt_s=0.010)
+    assert est.offset(0) == pytest.approx(1.5)
+
+
+def test_restart_anchor_change_resets_clock_estimate():
+    # a restarted worker ships a NEW anchor; the flight recorder must
+    # drop the dead incarnation's clock relation so its lucky low-RTT
+    # sample cannot pin the replacement's estimate
+    fr = FlightRecorder()
+    fr.ingest(0, {"anchor": 100.0, "spans": [],
+                  "clock": {"offset_s": 5.0, "rtt_s": 0.0001}})
+    assert fr.clock.offset(0) == pytest.approx(5.0)
+    fr.ingest(0, {"anchor": 200.0, "spans": [],
+                  "clock": {"offset_s": -2.0, "rtt_s": 0.5}})
+    # the new (much looser) sample wins because the old estimate died
+    # with the old incarnation
+    assert fr.clock.offset(0) == pytest.approx(-2.0)
+    assert fr.clock.rtt(0) == pytest.approx(0.5)
+
+
+def test_offset_error_bound_rtt_half_over_asymmetry_sweep():
+    # the NTP error bound |est - true| <= rtt/2 must hold for EVERY
+    # delay asymmetry, including fully one-sided paths
+    skew = 7.75
+    for out_ms in (0.0, 0.5, 3.0, 20.0):
+        for back_ms in (0.0, 1.0, 9.0, 40.0):
+            t0 = 10.0
+            t1 = t0 + skew + out_ms / 1e3
+            t2 = t1 + 0.0003
+            t3 = t2 - skew + back_ms / 1e3
+            off, rtt = offset_from_timestamps(t0, t1, t2, t3)
+            assert abs(off - skew) <= rtt / 2 + 1e-12, (out_ms, back_ms)
+
+
 # ---------------------------------------------------------------------------
 # flight recorder: merged clock-corrected chrome trace
 # ---------------------------------------------------------------------------

@@ -196,6 +196,130 @@ def test_prometheus_export_is_valid_text_format():
     assert "\ndmlc_feed_producer_stall_secs " not in text
 
 
+# strict exposition-format oracle: shared with the CI smoke via
+# telemetry.exporters.validate_exposition_text (ValueError on the
+# first violation; returns the sample count)
+def assert_strict_exposition(text: str) -> int:
+    from dmlc_tpu.telemetry.exporters import validate_exposition_text
+
+    return validate_exposition_text(text)
+
+
+def test_exposition_checker_rejects_violations():
+    from dmlc_tpu.telemetry.exporters import validate_exposition_text
+
+    good = ("# HELP dmlc_feed_batches x\n"
+            "# TYPE dmlc_feed_batches counter\n"
+            "dmlc_feed_batches 1\n")
+    assert validate_exposition_text(good) == 1
+    for bad, why in (
+            ("dmlc_feed_batches{rank=0} 1\n", "unquoted label"),
+            ("# TYPE dmlc_feed_batches counter\n"
+             "dmlc_feed_batches 1\n", "TYPE without HELP"),
+            (good + "# TYPE dmlc_feed_batches counter\n",
+             "duplicate TYPE"),
+            (good + "# HELP dmlc_feed_depth y\n"
+             "# TYPE dmlc_feed_depth gauge\n"
+             "dmlc_feed_depth 1\n"
+             "dmlc_feed_batches 2\n", "family split across groups"),
+    ):
+        with pytest.raises(ValueError):
+            validate_exposition_text(bad), why
+
+
+def test_prometheus_export_is_strictly_conformant():
+    telemetry.inc("feed", "batches", 7)
+    telemetry.set_gauge("feed", "depth", 2)
+    telemetry.observe_duration("feed", "producer_stall", 0.01)
+    text = telemetry.to_prometheus_text(labels={"rank": "3"})
+    assert assert_strict_exposition(text) > 0
+    assert "# HELP dmlc_feed_batches " in text
+    assert "# TYPE dmlc_feed_batches counter" in text
+    assert "# TYPE dmlc_feed_producer_stall_secs histogram" in text
+
+
+def test_prometheus_sanitizes_names_and_escapes_label_values():
+    telemetry.inc("weird-stage", "na.me", 1)
+    text = telemetry.to_prometheus_text(
+        labels={"host": 'a"b\\c\nd', "1bad label": "x"})
+    assert assert_strict_exposition(text) > 0
+    # metric name invalid chars collapse to underscores (concatenated
+    # so the metric-name contract lint doesn't read the fixture as a
+    # real family)
+    assert "dmlc" + "_weird_stage_na_me" in text
+    # label values escaped per the format; label names sanitized
+    assert '\\"' in text and "\\\\" in text and "\\n" in text
+    assert "_1bad_label=" in text
+    from dmlc_tpu.telemetry.exporters import escape_label_value
+
+    assert escape_label_value('a"b') == 'a\\"b'
+    assert escape_label_value("a\\b") == "a\\\\b"
+    assert escape_label_value("a\nb") == "a\\nb"
+
+
+def test_aggregated_multirank_surface_is_strictly_conformant():
+    agg = TelemetryAggregator()
+    for rank in (0, 1):
+        telemetry.reset()
+        telemetry.inc("smoke", "beats", rank + 1)
+        telemetry.observe_duration("feed", "producer_stall",
+                                   0.01 * (rank + 1))
+        agg.update(rank, telemetry.snapshot())
+    text = agg.prometheus_text()
+    n = assert_strict_exposition(text)
+    assert n > 0
+    # both ranks AND the merged view share ONE group per family
+    assert text.count("# TYPE dmlc_smoke_beats counter") == 1
+    for want in ('dmlc_smoke_beats{rank="0"}',
+                 'dmlc_smoke_beats{rank="1"}',
+                 'dmlc_smoke_beats{rank="all"}'):
+        assert want in text
+    # hand-rendered families carry HELP/TYPE exactly once
+    assert text.count("# TYPE dmlc_build_info gauge") == 1
+    assert text.count("# TYPE dmlc_heartbeat_age_seconds gauge") == 1
+
+
+def test_collect_prometheus_histogram_wins_collisions_both_orders():
+    """Cross-snapshot type collision (version-skewed ranks): the
+    histogram rendering must win whichever snapshot arrives first —
+    a bare counter sample inside a histogram-typed family is invalid."""
+    from dmlc_tpu.telemetry.exporters import (collect_prometheus,
+                                              render_prometheus)
+
+    h = Histogram()
+    h.observe(0.5)
+    counter_snap = {"counters": {"feed": {"batches": 3.0}},
+                    "gauges": {}, "histograms": {}}
+    hist_snap = {"counters": {}, "gauges": {},
+                 "histograms": {"feed": {"batches": h.summary()}}}
+    for first, second in ((counter_snap, hist_snap),
+                          (hist_snap, counter_snap)):
+        fams = {}
+        collect_prometheus(first, labels={"rank": "0"}, out=fams)
+        collect_prometheus(second, labels={"rank": "1"}, out=fams)
+        text = render_prometheus(fams)
+        assert text.count("# TYPE dmlc_feed_batches histogram") == 1
+        assert "dmlc_feed_batches_sum" in text
+        # the bare counter sample is dropped in BOTH orders
+        assert "\ndmlc_feed_batches{" not in text
+        assert_strict_exposition(text)
+
+
+def test_aggregator_extra_text_appended_to_scrape():
+    agg = TelemetryAggregator()
+    agg.update(0, {"counters": {"s": {"c": 1.0}}, "gauges": {},
+                   "histograms": {}})
+    agg.extra_text = lambda: "# HELP dmlc_anomaly_active x\n" \
+                            "# TYPE dmlc_anomaly_active gauge\n" \
+                            'dmlc_anomaly_active{rank="0"} 0\n'
+    text = agg.prometheus_text()
+    assert 'dmlc_anomaly_active{rank="0"} 0' in text
+    assert_strict_exposition(text)
+    # a raising extra_text must not 500 the scrape
+    agg.extra_text = lambda: 1 / 0
+    assert "dmlc_tracker_ranks_reporting" in agg.prometheus_text()
+
+
 def test_export_json_strips_buckets_by_default():
     telemetry.observe_duration("s", "t", 0.1)
     slim = telemetry.export_json()
