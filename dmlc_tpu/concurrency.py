@@ -47,6 +47,13 @@ class BufferPool(Generic[T]):
 
     ``kill()`` wakes every blocked acquirer with ``None`` so pipeline
     teardown never leaves a thread parked on an empty pool.
+
+    ``acquire(timeout=...)`` bounds the wait against an absolute
+    deadline and returns ``None`` on expiry — the admission-queue
+    contract (serving.engine): a full pool becomes a clean reject
+    (HTTP 429) instead of an unbounded block, and ``kill()`` still
+    wakes timed waiters immediately on shutdown.  ``timeout=0`` is a
+    non-blocking try-acquire.
     """
 
     def __init__(self, factory: Callable[[], T], capacity: int = 2):

@@ -9,8 +9,12 @@ InputSplit partitions through the device feed into a 5-way-parallel
 from .transformer import (  # noqa: F401
     TransformerConfig,
     count_params,
+    decode_flops_per_token,
     flagship_config,
+    forward_decode,
     forward_local,
+    forward_prefill,
+    forward_prefill_last,
     init_params,
     make_train_step,
     param_specs,

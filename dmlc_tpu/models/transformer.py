@@ -394,6 +394,179 @@ def unsharded_loss(params, ids, labels, cfg: TransformerConfig):
     return forward_local(params, ids, labels, cfg, ShardAxes())
 
 
+# ---------------------------------------------------------------------------
+# serving forward paths: prefill (full sequence, returns per-layer K/V)
+# and single-token decode against an externally supplied KV cache
+# (dmlc_tpu.serving drives these; the paged cache lives in
+# serving/kv_cache.py — the model only sees dense gathered views)
+# ---------------------------------------------------------------------------
+
+
+def decode_flops_per_token(cfg: TransformerConfig, ctx: int) -> float:
+    """Executed forward FLOPs for ONE generated token attending a
+    ``ctx``-token context — the serving engine's declaration to the
+    step ledger, so decode-step MFU is accounted on the same basis as
+    training MFU.  A decode token runs every projection once and its
+    attention reads the full context (no causal halving applies), which
+    is exactly the forward third of ``train_flops_per_token`` counted
+    without the causal discount."""
+    return train_flops_per_token(cfg, ctx, causal=False) / 3.0
+
+
+def _rope_at(x, positions, theta: float = 10000.0):
+    """Rotary embedding for decode: x [B, 1, H, D] with a PER-SEQUENCE
+    position [B] (continuous batching puts every active request at a
+    different depth, so the shared-[T] ``rope`` signature cannot serve)."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [B, half]
+    cos = jnp.cos(angles)[:, None, None, :]
+    sin = jnp.sin(angles)[:, None, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _prefill_attention(q, k, v):
+    """Causal full-sequence attention for prefill: the Pallas flash
+    kernel on TPU when shapes allow, the materialized oracle elsewhere
+    (same dispatch as the training path's unsharded branch)."""
+    from ..ops import flash_attention as _flash
+
+    if jax.default_backend() == "tpu" and _flash.supports(q.shape, k.shape):
+        return _flash.flash_attention(q, k, v, causal=True)
+    return ring_attention_reference(q, k, v, causal=True)
+
+
+def _cached_attention(q, k_new, v_new, k_cache, v_cache, lengths):
+    """One-token attention over an external cache.
+
+    q/k_new/v_new: [B, 1, H, D] (the token being consumed, post-rope);
+    k_cache/v_cache: [B, Tc, H, D] — slot j of row b is valid iff
+    j < lengths[b] (paged gathers pad with garbage past the length).
+    The new token's K/V ride along explicitly so the caller can write
+    them into the cache AFTER the step (the cache never holds a token
+    the model has not consumed yet).
+    """
+    d = q.shape[-1]
+    tc = k_cache.shape[1]
+    k_all = jnp.concatenate([k_cache, k_new], axis=1)  # [B, Tc+1, H, D]
+    v_all = jnp.concatenate([v_cache, v_new], axis=1)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_all,
+                   preferred_element_type=jnp.float32) * (1.0 / d ** 0.5)
+    idx = jnp.arange(tc + 1)
+    valid = (idx[None, :] < lengths[:, None]) | (idx[None, :] == tc)
+    s = jnp.where(valid[:, None, None, :], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_all.dtype), v_all,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def _layer_params(blocks, stage: int, layer: int):
+    return jax.tree.map(lambda a: a[stage, layer], blocks)
+
+
+def _prefill_trunk(params, ids, cfg: TransformerConfig):
+    """All prefill layers up to (and including) the final norm:
+    returns ``(x [B, T, E], k, v [L, B, T, H, hd])`` — shared by the
+    full-logits and last-position heads below."""
+    _, t = ids.shape
+    positions = jnp.arange(t)
+    x = embed_lookup(params["embed"], ids, ShardAxes()).astype(cfg.jdtype)
+    blocks = params["blocks"]
+    n_stages, lps = blocks["ln1"].shape[0], blocks["ln1"].shape[1]
+    ks, vs = [], []
+    for s in range(n_stages):
+        for i in range(lps):
+            p = _layer_params(blocks, s, i)
+            xn = rms_norm(x, p["ln1"])
+            q = jnp.einsum("bte,ehd->bthd", xn, p["wq"])
+            k = jnp.einsum("bte,ehd->bthd", xn, p["wk"])
+            v = jnp.einsum("bte,ehd->bthd", xn, p["wv"])
+            q = rope(q, positions)
+            k = rope(k, positions)
+            o = _prefill_attention(q, k, v)
+            x = x + jnp.einsum("bthd,hde->bte", o, p["wo"])
+            x = x + _moe_ffn(rms_norm(x, p["ln2"]), p, ShardAxes(), cfg)
+            ks.append(k)
+            vs.append(v)
+    x = rms_norm(x, params["ln_f"])
+    return x, jnp.stack(ks), jnp.stack(vs)
+
+
+def forward_prefill(params, ids, cfg: TransformerConfig):
+    """Serving prefill: full forward over ``ids`` [B, T] returning
+    ``(logits [B, T, V], k, v)`` with k/v ``[L, B, T, H, hd]`` — the
+    post-rope per-layer keys/values the decode path needs cached.
+
+    Single-chip math (ShardAxes()); right-padding is safe because
+    attention is causal: positions < the true length never attend a pad
+    token, so their K/V and logits are unaffected — the serving engine
+    pads prompts to length buckets to bound jit recompilation.
+    """
+    x, k, v = _prefill_trunk(params, ids, cfg)
+    logits = jnp.einsum("bte,ev->btv", x, params["unembed"])
+    return logits, k, v
+
+
+def forward_prefill_last(params, ids, last_index, cfg: TransformerConfig):
+    """Prefill with logits at ONE position per sequence:
+    ``(logits [B, V], k, v)`` for ``last_index`` [B] (each sequence's
+    final real token in a right-padded batch).  The unembed is the
+    model's largest single matmul at flagship vocab — projecting all T
+    padded positions just to slice one row would multiply the serving
+    prefill's dominant term by T, so the engine uses this head."""
+    x, k, v = _prefill_trunk(params, ids, cfg)
+    x_last = jnp.take_along_axis(
+        x, last_index[:, None, None].astype(jnp.int32), axis=1)  # [B,1,E]
+    logits = jnp.einsum("bte,ev->btv", x_last, params["unembed"])[:, 0]
+    return logits, k, v
+
+
+def forward_decode(params, ids, positions, k_cache, v_cache, lengths,
+                   cfg: TransformerConfig):
+    """Single-token decode step against an externally supplied KV cache.
+
+    ids / positions / lengths: [B] — the token each sequence consumes
+    this step, its absolute position, and how many tokens of that
+    sequence the cache currently holds (positions == lengths for a
+    healthy cache; they are separate arguments so tests can probe).
+    k_cache / v_cache: [L, B, Tc, H, hd] dense gathered views (padded;
+    see :func:`_cached_attention` for validity).
+
+    Returns ``(logits [B, V], k_new, v_new [L, B, H, hd])``: the
+    next-token logits and this token's per-layer K/V for the caller to
+    append to the cache.  Batch rows are independent, so a continuous
+    batcher can pad the batch with dead rows (length 0) freely.
+    """
+    x = embed_lookup(params["embed"], ids[:, None],
+                     ShardAxes()).astype(cfg.jdtype)  # [B, 1, E]
+    blocks = params["blocks"]
+    n_stages, lps = blocks["ln1"].shape[0], blocks["ln1"].shape[1]
+    k_news, v_news = [], []
+    li = 0
+    for s in range(n_stages):
+        for i in range(lps):
+            p = _layer_params(blocks, s, i)
+            xn = rms_norm(x, p["ln1"])
+            q = jnp.einsum("bte,ehd->bthd", xn, p["wq"])
+            k = jnp.einsum("bte,ehd->bthd", xn, p["wk"])
+            v = jnp.einsum("bte,ehd->bthd", xn, p["wv"])
+            q = _rope_at(q, positions)
+            k = _rope_at(k, positions)
+            o = _cached_attention(q, k, v, k_cache[li], v_cache[li], lengths)
+            x = x + jnp.einsum("bthd,hde->bte", o, p["wo"])
+            x = x + _moe_ffn(rms_norm(x, p["ln2"]), p, ShardAxes(), cfg)
+            k_news.append(k[:, 0])
+            v_news.append(v[:, 0])
+            li += 1
+    x = rms_norm(x, params["ln_f"])
+    logits = jnp.einsum("bte,ev->btv", x, params["unembed"])[:, 0]
+    return logits, jnp.stack(k_news), jnp.stack(v_news)
+
+
 def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
                     ledger: bool = True):
     """Build a jitted SPMD train step over ``mesh``.

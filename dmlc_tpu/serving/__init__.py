@@ -1,0 +1,43 @@
+"""dmlc_tpu.serving: the request-serving plane.
+
+The training substrate pointed at users: a continuous-batching
+inference server over the flagship transformer, built from the pieces
+the repo already trusts —
+
+  * ``kv_cache``   paged (block-granular) KV storage with a free-list
+                   allocator; gathered views shard over parallel.mesh
+  * ``scheduler``  Orca-style iteration-level admit/evict with
+                   preemption-by-recompute under memory pressure
+  * ``engine``     the prefill/decode loop: jitted model programs,
+                   greedy sampling, BufferPool admission backpressure,
+                   and one StepLedger step per decode iteration (p50/
+                   p99 step time, goodput, decode MFU on /metrics)
+  * ``server``     POST /generate + /metrics + /healthz HTTP surface
+                   (TelemetryHTTPServer pattern; 429 on a full queue)
+  * ``loadgen``    N-stream closed-loop load + BENCH_serving.json
+
+Launch with ``bin/dmlc-serve``; knobs are the ``DMLC_SERVE_*`` family
+(README "Serving"); the CI smoke is ``scripts/serving_smoke.py``.
+"""
+
+from .engine import (  # noqa: F401
+    AdmissionFull,
+    InferenceEngine,
+    RequestTooLarge,
+)
+from .kv_cache import BlockAllocator, PagedKVCache  # noqa: F401
+from .loadgen import LoadGenerator  # noqa: F401
+from .scheduler import ContinuousBatchScheduler, Request  # noqa: F401
+from .server import ServingHTTPServer  # noqa: F401
+
+__all__ = [
+    "AdmissionFull",
+    "BlockAllocator",
+    "ContinuousBatchScheduler",
+    "InferenceEngine",
+    "LoadGenerator",
+    "PagedKVCache",
+    "Request",
+    "RequestTooLarge",
+    "ServingHTTPServer",
+]

@@ -1,0 +1,371 @@
+"""Inference engine: the per-iteration prefill/decode loop.
+
+The engine owns the compute half of serving: jitted
+``models.forward_prefill`` / ``models.forward_decode`` programs, the
+paged cache's data plane, greedy sampling, and the instrumentation
+contract — every decode iteration is a **step** on the PR 5
+:class:`telemetry.StepLedger` (``step_begin``/``step_end`` with the
+batch's token count and the exact forward FLOPs given each sequence's
+context length), so a serving process surfaces p50/p99 decode-step
+time, goodput tokens/s, and decode MFU on ``/metrics`` and in
+``dmlc top`` through the machinery training already built.
+
+Admission backpressure is a ``concurrency.BufferPool`` of request
+slots: ``submit`` must acquire one within ``admit_timeout_s`` or the
+request is rejected (the HTTP layer maps that to 429) — the pool's
+kill-wakes semantics double as clean shutdown for blocked submitters.
+
+Shape discipline (XLA recompiles per shape, so both are bucketed):
+prefill pads prompts up to a whole number of KV blocks (safe under
+causal attention), and decode always runs the full ``max_active``-row
+batch with dead rows masked by length 0, growing the gathered context
+in whole-block steps.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from .. import telemetry
+from ..base import DMLCError, get_env
+from ..concurrency import BufferPool
+from ..models import transformer as tfm
+from .kv_cache import PagedKVCache
+from .scheduler import ACTIVE, ContinuousBatchScheduler, Request
+
+__all__ = ["InferenceEngine", "AdmissionFull"]
+
+logger = logging.getLogger("dmlc_tpu.serving")
+
+
+class AdmissionFull(DMLCError):
+    """The admission queue stayed full past the timeout (HTTP 429)."""
+
+
+class RequestTooLarge(DMLCError):
+    """The request could never fit the KV pool, even alone (HTTP 413)."""
+
+
+_JIT_CACHE: dict = {}
+
+
+def _jitted_programs():
+    """Process-wide jitted prefill/decode (one jit wrapper, so every
+    engine instance shares one compile cache — tests and smokes build
+    several engines and must not pay XLA again for identical shapes)."""
+    if not _JIT_CACHE:
+        import jax
+
+        _JIT_CACHE["prefill"] = jax.jit(tfm.forward_prefill_last,
+                                        static_argnums=(3,))
+        _JIT_CACHE["decode"] = jax.jit(tfm.forward_decode,
+                                       static_argnums=(6,))
+    return _JIT_CACHE["prefill"], _JIT_CACHE["decode"]
+
+
+class InferenceEngine:
+    """Continuous-batching generation over one model replica.
+
+    Defaults come from the ``DMLC_SERVE_*`` knobs (see README
+    "Serving") so ``bin/dmlc-serve`` and embedded uses read one
+    configuration surface.
+    """
+
+    def __init__(self, params, cfg: "tfm.TransformerConfig", *,
+                 mesh=None,
+                 n_blocks: Optional[int] = None,
+                 block_size: Optional[int] = None,
+                 max_active: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 admit_timeout_s: Optional[float] = None,
+                 max_new_tokens: Optional[int] = None,
+                 eos_id: Optional[int] = None):
+        self.params = params
+        self.cfg = cfg
+        self.mesh = mesh
+        self.max_active = (max_active if max_active is not None
+                           else get_env("DMLC_SERVE_MAX_ACTIVE", 8))
+        self.admit_timeout_s = (
+            admit_timeout_s if admit_timeout_s is not None
+            else get_env("DMLC_SERVE_ADMIT_TIMEOUT_S", 2.0))
+        self.default_max_new_tokens = (
+            max_new_tokens if max_new_tokens is not None
+            else get_env("DMLC_SERVE_MAX_TOKENS", 64))
+        self.eos_id = eos_id
+        self.cache = PagedKVCache(
+            cfg.n_layers, cfg.n_heads, cfg.head_dim,
+            n_blocks=(n_blocks if n_blocks is not None
+                      else get_env("DMLC_SERVE_KV_BLOCKS", 256)),
+            block_size=(block_size if block_size is not None
+                        else get_env("DMLC_SERVE_KV_BLOCK_SIZE", 16)),
+            dtype=np.dtype(cfg.dtype), mesh=mesh)
+        self.scheduler = ContinuousBatchScheduler(
+            self.cache, max_active=self.max_active)
+        depth = (queue_depth if queue_depth is not None
+                 else get_env("DMLC_SERVE_QUEUE_DEPTH", 64))
+        self._slots: BufferPool = BufferPool(object, capacity=depth)
+        self._prefill, self._decode = _jitted_programs()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._flops_declared = False
+
+    # ---- client surface -------------------------------------------------
+    def submit(self, prompt_ids: List[int],
+               max_new_tokens: Optional[int] = None,
+               timeout: Optional[float] = None) -> Request:
+        """Admit a request or raise: :class:`AdmissionFull` when no
+        queue slot frees up within ``timeout`` (default
+        ``admit_timeout_s``), ``ValueError`` when the request could
+        never be served (bad ids, context beyond total cache)."""
+        mnt = (max_new_tokens if max_new_tokens is not None
+               else self.default_max_new_tokens)
+        req = Request(prompt_ids, mnt, eos_id=self.eos_id)
+        if any(t < 0 or t >= self.cfg.vocab for t in req.prompt_ids):
+            raise ValueError(
+                f"prompt ids out of range for vocab {self.cfg.vocab}")
+        if not self.cache.fits_at_all(req.n_prompt + mnt):
+            raise RequestTooLarge(
+                f"request needs up to {req.n_prompt + mnt} cached tokens; "
+                f"cache holds {self.cache.n_blocks * self.cache.block_size}")
+        slot = self._slots.acquire(
+            timeout=self.admit_timeout_s if timeout is None else timeout)
+        if slot is None:
+            telemetry.inc("serving", "rejected")
+            raise AdmissionFull(
+                f"admission queue full (depth includes {self.max_active} "
+                f"active); retry later")
+        req.slot = slot
+        telemetry.inc("serving", "requests")
+        self.scheduler.enqueue(req)
+        if self._stop.is_set():
+            # close() can finish its sweep between our slot acquire and
+            # the enqueue above; nobody would ever fail this request,
+            # so do it here rather than hang the waiter
+            try:
+                self._finish(req, error="engine shut down")
+            except DMLCError:
+                pass
+            raise DMLCError("engine shut down")
+        return req
+
+    def generate(self, prompt_ids: List[int],
+                 max_new_tokens: Optional[int] = None,
+                 timeout: float = 120.0) -> List[int]:
+        """Blocking convenience: submit, wait, return generated ids."""
+        req = self.submit(prompt_ids, max_new_tokens)
+        if not req.wait(timeout):
+            raise DMLCError(f"request {req.id} timed out after {timeout}s")
+        if req.error:
+            raise DMLCError(f"request {req.id} failed: {req.error}")
+        return list(req.generated)
+
+    # ---- engine loop ----------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            if self._thread.is_alive():
+                return
+            raise DMLCError("engine thread wedged by a previous close(); "
+                            "build a fresh engine")
+        if self._stop.is_set():
+            raise DMLCError("engine is closed")
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="serving-engine")
+        self._thread.start()
+
+    def close(self) -> None:
+        """Stop the loop; fail whatever is still queued or active (their
+        waiters wake with an error) and wake blocked submitters."""
+        self._stop.set()
+        self._slots.kill()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=30.0)
+            if t.is_alive():
+                # a step is still running (giant jit compile, wedged
+                # device): sweeping now would race its cache writes —
+                # leave the daemon thread to die with the process and
+                # let per-request timeouts surface the failure
+                logger.error("engine thread still running after 30s; "
+                             "skipping the shutdown sweep")
+                return
+            self._thread = None
+        for req in self.scheduler.all_pending():
+            try:
+                self._finish(req, error="engine shut down")
+            except DMLCError:
+                pass  # racing terminal transition already happened
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                did = self.step()
+            except Exception as e:  # noqa: BLE001 - engine must not die
+                # a crashed decode leaves the ACTIVE set's cache state
+                # unknown, so those requests fail (waiters wake with
+                # the error) — but WAITING requests were never touched
+                # and the engine keeps serving them
+                for req in self.scheduler.active_requests():
+                    try:
+                        self._finish(
+                            req, error=f"engine iteration failed: {e!r}")
+                    except DMLCError:
+                        pass
+                logger.error("serving iteration failed: %r", e)
+                did = False
+            if not did:
+                time.sleep(0.002)  # idle: nothing waiting, nothing active
+
+    # ---- one iteration --------------------------------------------------
+    def step(self) -> bool:
+        """One continuous-batching iteration: at most one prefill, then
+        one decode token for every active request.  Returns whether any
+        work happened (the loop's idle signal).  Public so tests can
+        single-step the engine deterministically."""
+        did = False
+        req = self.scheduler.next_prefill()
+        if req is not None:
+            self._run_prefill(req)
+            did = True
+        active = self.scheduler.active_requests()
+        if active:
+            self._run_decode(active)
+            did = True
+        return did
+
+    def _finish(self, req: Request, error: Optional[str] = None) -> None:
+        self.scheduler.finish(req, error=error)
+        if req.latency_s is not None:
+            telemetry.observe_duration("serving", "latency", req.latency_s)
+        tps = req.decode_tokens_per_s
+        if tps is not None:
+            telemetry.set_gauge("serving", "tokens_per_s_per_user", tps)
+        slot, req.slot = req.slot, None
+        if slot is not None:
+            self._slots.release(slot)
+
+    def _run_prefill(self, req: Request) -> None:
+        """Prefill ``req``'s context and cache its K/V.  A fresh request
+        also samples its first token here (that IS the TTFT moment); a
+        preemption resume must NOT sample — its context already excludes
+        the un-consumed ``generated[-1]``, so the last-position logits
+        would deterministically re-derive that very token and duplicate
+        it in the output.  The resume's next token comes from the decode
+        step that consumes ``generated[-1]``."""
+        ctx = req.context_ids()
+        n = len(ctx)
+        bs = self.cache.block_size
+        if not self.cache.allocate(req.id, n):
+            # admission checked the free list, but a decode in the same
+            # iteration window can race it; retry next iteration
+            self.scheduler.requeue_front(req)
+            return
+        try:
+            padded = n + (-n % bs)
+            ids = np.zeros((1, padded), np.int32)
+            ids[0, :n] = ctx
+            t0 = time.perf_counter()
+            with telemetry.span("serving.prefill",
+                                stage="serving", args={"tokens": n}):
+                logits, k, v = self._prefill(
+                    self.params, ids, np.array([n - 1], np.int32),
+                    self.cfg)
+                logits = np.asarray(logits[0])
+                k = np.asarray(k)[:, 0, :n]
+                v = np.asarray(v)[:, 0, :n]
+            telemetry.observe_duration("serving", "prefill",
+                                       time.perf_counter() - t0)
+            telemetry.inc("serving", "prefill_tokens", n)
+            self.cache.write(req.id, k, v, start=0)
+        except Exception as e:  # noqa: BLE001 - fail THIS request only
+            logger.error("prefill of request %d failed: %r", req.id, e)
+            self._finish(req, error=f"prefill failed: {e!r}")
+            return
+        if not req.generated:
+            next_id = int(np.argmax(logits))
+            req.generated.append(next_id)
+            telemetry.inc("serving", "tokens_generated")
+            req.ttft_s = time.monotonic() - req.submit_t
+            telemetry.observe_duration("serving", "ttft", req.ttft_s)
+            if req.is_finished_by(next_id):
+                self._finish(req)
+                return
+        self.scheduler.activate(req)
+
+    def _ensure_decode_capacity(self,
+                                active: List[Request]) -> List[Request]:
+        """Reserve one more cache slot per active request, preempting
+        youngest-first under pressure; returns the surviving batch."""
+        alive = []
+        for req in active:
+            if req.state != ACTIVE:
+                continue  # a preemption below already took it out
+            while not self.cache.extend(req.id, 1):
+                victim = self.scheduler.preempt_youngest()
+                if victim is None:
+                    self._finish(req, error="kv cache exhausted with "
+                                 "nothing left to evict")
+                    break
+                if victim is req:
+                    break  # preempted itself; resumes via re-prefill
+            else:
+                alive.append(req)
+        # a LATER request's eviction can preempt an EARLIER survivor
+        # (activation order is not age order once resumes re-append):
+        # only still-active requests may decode
+        return [r for r in alive if r.state == ACTIVE]
+
+    def _run_decode(self, active: List[Request]) -> None:
+        active = self._ensure_decode_capacity(active)
+        if not active:
+            return
+        b = len(active)
+        pad_b = self.max_active
+        ids = np.zeros(pad_b, np.int32)
+        positions = np.zeros(pad_b, np.int32)
+        for i, req in enumerate(active):
+            ids[i] = req.generated[-1]
+            positions[i] = self.cache.length(req.id)
+        if not self._flops_declared:
+            # per-token FLOPs vary with context; declared once for the
+            # ledger's goodput math, exact FLOPs passed per step below
+            telemetry.declare_flops_per_token(
+                tfm.decode_flops_per_token(self.cfg, self.cache.block_size))
+            self._flops_declared = True
+        telemetry.step_begin()
+        k, v, lengths = self.cache.gather(
+            [r.id for r in active], pad_batch=pad_b)
+        k, v = self.cache.shard_gathered(k, v)
+        logits, k_new, v_new = self._decode(
+            self.params, ids, positions, k, v, lengths, self.cfg)
+        logits = np.asarray(logits)
+        k_new = np.asarray(k_new)
+        v_new = np.asarray(v_new)
+        flops = float(sum(
+            tfm.decode_flops_per_token(self.cfg, int(lengths[i]) + 1)
+            for i in range(b)))
+        telemetry.step_end(tokens=float(b), flops=flops)
+        telemetry.inc("serving", "decode_steps")
+        telemetry.observe("serving", "decode_batch", b)
+        for i, req in enumerate(active):
+            self.cache.append(req.id, k_new[:, i], v_new[:, i])
+            next_id = int(np.argmax(logits[i]))
+            req.generated.append(next_id)
+            telemetry.inc("serving", "tokens_generated")
+            if req.is_finished_by(next_id):
+                self._finish(req)
+
+    # ---- observability --------------------------------------------------
+    def stats(self) -> dict:
+        return {
+            "active": self.scheduler.n_active,
+            "waiting": self.scheduler.n_waiting,
+            "max_active": self.max_active,
+            "kv": self.cache.stats(),
+            "ledger": telemetry.ledger().summary(),
+        }

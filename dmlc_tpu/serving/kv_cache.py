@@ -1,0 +1,359 @@
+"""Paged KV cache: block-granular virtual memory for decode contexts.
+
+vLLM-style PagedAttention bookkeeping adapted to this substrate: the
+cache is a fixed pool of fixed-size blocks (``block_size`` tokens each)
+handed out by a free-list :class:`BlockAllocator`, and every sequence
+owns a *block table* mapping its logical token positions to physical
+blocks.  Continuous batching lives or dies on this layout — sequences
+of wildly different lengths share one arena with zero fragmentation
+beyond the final partial block, and a finished (or preempted) request
+returns its blocks to the free list for immediate reuse.
+
+Pool layout (layer-major, mirroring the paged-attention kernel shapes):
+
+    k_pool / v_pool : [n_layers, n_blocks, block_size, n_heads, head_dim]
+
+The model never sees pages: :meth:`PagedKVCache.gather` materializes a
+dense padded ``[L, B, T, H, D]`` view for a decode batch (whole blocks
+are copied; slots past a sequence's length carry garbage the attention
+mask ignores), and :meth:`shard_gathered` places that view over a
+``parallel.mesh`` — batch over ``dp``, heads over ``tp`` — so the
+decode matmuls run sharded under jit.  Prefill attention goes through
+the model layer's existing dispatch (Pallas flash on TPU, the
+materialized oracle elsewhere); an sp-sharded ring/Ulysses prefill for
+very long prompts is future work — the cache is layout-ready for it
+(it only ever stores the resulting per-layer K/V).
+
+Thread-safety: all bookkeeping is lock-protected, but the data plane
+(write/gather) assumes the engine's single step thread — the same
+contract as the training feed.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import DMLCError
+from .. import telemetry
+
+__all__ = ["BlockAllocator", "PagedKVCache", "kv_partition_spec"]
+
+
+class BlockAllocator:
+    """Free-list allocator over ``n_blocks`` fixed-size blocks.
+
+    All-or-nothing ``alloc_many`` keeps admission atomic: a request
+    either gets its whole reservation or leaves the free list untouched
+    (no partial grabs to roll back under concurrent admits).  Double
+    free raises — an aliased block silently corrupting another
+    sequence's context is the worst failure mode a KV cache has.
+    """
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 1:
+            raise ValueError(f"n_blocks must be >= 1, got {n_blocks}")
+        self.n_blocks = int(n_blocks)
+        # pop() from the tail → ascending ids first; order is cosmetic
+        self._free: List[int] = list(range(self.n_blocks - 1, -1, -1))
+        self._in_use: set = set()
+        self._lock = threading.Lock()
+
+    @property
+    def n_free(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def n_in_use(self) -> int:
+        with self._lock:
+            return len(self._in_use)
+
+    def alloc(self) -> Optional[int]:
+        got = self.alloc_many(1)
+        return got[0] if got else None
+
+    def alloc_many(self, n: int) -> Optional[List[int]]:
+        """``n`` block ids, or None (and no state change) if fewer than
+        ``n`` are free."""
+        if n < 0:
+            raise ValueError(f"cannot allocate {n} blocks")
+        with self._lock:
+            if n > len(self._free):
+                return None
+            got = [self._free.pop() for _ in range(n)]
+            self._in_use.update(got)
+            return got
+
+    def free(self, blocks: Sequence[int]) -> None:
+        """All-or-nothing like ``alloc_many``: the whole list is
+        validated before any block moves, so a bad id raises with the
+        allocator unchanged (a partial free would desync the caller's
+        block table from ``in_use``)."""
+        blocks = list(blocks)
+        with self._lock:
+            bad = [b for b in blocks if b not in self._in_use]
+            if bad:
+                raise DMLCError(
+                    f"double free / foreign blocks {bad} "
+                    f"(in_use={len(self._in_use)})")
+            for b in blocks:
+                self._in_use.discard(b)
+                self._free.append(b)
+
+
+class _SeqEntry:
+    __slots__ = ("blocks", "length")
+
+    def __init__(self) -> None:
+        self.blocks: List[int] = []
+        self.length = 0
+
+
+def kv_partition_spec(mesh) -> Optional[tuple]:
+    """PartitionSpec for a gathered ``[L, B, T, H, D]`` view over
+    ``mesh``: batch over dp, heads over tp, everything else replicated.
+    None when the mesh offers no divisible sharding (single device)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import AXIS_DP, AXIS_TP
+
+    dp = mesh.shape.get(AXIS_DP, 1)
+    tp = mesh.shape.get(AXIS_TP, 1)
+    if dp <= 1 and tp <= 1:
+        return None
+    return P(None, AXIS_DP if dp > 1 else None, None,
+             AXIS_TP if tp > 1 else None, None)
+
+
+class PagedKVCache:
+    """Block-paged K/V storage for a set of live sequences.
+
+    ``n_layers/n_heads/head_dim`` come from the model config;
+    ``n_blocks × block_size`` is the total token capacity shared by all
+    concurrent requests.  ``mesh`` (optional) enables
+    :meth:`shard_gathered` device placement.
+    """
+
+    def __init__(self, n_layers: int, n_heads: int, head_dim: int, *,
+                 n_blocks: int = 256, block_size: int = 16,
+                 dtype=np.float32, mesh=None):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.n_layers = int(n_layers)
+        self.n_heads = int(n_heads)
+        self.head_dim = int(head_dim)
+        self.n_blocks = int(n_blocks)
+        self.block_size = int(block_size)
+        self.mesh = mesh
+        shape = (self.n_layers, self.n_blocks, self.block_size,
+                 self.n_heads, self.head_dim)
+        self.k_pool = np.zeros(shape, dtype)
+        self.v_pool = np.zeros(shape, dtype)
+        self._alloc = BlockAllocator(self.n_blocks)
+        self._seqs: Dict[int, _SeqEntry] = {}
+        self._lock = threading.Lock()
+        telemetry.set_gauge("serving", "kv_blocks_total", self.n_blocks)
+        self._publish_usage()
+
+    # ---- capacity arithmetic -------------------------------------------
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks needed to hold ``n_tokens`` (ceil; 0 tokens → 0)."""
+        return -(-max(int(n_tokens), 0) // self.block_size)
+
+    @property
+    def n_free_blocks(self) -> int:
+        return self._alloc.n_free
+
+    @property
+    def n_blocks_in_use(self) -> int:
+        return self._alloc.n_in_use
+
+    def can_reserve(self, n_tokens: int) -> bool:
+        return self.blocks_for(n_tokens) <= self._alloc.n_free
+
+    def fits_at_all(self, n_tokens: int) -> bool:
+        """Whether ``n_tokens`` could EVER be cached, even with the
+        whole pool free — the admission-time sanity bound."""
+        return self.blocks_for(n_tokens) <= self.n_blocks
+
+    # ---- sequence lifecycle --------------------------------------------
+    def allocate(self, seq_id: int, n_tokens: int) -> bool:
+        """Register ``seq_id`` with capacity for ``n_tokens``; False
+        (and no state change) when the free list cannot cover it."""
+        with self._lock:
+            if seq_id in self._seqs:
+                raise DMLCError(f"sequence {seq_id} already allocated")
+            got = self._alloc.alloc_many(self.blocks_for(n_tokens))
+            if got is None:
+                telemetry.inc("serving", "kv_alloc_failures")
+                return False
+            ent = _SeqEntry()
+            ent.blocks = got
+            self._seqs[seq_id] = ent
+        self._publish_usage()
+        return True
+
+    def extend(self, seq_id: int, n_tokens: int = 1) -> bool:
+        """Ensure capacity for ``n_tokens`` more tokens; False when the
+        pool is exhausted (caller evicts and retries)."""
+        with self._lock:
+            ent = self._seq(seq_id)
+            need = self.blocks_for(ent.length + n_tokens) - len(ent.blocks)
+            if need <= 0:
+                return True
+            got = self._alloc.alloc_many(need)
+            if got is None:
+                telemetry.inc("serving", "kv_alloc_failures")
+                return False
+            ent.blocks.extend(got)
+        self._publish_usage()
+        return True
+
+    def free(self, seq_id: int) -> None:
+        """Return the sequence's blocks to the free list (idempotent:
+        freeing an unknown seq is a no-op so finish/preempt paths never
+        double-free)."""
+        with self._lock:
+            ent = self._seqs.pop(seq_id, None)
+            if ent is None:
+                return
+            self._alloc.free(ent.blocks)
+        self._publish_usage()
+
+    def length(self, seq_id: int) -> int:
+        with self._lock:
+            return self._seq(seq_id).length
+
+    def block_table(self, seq_id: int) -> List[int]:
+        with self._lock:
+            return list(self._seq(seq_id).blocks)
+
+    def live_sequences(self) -> List[int]:
+        with self._lock:
+            return list(self._seqs)
+
+    def _seq(self, seq_id: int) -> _SeqEntry:
+        ent = self._seqs.get(seq_id)
+        if ent is None:
+            raise DMLCError(f"unknown sequence {seq_id}")
+        return ent
+
+    # ---- data plane -----------------------------------------------------
+    def write(self, seq_id: int, k, v, start: Optional[int] = None) -> None:
+        """Write ``k/v [L, T, H, D]`` at token offset ``start`` (default:
+        the current length — append semantics).  Capacity must already
+        be reserved (allocate/extend); writing past it raises rather
+        than silently growing, keeping the eviction policy in the
+        scheduler where it belongs."""
+        k = np.asarray(k)
+        v = np.asarray(v)
+        t = k.shape[1]
+        with self._lock:
+            ent = self._seq(seq_id)
+            pos = ent.length if start is None else int(start)
+            end = pos + t
+            if self.blocks_for(end) > len(ent.blocks):
+                raise DMLCError(
+                    f"write past reservation: seq {seq_id} end={end} "
+                    f"blocks={len(ent.blocks)}×{self.block_size}")
+            blocks = list(ent.blocks)
+            ent.length = max(ent.length, end)
+        bs = self.block_size
+        off = 0
+        while off < t:
+            p = pos + off
+            blk = blocks[p // bs]
+            slot = p % bs
+            n = min(bs - slot, t - off)
+            self.k_pool[:, blk, slot:slot + n] = k[:, off:off + n]
+            self.v_pool[:, blk, slot:slot + n] = v[:, off:off + n]
+            off += n
+
+    def append(self, seq_id: int, k, v) -> None:
+        """Append ONE token's ``k/v [L, H, D]`` (the per-decode-step
+        write path)."""
+        self.write(seq_id, np.asarray(k)[:, None], np.asarray(v)[:, None])
+
+    def gather(self, seq_ids: Sequence[int], *, pad_len: Optional[int] = None,
+               pad_batch: Optional[int] = None
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Dense padded view for a decode batch.
+
+        Returns ``(k, v, lengths)`` with k/v ``[L, B, T, H, D]`` and
+        lengths ``[B] int32``; ``T`` = ``pad_len`` or the max sequence
+        length rounded up to a whole block, ``B`` = ``pad_batch`` or
+        ``len(seq_ids)`` (extra rows are zero with length 0 — dead rows
+        the decode mask ignores, used to pin the jit batch shape).
+        Whole blocks are copied, so slots in [length, T) are garbage by
+        contract."""
+        with self._lock:
+            ents = [self._seq(s) for s in seq_ids]
+            tables = [list(e.blocks) for e in ents]
+            lens = [e.length for e in ents]
+        bs = self.block_size
+        max_len = max(lens, default=0)
+        need = max(self.blocks_for(max_len) * bs, bs)
+        if pad_len is not None:
+            # an explicit pad_len pins the jit shape; widening it
+            # silently would defeat that, so insufficiency is loud
+            if pad_len % bs:
+                raise ValueError(f"pad_len {pad_len} not a multiple of "
+                                 f"block_size {bs}")
+            if pad_len < need:
+                raise ValueError(f"pad_len {pad_len} < required {need}")
+            t = pad_len
+        else:
+            t = need
+        b = max(pad_batch or 0, len(seq_ids))
+        shape = (self.n_layers, b, t, self.n_heads, self.head_dim)
+        k_out = np.zeros(shape, self.k_pool.dtype)
+        v_out = np.zeros(shape, self.v_pool.dtype)
+        for i, (table, n) in enumerate(zip(tables, lens)):
+            for j in range(self.blocks_for(n)):
+                blk = table[j]
+                k_out[:, i, j * bs:(j + 1) * bs] = self.k_pool[:, blk]
+                v_out[:, i, j * bs:(j + 1) * bs] = self.v_pool[:, blk]
+        lengths = np.zeros(b, np.int32)
+        lengths[:len(lens)] = lens
+        return k_out, v_out, lengths
+
+    def shard_gathered(self, k: np.ndarray, v: np.ndarray):
+        """Place a gathered view over the mesh (batch→dp, heads→tp) so
+        decode runs as a sharded jit program.  Falls back to plain
+        host→default-device arrays when no mesh was given or the shapes
+        do not divide the axes."""
+        if self.mesh is None:
+            return k, v
+        import jax
+
+        spec = kv_partition_spec(self.mesh)
+        if spec is None:
+            return k, v
+        from ..parallel.mesh import AXIS_DP, AXIS_TP
+
+        if (k.shape[1] % max(self.mesh.shape.get(AXIS_DP, 1), 1)
+                or k.shape[3] % max(self.mesh.shape.get(AXIS_TP, 1), 1)):
+            return k, v
+        sh = jax.sharding.NamedSharding(self.mesh, spec)
+        return jax.device_put(k, sh), jax.device_put(v, sh)
+
+    # ---- observability --------------------------------------------------
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            live = len(self._seqs)
+            tokens = sum(e.length for e in self._seqs.values())
+        return {
+            "n_blocks": self.n_blocks,
+            "block_size": self.block_size,
+            "blocks_in_use": self._alloc.n_in_use,
+            "blocks_free": self._alloc.n_free,
+            "live_sequences": live,
+            "cached_tokens": tokens,
+        }
+
+    def _publish_usage(self) -> None:
+        telemetry.set_gauge("serving", "kv_blocks_in_use",
+                            self._alloc.n_in_use)

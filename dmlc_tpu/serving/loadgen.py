@@ -1,0 +1,164 @@
+"""Load generator: N concurrent request streams + the BENCH artifact.
+
+Each stream is a closed-loop synthetic user: it POSTs a random-length
+prompt to ``/generate``, waits for the completion document, and
+immediately issues the next request.  429s back off and retry (they
+are the admission queue working as designed, counted but not failed).
+The summary aggregates the *server-reported* per-request timings —
+TTFT is measured where it is defined (submit → first token inside the
+engine), not smeared by client-side HTTP overhead — and joins them
+with the engine's own ledger view scraped from ``/healthz``, so the
+emitted ``BENCH_serving.json`` carries p50/p99 TTFT, per-user decode
+tokens/s, and decode-step MFU from one run.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+__all__ = ["LoadGenerator", "percentile"]
+
+
+def percentile(values: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (same convention as StepLedger.summary)."""
+    if not values:
+        return None
+    vs = sorted(values)
+    return vs[min(int(q / 100.0 * len(vs)), len(vs) - 1)]
+
+
+class LoadGenerator:
+    """Drive ``n_streams`` concurrent users against a serving endpoint."""
+
+    def __init__(self, url: str, *, n_streams: int = 8,
+                 requests_per_stream: int = 4,
+                 prompt_len: tuple = (8, 24), max_tokens: int = 16,
+                 vocab: int = 128, seed: int = 0,
+                 retry_429_s: float = 0.2, max_retries: int = 50):
+        self.url = url.rstrip("/")
+        self.n_streams = int(n_streams)
+        self.requests_per_stream = int(requests_per_stream)
+        self.prompt_len = (int(prompt_len[0]), int(prompt_len[1]))
+        self.max_tokens = int(max_tokens)
+        self.vocab = int(vocab)
+        self.seed = int(seed)
+        self.retry_429_s = float(retry_429_s)
+        self.max_retries = int(max_retries)
+        self.results: List[Dict] = []
+        self.failures: List[Dict] = []
+        self.rejections = 0
+        self._lock = threading.Lock()
+
+    # ---- one synthetic user --------------------------------------------
+    def _post(self, doc: Dict) -> Dict:
+        body = json.dumps(doc).encode()
+        req = urllib.request.Request(
+            self.url + "/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return json.loads(resp.read())
+
+    def _stream(self, sid: int) -> None:
+        rng = random.Random(self.seed * 1000 + sid)
+        for _ in range(self.requests_per_stream):
+            n = rng.randint(*self.prompt_len)
+            doc = {"prompt": [rng.randrange(self.vocab) for _ in range(n)],
+                   "max_tokens": self.max_tokens}
+            t0 = time.monotonic()
+            out = None
+            for _attempt in range(self.max_retries):
+                try:
+                    out = self._post(doc)
+                    break
+                except urllib.error.HTTPError as e:
+                    if e.code == 429:
+                        with self._lock:
+                            self.rejections += 1
+                        time.sleep(self.retry_429_s)
+                        continue
+                    out = {"error": f"HTTP {e.code}: "
+                           f"{e.read()[:200].decode(errors='replace')}"}
+                    break
+                except (urllib.error.URLError, OSError) as e:
+                    # a dead server / timed-out connection is a FAILED
+                    # request, not a silently vanished stream
+                    out = {"error": f"connection failed: {e!r}"}
+                    break
+            if out is None:
+                out = {"error": "429 retry budget exhausted"}
+            out["stream"] = sid
+            out["client_latency_s"] = time.monotonic() - t0
+            with self._lock:
+                if out.get("error"):
+                    self.failures.append(out)
+                else:
+                    self.results.append(out)
+
+    # ---- the run --------------------------------------------------------
+    def run(self) -> Dict:
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=self._stream, args=(i,),
+                                    name=f"loadgen-{i}", daemon=True)
+                   for i in range(self.n_streams)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        return self.summary(wall)
+
+    def summary(self, wall_s: float) -> Dict:
+        ttfts = [r["ttft_s"] for r in self.results
+                 if r.get("ttft_s") is not None]
+        tps = [r["decode_tokens_per_s"] for r in self.results
+               if r.get("decode_tokens_per_s")]
+        gen = sum(r.get("n_generated", 0) for r in self.results)
+        out = {
+            "n_streams": self.n_streams,
+            "n_requests_ok": len(self.results),
+            "n_requests_failed": len(self.failures),
+            "n_rejections_429": self.rejections,
+            "wall_s": wall_s,
+            "total_generated_tokens": gen,
+            "aggregate_tokens_per_s": gen / max(wall_s, 1e-9),
+            "p50_ttft_s": percentile(ttfts, 50),
+            "p99_ttft_s": percentile(ttfts, 99),
+            "tokens_per_s_per_user": (sum(tps) / len(tps)) if tps else None,
+            "p50_latency_s": percentile(
+                [r["latency_s"] for r in self.results
+                 if r.get("latency_s") is not None], 50),
+            "preemptions": sum(r.get("preemptions", 0)
+                               for r in self.results),
+        }
+        return out
+
+    # ---- artifact -------------------------------------------------------
+    def healthz(self) -> Dict:
+        with urllib.request.urlopen(self.url + "/healthz",
+                                    timeout=30) as resp:
+            return json.loads(resp.read())
+
+    def emit_bench(self, path: str, summary: Dict,
+                   extra: Optional[Dict] = None) -> Dict:
+        """Join the client summary with the engine ledger (/healthz) and
+        write the one-line BENCH_serving.json artifact."""
+        ledger = self.healthz().get("ledger", {}) or {}
+        doc = dict(summary)
+        doc["decode_mfu"] = ledger.get("mfu")
+        doc["decode_step_p50_s"] = ledger.get("step_time_p50")
+        doc["decode_step_p99_s"] = ledger.get("step_time_p99")
+        doc["decode_goodput_tokens_per_s"] = ledger.get(
+            "goodput_tokens_per_s")
+        doc["decode_steps"] = ledger.get("steps")
+        if extra:
+            doc.update(extra)
+        with open(path, "w") as f:
+            json.dump(doc, f)
+            f.write("\n")
+        return doc

@@ -1,0 +1,255 @@
+"""Continuous-batching scheduler: iteration-level admit / evict.
+
+Orca-style scheduling over the paged cache (serving.kv_cache): the unit
+of scheduling is one engine *iteration*, not one request.  Every
+iteration the engine (a) admits at most one waiting request whose
+context fits the free list — its prefill runs this iteration and it
+joins the decode batch the next — and (b) decodes every active request
+one token.  Requests therefore enter and leave the batch mid-flight;
+a long generation never convoys short ones behind it.
+
+Memory pressure is resolved by *preemption with recompute* (the vLLM
+trade): when a decode step cannot extend some sequence's cache, the
+youngest active request is evicted — its blocks return to the free
+list and the request re-enters the FRONT of the wait queue carrying
+the tokens it already generated, so its eventual re-prefill recomputes
+prompt+generated in one pass and generation resumes where it stopped.
+Youngest-first eviction minimizes wasted recompute and cannot starve:
+the oldest request only ever gains blocks.
+
+The scheduler is pure policy + bookkeeping (no jax): the engine owns
+the compute.  All methods are lock-protected; the engine's single step
+thread is the only caller of the mutating paths, but /healthz and the
+admission path read concurrently.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from .. import telemetry
+from ..base import DMLCError
+from .kv_cache import PagedKVCache
+
+__all__ = ["Request", "ContinuousBatchScheduler",
+           "WAITING", "ACTIVE", "DONE", "FAILED"]
+
+WAITING = "waiting"
+ACTIVE = "active"
+DONE = "done"
+FAILED = "failed"
+
+_req_ids = itertools.count(1)
+
+
+class Request:
+    """One generation request's lifetime record.
+
+    ``generated`` persists across preemptions (the output so far is
+    never discarded — only its cached K/V is, and the re-prefill
+    recomputes that from ``context_ids()``).  ``wait()`` is the client
+    blocking primitive; the engine signals completion exactly once.
+    """
+
+    def __init__(self, prompt_ids: List[int], max_new_tokens: int,
+                 eos_id: Optional[int] = None):
+        if not prompt_ids:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, "
+                             f"got {max_new_tokens}")
+        self.id = next(_req_ids)
+        self.prompt_ids = [int(t) for t in prompt_ids]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.submit_t = time.monotonic()
+        self.state = WAITING
+        self.generated: List[int] = []
+        self.ttft_s: Optional[float] = None
+        self.finish_t: Optional[float] = None
+        self.error: Optional[str] = None
+        self.preemptions = 0
+        self.slot = None  # admission token (engine's BufferPool buffer)
+        self._done = threading.Event()
+
+    # ---- views ----------------------------------------------------------
+    @property
+    def n_prompt(self) -> int:
+        return len(self.prompt_ids)
+
+    @property
+    def n_generated(self) -> int:
+        return len(self.generated)
+
+    def context_ids(self) -> List[int]:
+        """Tokens a (re-)prefill must consume: prompt plus everything
+        generated before a preemption, minus the last generated token —
+        that one has not been consumed yet (it is the next decode
+        input), so caching its K/V would double-count it."""
+        if self.generated:
+            return self.prompt_ids + self.generated[:-1]
+        return list(self.prompt_ids)
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        if self.finish_t is None:
+            return None
+        return self.finish_t - self.submit_t
+
+    @property
+    def decode_tokens_per_s(self) -> Optional[float]:
+        """Per-user decode throughput: generated tokens over the time
+        AFTER the first token (the steady-state rate a streaming user
+        experiences; None until finished or when only one token)."""
+        if self.finish_t is None or self.ttft_s is None:
+            return None
+        decode_s = (self.finish_t - self.submit_t) - self.ttft_s
+        if self.n_generated <= 1 or decode_s <= 0:
+            return None
+        return (self.n_generated - 1) / decode_s
+
+    def is_finished_by(self, token: int) -> bool:
+        return (self.n_generated >= self.max_new_tokens
+                or (self.eos_id is not None and token == self.eos_id))
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the request completes (True) or times out."""
+        return self._done.wait(timeout)
+
+    def result(self) -> Dict:
+        """JSON-able completion document (the server's response body)."""
+        return {
+            "id": self.id,
+            "state": self.state,
+            "error": self.error,
+            "n_prompt": self.n_prompt,
+            "n_generated": self.n_generated,
+            "output_ids": list(self.generated),
+            "ttft_s": self.ttft_s,
+            "latency_s": self.latency_s,
+            "decode_tokens_per_s": self.decode_tokens_per_s,
+            "preemptions": self.preemptions,
+        }
+
+
+class ContinuousBatchScheduler:
+    """Admission queue + active set over a shared :class:`PagedKVCache`."""
+
+    def __init__(self, cache: PagedKVCache, max_active: int = 8):
+        if max_active < 1:
+            raise ValueError(f"max_active must be >= 1, got {max_active}")
+        self.cache = cache
+        self.max_active = int(max_active)
+        self._waiting: deque = deque()
+        self._active: List[Request] = []
+        self._lock = threading.Lock()
+
+    # ---- queue views ----------------------------------------------------
+    @property
+    def n_waiting(self) -> int:
+        with self._lock:
+            return len(self._waiting)
+
+    @property
+    def n_active(self) -> int:
+        with self._lock:
+            return len(self._active)
+
+    def active_requests(self) -> List[Request]:
+        with self._lock:
+            return list(self._active)
+
+    # ---- admission ------------------------------------------------------
+    def enqueue(self, req: Request) -> None:
+        with self._lock:
+            req.state = WAITING
+            self._waiting.append(req)
+            telemetry.set_gauge("serving", "queue_depth",
+                                len(self._waiting))
+
+    def next_prefill(self) -> Optional[Request]:
+        """Pop the next admissible request: there is an active slot and
+        the free list covers its context plus one decode slot (the
+        iteration-level admission test — checked against the cache NOW,
+        so a freed block is reusable on the very next iteration)."""
+        with self._lock:
+            if len(self._active) >= self.max_active or not self._waiting:
+                return None
+            req = self._waiting[0]
+            if not self.cache.can_reserve(len(req.context_ids()) + 1):
+                return None
+            self._waiting.popleft()
+            telemetry.set_gauge("serving", "queue_depth",
+                                len(self._waiting))
+            return req
+
+    def requeue_front(self, req: Request) -> None:
+        """Put a popped-but-not-started request back at the head (the
+        admission check raced a same-iteration cache change)."""
+        with self._lock:
+            req.state = WAITING
+            self._waiting.appendleft(req)
+            telemetry.set_gauge("serving", "queue_depth",
+                                len(self._waiting))
+
+    def all_pending(self) -> List[Request]:
+        """Every request not yet in a terminal state (shutdown sweep)."""
+        with self._lock:
+            return list(self._active) + list(self._waiting)
+
+    def activate(self, req: Request) -> None:
+        with self._lock:
+            req.state = ACTIVE
+            self._active.append(req)
+            telemetry.set_gauge("serving", "active_requests",
+                                len(self._active))
+
+    # ---- eviction -------------------------------------------------------
+    def preempt_youngest(self) -> Optional[Request]:
+        """Evict the youngest active request (free its blocks, requeue
+        it at the FRONT of the wait queue for prompt resumption).
+        Returns it, or None when nothing is active to evict."""
+        with self._lock:
+            if not self._active:
+                return None
+            req = max(self._active, key=lambda r: (r.submit_t, r.id))
+            self._active.remove(req)
+            req.state = WAITING
+            req.preemptions += 1
+            self._waiting.appendleft(req)
+            telemetry.set_gauge("serving", "active_requests",
+                                len(self._active))
+            telemetry.set_gauge("serving", "queue_depth",
+                                len(self._waiting))
+        self.cache.free(req.id)
+        telemetry.inc("serving", "preemptions")
+        return req
+
+    # ---- completion -----------------------------------------------------
+    def finish(self, req: Request, error: Optional[str] = None) -> None:
+        """Terminal transition (exactly once per request): release the
+        request's cache blocks, mark DONE/FAILED, and wake waiters."""
+        with self._lock:
+            if req.state in (DONE, FAILED):
+                raise DMLCError(f"request {req.id} finished twice")
+            if req in self._active:
+                self._active.remove(req)
+            elif req in self._waiting:
+                self._waiting.remove(req)
+            req.state = FAILED if error else DONE
+            req.error = error
+            req.finish_t = time.monotonic()
+            telemetry.set_gauge("serving", "active_requests",
+                                len(self._active))
+            telemetry.set_gauge("serving", "queue_depth",
+                                len(self._waiting))
+        self.cache.free(req.id)
+        if error:
+            telemetry.inc("serving", "failed")
+        else:
+            telemetry.inc("serving", "completed")
+        req._done.set()

@@ -1,0 +1,142 @@
+"""Serving HTTP endpoint: POST /generate + the telemetry surface.
+
+The same lightweight pattern as ``telemetry.TelemetryHTTPServer`` (a
+``ThreadingHTTPServer`` with daemon handler threads), extended with a
+request body: each handler thread submits into the engine's bounded
+admission queue and parks on the request until the continuous batcher
+finishes it — so the HTTP concurrency model is "one cheap parked
+thread per in-flight request" and the *engine* decides the actual
+batch, which is the whole point of iteration-level scheduling.
+
+Backpressure is explicit at the edge: when no admission slot frees
+within the engine's timeout the client gets **429** with Retry-After,
+not a silently growing queue.  Malformed bodies get 400; a request the
+cache could never hold gets 413; an engine-side failure gets 503.
+
+Endpoints:
+  POST /generate   {"prompt": [int, ...], "max_tokens": int?}
+                   → request result document (scheduler.Request.result)
+  GET  /metrics    local Prometheus exposition (serving + step-ledger
+                   families ride the existing exporter)
+  GET  /healthz    engine stats: queues, KV pool, ledger summary
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .. import telemetry
+from .engine import AdmissionFull, InferenceEngine, RequestTooLarge
+
+__all__ = ["ServingHTTPServer"]
+
+logger = logging.getLogger("dmlc_tpu.serving")
+
+MAX_BODY_BYTES = 1 << 20  # a prompt is ids, not a payload dump
+
+
+class ServingHTTPServer:
+    """HTTP front end over an :class:`InferenceEngine`."""
+
+    def __init__(self, engine: InferenceEngine, host: str = "127.0.0.1",
+                 port: int = 0, request_timeout_s: float = 300.0):
+        eng = engine
+        wait_s = float(request_timeout_s)
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, ctype: str, body: bytes,
+                      extra_headers=None) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json(self, code: int, doc, extra_headers=None) -> None:
+                self._send(code, "application/json",
+                           json.dumps(doc).encode(),
+                           extra_headers=extra_headers)
+
+            def do_GET(self):  # noqa: N802 - http.server API
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    self._send(200,
+                               "text/plain; version=0.0.4; charset=utf-8",
+                               telemetry.to_prometheus_text().encode())
+                elif path == "/healthz":
+                    self._send_json(200, {"status": "ok", **eng.stats()})
+                else:
+                    self._send(404, "text/plain", b"not found\n")
+
+            def do_POST(self):  # noqa: N802 - http.server API
+                path = self.path.split("?", 1)[0]
+                if path != "/generate":
+                    self._send(404, "text/plain", b"not found\n")
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    if n > MAX_BODY_BYTES:
+                        self._send_json(413, {"error": "body too large"})
+                        return
+                    doc = json.loads(self.rfile.read(n) or b"{}")
+                    prompt = doc["prompt"]
+                    if (not isinstance(prompt, list)
+                            or not all(isinstance(t, int) for t in prompt)):
+                        raise ValueError("prompt must be a list of ints")
+                    max_tokens = doc.get("max_tokens")
+                    if max_tokens is not None:
+                        max_tokens = int(max_tokens)
+                except (KeyError, ValueError, TypeError,
+                        json.JSONDecodeError) as e:
+                    self._send_json(400, {"error": f"bad request: {e}"})
+                    return
+                try:
+                    req = eng.submit(prompt, max_new_tokens=max_tokens)
+                except AdmissionFull as e:
+                    self._send_json(429, {"error": str(e)},
+                                    extra_headers={"Retry-After": "1"})
+                    return
+                except RequestTooLarge as e:
+                    self._send_json(413, {"error": str(e)})
+                    return
+                except ValueError as e:
+                    # content errors (out-of-vocab ids, bad bounds) are
+                    # the client's 400, not a size problem
+                    self._send_json(400, {"error": str(e)})
+                    return
+                if not req.wait(wait_s):
+                    self._send_json(503, {"error": "generation timed out",
+                                          "id": req.id})
+                    return
+                doc = req.result()
+                if req.error:
+                    self._send_json(503, doc)
+                else:
+                    self._send_json(200, doc)
+
+            def log_message(self, fmt, *args):
+                logger.debug("serving http: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self.engine = engine
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="serving-http")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)

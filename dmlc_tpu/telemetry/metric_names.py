@@ -101,6 +101,25 @@ METRIC_NAMES = frozenset({
     "dmlc_ring_attention_bytes_rotated",
     "dmlc_ring_attention_calls",
     "dmlc_ring_attention_kv_block_bytes",
+    # serving plane (dmlc_tpu/serving)
+    "dmlc_serving_active_requests",
+    "dmlc_serving_completed",
+    "dmlc_serving_decode_batch",
+    "dmlc_serving_decode_steps",
+    "dmlc_serving_failed",
+    "dmlc_serving_kv_alloc_failures",
+    "dmlc_serving_kv_blocks_in_use",
+    "dmlc_serving_kv_blocks_total",
+    "dmlc_serving_latency_secs",
+    "dmlc_serving_preemptions",
+    "dmlc_serving_prefill_secs",
+    "dmlc_serving_prefill_tokens",
+    "dmlc_serving_queue_depth",
+    "dmlc_serving_rejected",
+    "dmlc_serving_requests",
+    "dmlc_serving_tokens_generated",
+    "dmlc_serving_tokens_per_s_per_user",
+    "dmlc_serving_ttft_secs",
     # step ledger
     "dmlc_step_collective_secs",
     "dmlc_step_compute_secs",
@@ -141,6 +160,8 @@ NON_METRIC_TOKENS = frozenset({
     "dmlc_top",
     "dmlc_tracker",       # reference repo path tracker/dmlc_tracker/…
     "dmlc_anomaly",       # prose prefix for the dmlc_anomaly_* family
+    "dmlc_serving",       # prose prefix for the dmlc_serving_* family
+    "dmlc_serve",         # bin/dmlc-serve launcher name in prose
     "dmlc_recordio_spans",  # native ABI symbol (dmlc_native.cc)
     "dmlc_pack_spans",      # native ABI symbol
     "dmlc_comm_allreduce",  # native collective ABI symbol

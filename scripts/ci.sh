@@ -34,6 +34,12 @@
 #      overlapped DeviceFeed pipeline, and the chunked ring allreduce
 #      beating the binomial tree on busbw at a bandwidth-dominated
 #      payload under the real local launcher
+#   9. serving smoke: continuous-batching inference server end to end —
+#      8 concurrent HTTP streams through the bounded admission queue,
+#      prefill/decode over the paged KV cache, p99 TTFT bound and
+#      nonzero per-user tokens/s asserted, /metrics scraped for the
+#      dmlc_serving_* + step-ledger families, BENCH_serving.json
+#      emitted with p50/p99 TTFT, tokens/s/user, and decode MFU
 #
 # Usage: scripts/ci.sh [pytest-args...]
 set -u
@@ -149,5 +155,9 @@ echo "== stage 8: perf smoke (feed shipped-efficiency + ring vs tree) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/perf_smoke.py \
     || { echo "FAIL: perf smoke"; exit 1; }
 
+echo "== stage 9: serving smoke (continuous batching + paged KV) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/serving_smoke.py \
+    || { echo "FAIL: serving smoke"; exit 1; }
+
 echo "== CI OK (native=$NATIVE_OK tsan=$TSAN_OK asan=$ASAN_OK" \
-     "telemetry=1 chaos=1 perf=1) =="
+     "telemetry=1 chaos=1 perf=1 serving=1) =="

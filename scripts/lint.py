@@ -32,7 +32,8 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_ROOTS = ["dmlc_tpu", "tests", "scripts", "examples", "bench.py",
-                 "__graft_entry__.py", "bin/dmlc-submit", "bin/dmlc-top"]
+                 "__graft_entry__.py", "bin/dmlc-submit", "bin/dmlc-top",
+                 "bin/dmlc-serve"]
 MAX_COLS = 100
 
 # roots whose telemetry call sites define REAL metric families; tests

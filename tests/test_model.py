@@ -222,3 +222,83 @@ def test_unknown_remat_policy_rejected():
     ids, labels = _data(jax.random.PRNGKey(6))
     with pytest.raises(ValueError, match="remat_policy"):
         unsharded_loss(params, ids, labels, cfg)
+
+
+def test_prefill_matches_training_forward():
+    """Serving prefill is the same math as the training forward: the
+    cross entropy of its logits equals unsharded_loss, and right-padding
+    must not perturb positions before the true length (causality)."""
+    from dmlc_tpu.models import forward_prefill
+    from dmlc_tpu.ops.core import ShardAxes, softmax_xent
+
+    params = init_params(jax.random.PRNGKey(0), CFG, n_stages=2)
+    ids, labels = _data(jax.random.PRNGKey(3), b=2, t=12)
+    want = float(unsharded_loss(params, ids, labels, CFG))
+    logits, k, v = forward_prefill(params, ids, CFG)
+    got = float(jnp.mean(softmax_xent(logits, labels, ShardAxes())))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    assert k.shape == (CFG.n_layers, 2, 12, CFG.n_heads, CFG.head_dim)
+    # pad two extra columns: everything at t<12 must be unchanged
+    ids_pad = jnp.pad(ids, ((0, 0), (0, 2)))
+    lp, kp, vp = forward_prefill(params, ids_pad, CFG)
+    np.testing.assert_allclose(np.asarray(lp[:, :12]), np.asarray(logits),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(kp[:, :, :12]), np.asarray(k),
+                               rtol=1e-5, atol=1e-6)
+    # the serving engine's last-position head: same logits, no [B,T,V]
+    from dmlc_tpu.models import forward_prefill_last
+
+    ll, kl, _ = forward_prefill_last(
+        params, ids_pad, jnp.array([11, 11]), CFG)
+    np.testing.assert_allclose(np.asarray(ll), np.asarray(logits[:, 11]),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(kl), np.asarray(kp),
+                               rtol=1e-6)
+
+
+def test_decode_step_matches_full_forward():
+    """The satellite contract: single-token decode against an external
+    KV cache reproduces the full-sequence forward's logits position by
+    position — including when the cache view is padded with garbage
+    past each sequence's true length."""
+    from dmlc_tpu.models import forward_decode, forward_prefill
+
+    params = init_params(jax.random.PRNGKey(0), CFG, n_stages=2)
+    t_total, n0, pad = 10, 4, 16
+    ids, _ = _data(jax.random.PRNGKey(4), b=2, t=t_total)
+    logits_full, k_full, v_full = forward_prefill(params, ids, CFG)
+
+    shape = (CFG.n_layers, 2, pad, CFG.n_heads, CFG.head_dim)
+    # garbage sentinel past the valid region: the length mask must make
+    # these slots invisible, so parity proves masking, not luck
+    k_cache = np.full(shape, 7.7, np.float32)
+    v_cache = np.full(shape, -7.7, np.float32)
+    _, k0, v0 = forward_prefill(params, ids[:, :n0], CFG)
+    k_cache[:, :, :n0] = np.asarray(k0)
+    v_cache[:, :, :n0] = np.asarray(v0)
+    for pos in range(n0, t_total):
+        lengths = np.full(2, pos, np.int32)
+        positions = np.full(2, pos, np.int32)
+        lg, kn, vn = forward_decode(
+            params, np.asarray(ids[:, pos], np.int32), positions,
+            k_cache, v_cache, lengths, CFG)
+        np.testing.assert_allclose(
+            np.asarray(lg), np.asarray(logits_full[:, pos]),
+            rtol=1e-5, atol=1e-5,
+            err_msg=f"decode logits diverge at position {pos}")
+        np.testing.assert_allclose(
+            np.asarray(kn), np.asarray(k_full[:, :, pos]),
+            rtol=1e-5, atol=1e-6)
+        k_cache[:, :, pos] = np.asarray(kn)
+        v_cache[:, :, pos] = np.asarray(vn)
+
+
+def test_decode_flops_per_token_is_forward_third():
+    from dmlc_tpu.models import decode_flops_per_token, train_flops_per_token
+
+    ctx = 128
+    got = decode_flops_per_token(CFG, ctx)
+    assert got == pytest.approx(train_flops_per_token(CFG, ctx,
+                                                      causal=False) / 3.0)
+    # more context strictly costs more attention FLOPs
+    assert decode_flops_per_token(CFG, 256) > got

@@ -1,0 +1,497 @@
+"""Serving plane: paged KV cache, continuous batching, HTTP surface.
+
+The allocator/cache tests are pure bookkeeping (no jax compute); the
+engine tests run the real jitted prefill/decode on a tiny model (the
+jit wrappers are process-cached, so the whole file pays each shape's
+compile once).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dmlc_tpu import telemetry
+from dmlc_tpu.base import DMLCError
+from dmlc_tpu.serving import (
+    AdmissionFull,
+    BlockAllocator,
+    ContinuousBatchScheduler,
+    InferenceEngine,
+    PagedKVCache,
+    Request,
+    RequestTooLarge,
+    ServingHTTPServer,
+)
+from dmlc_tpu.serving.scheduler import ACTIVE, DONE, WAITING
+
+
+# ---------------------------------------------------------------------------
+# block allocator
+# ---------------------------------------------------------------------------
+
+def test_allocator_exhaustion_is_all_or_nothing():
+    a = BlockAllocator(4)
+    got = a.alloc_many(3)
+    assert got is not None and len(got) == 3 and a.n_free == 1
+    # over-ask must not partially drain the free list
+    assert a.alloc_many(2) is None
+    assert a.n_free == 1
+    assert a.alloc() is not None
+    assert a.alloc() is None
+
+
+def test_allocator_free_reuse_and_double_free():
+    a = BlockAllocator(2)
+    got = a.alloc_many(2)
+    a.free(got)
+    assert a.n_free == 2 and a.n_in_use == 0
+    again = a.alloc_many(2)
+    assert sorted(again) == sorted(got)  # same physical blocks recycle
+    with pytest.raises(DMLCError):
+        a.free([99])  # foreign block
+    with pytest.raises(DMLCError):
+        a.free([again[0], 99])  # atomic: valid id must NOT free either
+    assert a.n_in_use == 2
+    a.free(again)
+    with pytest.raises(DMLCError):
+        a.free([again[0]])  # double free
+
+
+# ---------------------------------------------------------------------------
+# paged KV cache
+# ---------------------------------------------------------------------------
+
+def _mk_cache(**kw):
+    kw.setdefault("n_blocks", 8)
+    kw.setdefault("block_size", 4)
+    return PagedKVCache(2, 2, 3, **kw)  # L=2, H=2, D=3
+
+
+def _seq_kv(cache, n, seed):
+    rng = np.random.default_rng(seed)
+    shape = (cache.n_layers, n, cache.n_heads, cache.head_dim)
+    return rng.standard_normal(shape).astype(np.float32), \
+        rng.standard_normal(shape).astype(np.float32)
+
+
+def test_kv_write_gather_roundtrip_across_blocks():
+    cache = _mk_cache()
+    k, v = _seq_kv(cache, 10, seed=0)  # 10 tokens = 2.5 blocks
+    assert cache.allocate(1, 10)
+    cache.write(1, k, v, start=0)
+    gk, gv, lens = cache.gather([1])
+    assert lens.tolist() == [10]
+    assert gk.shape[2] % cache.block_size == 0
+    np.testing.assert_array_equal(gk[:, 0, :10], k)
+    np.testing.assert_array_equal(gv[:, 0, :10], v)
+    # append one token lands at position 10 (same block reservation is
+    # insufficient: 11 tokens need a 3rd block, so extend first)
+    assert cache.extend(1, 1)
+    k1, v1 = _seq_kv(cache, 1, seed=1)
+    cache.append(1, k1[:, 0], v1[:, 0])
+    gk, gv, lens = cache.gather([1])
+    assert lens.tolist() == [11]
+    np.testing.assert_array_equal(gk[:, 0, 10], k1[:, 0])
+
+
+def test_kv_exhaustion_then_free_then_reuse_without_aliasing():
+    cache = _mk_cache(n_blocks=4, block_size=4)  # 16 tokens total
+    ka, va = _seq_kv(cache, 8, seed=0)
+    kc, vc = _seq_kv(cache, 8, seed=2)
+    assert cache.allocate(1, 8)          # seq A: blocks 0-1
+    cache.write(1, ka, va)
+    assert cache.allocate(3, 8)          # seq C: blocks 2-3
+    cache.write(3, kc, vc)
+    assert not cache.allocate(2, 4)      # pool exhausted
+    assert not cache.extend(1, 1)
+    cache.free(1)                        # eviction frees A's blocks
+    reused = set()
+    assert cache.allocate(2, 8)          # seq B reuses A's blocks
+    reused = set(cache.block_table(2)) & set([0, 1, 2, 3])
+    assert reused, "freed blocks must be reused"
+    kb, vb = _seq_kv(cache, 8, seed=1)
+    cache.write(2, kb, vb)
+    # B reads back B's data, and surviving C is untouched (no aliasing)
+    gk, gv, lens = cache.gather([2, 3])
+    np.testing.assert_array_equal(gk[:, 0, :8], kb)
+    np.testing.assert_array_equal(gk[:, 1, :8], kc)
+    np.testing.assert_array_equal(gv[:, 1, :8], vc)
+
+
+def test_kv_fragmentation_bounded_under_mixed_length_churn():
+    cache = _mk_cache(n_blocks=16, block_size=4)
+    rng = np.random.default_rng(7)
+    live = {}
+    sid = 0
+    for it in range(120):
+        if live and (len(live) >= 5 or rng.random() < 0.45):
+            victim = int(rng.choice(list(live)))
+            cache.free(victim)
+            del live[victim]
+        else:
+            sid += 1
+            n = int(rng.integers(1, 14))
+            if cache.allocate(sid, n):
+                k, v = _seq_kv(cache, n, seed=sid)
+                cache.write(sid, k, v)
+                live[sid] = (n, k)
+        # invariants every iteration: conservation + bounded usage
+        s = cache.stats()
+        assert s["blocks_in_use"] + s["blocks_free"] == 16
+        assert s["blocks_in_use"] == sum(
+            cache.blocks_for(n) for n, _ in live.values())
+    # every surviving sequence still reads back its own data
+    for seq, (n, k) in live.items():
+        gk, _, lens = cache.gather([seq])
+        assert lens[0] == n
+        np.testing.assert_array_equal(gk[:, 0, :n], k)
+    for seq in list(live):
+        cache.free(seq)
+    assert cache.n_free_blocks == 16  # no leaked blocks after churn
+    assert cache.n_blocks_in_use == 0
+
+
+def test_kv_gather_pads_batch_with_dead_rows():
+    cache = _mk_cache()
+    k, v = _seq_kv(cache, 3, seed=0)
+    assert cache.allocate(1, 3)
+    cache.write(1, k, v)
+    gk, gv, lens = cache.gather([1], pad_batch=4, pad_len=8)
+    assert gk.shape[1] == 4 and gk.shape[2] == 8
+    assert lens.tolist() == [3, 0, 0, 0]
+    assert not gk[:, 1:].any()
+    # an explicit pad_len pins the jit shape: insufficiency / bad
+    # granularity must raise, never silently widen
+    with pytest.raises(ValueError):
+        cache.gather([1], pad_len=6)  # not a block multiple
+    assert cache.extend(1, 6)
+    k9, v9 = _seq_kv(cache, 6, seed=3)
+    cache.write(1, k9, v9)  # now 9 tokens > pad_len 8
+    with pytest.raises(ValueError):
+        cache.gather([1], pad_len=8)
+
+
+def test_kv_write_past_reservation_raises():
+    cache = _mk_cache()
+    assert cache.allocate(1, 4)
+    k, v = _seq_kv(cache, 5, seed=0)
+    with pytest.raises(DMLCError):
+        cache.write(1, k, v)  # 5 tokens into a 1-block reservation
+
+
+# ---------------------------------------------------------------------------
+# scheduler policy (no jax)
+# ---------------------------------------------------------------------------
+
+def test_scheduler_admission_respects_slots_and_blocks():
+    cache = _mk_cache(n_blocks=4, block_size=4)
+    sched = ContinuousBatchScheduler(cache, max_active=1)
+    r1 = Request([1] * 4, 4)
+    r2 = Request([2] * 4, 4)
+    sched.enqueue(r1)
+    sched.enqueue(r2)
+    got = sched.next_prefill()
+    assert got is r1
+    assert cache.allocate(r1.id, 4)
+    sched.activate(r1)
+    assert sched.next_prefill() is None  # max_active reached
+    sched.finish(r1)
+    assert r1.state == DONE and r1.wait(0)
+    # blocks freed by finish → r2 admissible
+    big = Request([3] * 100, 4)  # needs 26 blocks > 4 free: blocked
+    sched._waiting.appendleft(big)
+    assert sched.next_prefill() is None
+    sched._waiting.popleft()
+    assert sched.next_prefill() is r2
+
+
+def test_scheduler_preempts_youngest_and_requeues_front():
+    cache = _mk_cache(n_blocks=8, block_size=4)
+    sched = ContinuousBatchScheduler(cache, max_active=4)
+    old = Request([1, 2], 4)
+    young = Request([3, 4], 4)
+    for r in (old, young):
+        sched.enqueue(r)
+        assert sched.next_prefill() is r
+        assert cache.allocate(r.id, 2)
+        sched.activate(r)
+    young.generated = [7, 8]
+    victim = sched.preempt_youngest()
+    assert victim is young and young.state == WAITING
+    assert young.preemptions == 1
+    assert old.state == ACTIVE
+    assert young.id not in cache.live_sequences()
+    # resumes from the FRONT, context keeps generated-but-unconsumed
+    assert sched.next_prefill() is young
+    assert young.context_ids() == [3, 4, 7]  # last token not yet consumed
+
+
+# ---------------------------------------------------------------------------
+# engine + model (real jitted compute, tiny config)
+# ---------------------------------------------------------------------------
+
+def _tiny_model():
+    import jax
+
+    from dmlc_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=2, head_dim=8,
+                                d_ff=64, n_layers=2, n_experts=1,
+                                microbatches=1)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    return params, cfg
+
+
+def _greedy_oracle(params, cfg, prompt, n):
+    """Greedy continuation via repeated full forward (no cache)."""
+    from dmlc_tpu.models import transformer as tfm
+
+    ctx = list(prompt)
+    for _ in range(n):
+        lg, _, _ = tfm.forward_prefill(
+            params, np.array([ctx], np.int32), cfg)
+        ctx.append(int(np.argmax(np.asarray(lg[0, -1]))))
+    return ctx[len(prompt):]
+
+
+def test_engine_continuous_batching_end_to_end():
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=32, block_size=4,
+                          max_active=3, queue_depth=8, admit_timeout_s=2.0)
+    eng.start()
+    try:
+        reqs = [eng.submit([i + 1, i + 2, i + 3], max_new_tokens=5)
+                for i in range(4)]  # 4 requests over 3 active slots
+        for r in reqs:
+            assert r.wait(300), f"request {r.id} never finished"
+            assert r.error is None
+            assert r.n_generated == 5
+            assert r.ttft_s is not None and r.ttft_s > 0
+        # greedy parity through the paged cache for one of them
+        assert reqs[0].generated == _greedy_oracle(
+            params, cfg, [1, 2, 3], 5)
+        st = eng.stats()
+        assert st["kv"]["blocks_in_use"] == 0  # all returned
+        assert st["ledger"].get("steps", 0) > 0  # ledger was driven
+    finally:
+        eng.close()
+
+
+def test_engine_single_step_interleaves_admission():
+    """Iteration-level scheduling: a request submitted mid-generation
+    joins the running batch instead of waiting for drain."""
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=32, block_size=4,
+                          max_active=3, queue_depth=8)
+    r1 = eng.submit([1, 2, 3], max_new_tokens=8)
+    eng.step()   # prefill r1
+    eng.step()   # decode r1
+    assert r1.n_generated >= 2 and r1.state == ACTIVE
+    r2 = eng.submit([4, 5, 6], max_new_tokens=2)
+    eng.step()   # prefill r2 AND decode r1 in one iteration
+    assert r2.n_generated >= 1
+    assert r1.state == ACTIVE  # r1 still going: no drain barrier
+    for _ in range(12):
+        if r1.wait(0) and r2.wait(0):
+            break
+        eng.step()
+    assert r1.n_generated == 8 and r2.n_generated == 2
+    eng.close()
+
+
+def test_engine_preemption_under_kv_pressure_still_completes():
+    params, cfg = _tiny_model()
+    before = telemetry.snapshot()["counters"].get(
+        "serving", {}).get("preemptions", 0)
+    # 6 blocks × 4 slots = 24 cached tokens; 3 × (4 prompt + 10 gen)
+    # cannot coexist, so decode must evict and resume
+    eng = InferenceEngine(params, cfg, n_blocks=6, block_size=4,
+                          max_active=3, queue_depth=8)
+    eng.start()
+    try:
+        reqs = [eng.submit([i + 1] * 4, max_new_tokens=10)
+                for i in range(3)]
+        for r in reqs:
+            assert r.wait(300)
+            assert r.error is None
+            assert r.n_generated == 10
+        after = telemetry.snapshot()["counters"]["serving"]["preemptions"]
+        assert after > before, "tiny pool must have forced preemption"
+        assert eng.cache.n_blocks_in_use == 0
+        # preemption must be output-invisible: resume recomputes the
+        # context without re-sampling, so every request still matches
+        # the no-cache greedy oracle (a resume that re-derived its last
+        # token would duplicate it and drop the final one)
+        for i, r in enumerate(reqs):
+            assert r.generated == _greedy_oracle(
+                params, cfg, [i + 1] * 4, 10), (
+                f"request {i} output corrupted by preemption "
+                f"(preemptions={r.preemptions})")
+    finally:
+        eng.close()
+
+
+def test_decode_capacity_eviction_of_already_checked_survivor():
+    """Regression: activation order is not age order once a preempted
+    request resumes.  When a LATER request's extend evicts an EARLIER
+    survivor of the same capacity pass, that survivor must not reach
+    the decode batch (its cache sequence is gone)."""
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=5, block_size=4,
+                          max_active=4, queue_depth=8)
+    x = Request([1, 2], 4)   # younger (submitted later) but FIRST in
+    y = Request([3, 4], 4)   # the active list, older second: inversion
+    y.submit_t = x.submit_t - 10.0
+    assert eng.cache.allocate(x.id, 13)   # 4 blocks; extend stays inside
+    eng.cache.write(x.id, *_seq_kv_model(cfg, 13))
+    assert eng.cache.allocate(y.id, 4)    # 1 full block; extend needs +1
+    eng.cache.write(y.id, *_seq_kv_model(cfg, 4))
+    eng.scheduler.activate(x)
+    eng.scheduler.activate(y)
+    alive = eng._ensure_decode_capacity([x, y])
+    assert alive == [y], "evicted survivor leaked into the decode batch"
+    assert x.state == WAITING and x.preemptions == 1
+    assert x.id not in eng.cache.live_sequences()
+    eng.close()
+
+
+def _seq_kv_model(cfg, n):
+    rng = np.random.default_rng(n)
+    shape = (cfg.n_layers, n, cfg.n_heads, cfg.head_dim)
+    return (rng.standard_normal(shape).astype(np.float32),
+            rng.standard_normal(shape).astype(np.float32))
+
+
+def test_engine_rejects_oversized_and_overflowing_requests():
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=4, block_size=4,
+                          max_active=2, queue_depth=2,
+                          admit_timeout_s=0.05)
+    # could never fit even an empty cache → 413-shaped, not a slot
+    with pytest.raises(RequestTooLarge):
+        eng.submit([1] * 10, max_new_tokens=20)
+    # bad content is the client's ValueError (HTTP 400), not a size issue
+    with pytest.raises(ValueError):
+        eng.submit([cfg.vocab + 5], max_new_tokens=1)
+    # queue_depth=2 slots drain only when the engine runs; it is NOT
+    # started, so the third submit must time out with AdmissionFull
+    eng.submit([1, 2], max_new_tokens=1)
+    eng.submit([3, 4], max_new_tokens=1)
+    before = telemetry.snapshot()["counters"].get(
+        "serving", {}).get("rejected", 0)
+    with pytest.raises(AdmissionFull):
+        eng.submit([5, 6], max_new_tokens=1)
+    after = telemetry.snapshot()["counters"]["serving"]["rejected"]
+    assert after == before + 1
+    eng.close()
+
+
+def test_engine_close_fails_pending_requests():
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=16, block_size=4,
+                          max_active=2, queue_depth=4)
+    req = eng.submit([1, 2, 3], max_new_tokens=50)  # engine never started
+    eng.close()
+    assert req.wait(5)
+    assert req.state == "failed" and "shut down" in req.error
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface
+# ---------------------------------------------------------------------------
+
+def _post(url, doc, timeout=300):
+    req = urllib.request.Request(
+        url + "/generate", data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def test_http_generate_metrics_healthz():
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=32, block_size=4,
+                          max_active=2, queue_depth=8)
+    eng.start()
+    srv = ServingHTTPServer(eng, port=0)
+    try:
+        doc = _post(srv.url, {"prompt": [1, 2, 3], "max_tokens": 4})
+        assert doc["state"] == "done" and doc["n_generated"] == 4
+        assert doc["ttft_s"] > 0 and len(doc["output_ids"]) == 4
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url, {"prompt": "not a list"})
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url, {"prompt": [1] * 500, "max_tokens": 500})
+        assert e.value.code == 413
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url, {"prompt": [cfg.vocab + 7], "max_tokens": 2})
+        assert e.value.code == 400  # bad content, NOT 413
+        text = urllib.request.urlopen(
+            srv.url + "/metrics", timeout=30).read().decode()
+        from dmlc_tpu.telemetry.exporters import validate_exposition_text
+
+        assert validate_exposition_text(text) > 0
+        for fam in ("dmlc_serving_requests", "dmlc_serving_ttft_secs",
+                    "dmlc_serving_tokens_generated",
+                    "dmlc_serving_kv_blocks_in_use", "dmlc_step_count"):
+            assert fam in text, f"{fam} missing from /metrics"
+        hz = json.loads(urllib.request.urlopen(
+            srv.url + "/healthz", timeout=30).read())
+        assert hz["status"] == "ok" and "kv" in hz and "ledger" in hz
+    finally:
+        srv.close()
+        eng.close()
+
+
+def test_http_429_when_admission_queue_full():
+    params, cfg = _tiny_model()
+    # engine NOT started: slots never drain, so the queue fills
+    eng = InferenceEngine(params, cfg, n_blocks=32, block_size=4,
+                          max_active=2, queue_depth=1,
+                          admit_timeout_s=0.05)
+    srv = ServingHTTPServer(eng, port=0)
+    try:
+        eng.submit([1, 2], max_new_tokens=1)  # occupies the only slot
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url, {"prompt": [3, 4], "max_tokens": 1}, timeout=30)
+        assert e.value.code == 429
+        assert e.value.headers.get("Retry-After") == "1"
+    finally:
+        srv.close()
+        eng.close()
+
+
+def test_concurrent_http_streams_complete():
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=64, block_size=4,
+                          max_active=4, queue_depth=16)
+    eng.start()
+    srv = ServingHTTPServer(eng, port=0)
+    results = []
+    lock = threading.Lock()
+
+    def client(i):
+        doc = _post(srv.url, {"prompt": [i + 1, i + 2], "max_tokens": 3})
+        with lock:
+            results.append(doc)
+
+    try:
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(6)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(300)
+        assert time.monotonic() - t0 < 300
+        assert len(results) == 6
+        assert all(r["n_generated"] == 3 for r in results)
+    finally:
+        srv.close()
+        eng.close()

@@ -264,3 +264,82 @@ def test_buffer_pool_factory_failure_releases_capacity():
         pool.acquire()
     # the failed build must not leak its capacity slot
     assert pool.acquire() is not None
+
+
+def test_buffer_pool_kill_wakes_timed_waiter_before_deadline():
+    """The serving admission path parks submitters with a timeout;
+    kill() (engine shutdown) must wake them with None immediately, not
+    leave them burning the rest of their deadline."""
+    import threading
+    import time
+
+    from dmlc_tpu.concurrency import BufferPool
+
+    pool = BufferPool(lambda: object(), capacity=1)
+    pool.acquire()
+    results = []
+
+    def taker():
+        t0 = time.monotonic()
+        results.append((pool.acquire(timeout=30.0), time.monotonic() - t0))
+
+    t = threading.Thread(target=taker)
+    t.start()
+    time.sleep(0.1)
+    pool.kill()
+    t.join(5)
+    assert not t.is_alive()
+    got, waited = results[0]
+    assert got is None
+    assert waited < 5.0, f"kill took {waited:.1f}s to wake a timed waiter"
+
+
+def test_buffer_pool_timeout_zero_is_nonblocking():
+    from dmlc_tpu.concurrency import BufferPool
+
+    pool = BufferPool(lambda: object(), capacity=1)
+    first = pool.acquire(timeout=0)
+    assert first is not None          # capacity available: no wait needed
+    assert pool.acquire(timeout=0) is None  # exhausted: immediate None
+    pool.release(first)
+    assert pool.acquire(timeout=0) is first  # freed: immediate success
+
+
+def test_buffer_pool_release_during_timed_wait_hands_over():
+    import threading
+    import time
+
+    from dmlc_tpu.concurrency import BufferPool
+
+    pool = BufferPool(lambda: object(), capacity=1)
+    held = pool.acquire()
+    results = []
+
+    def taker():
+        results.append(pool.acquire(timeout=30.0))
+
+    t = threading.Thread(target=taker)
+    t.start()
+    time.sleep(0.05)
+    pool.release(held)
+    t.join(5)
+    assert results == [held]  # the waiter got the released buffer
+
+
+def test_buffer_pool_timeout_expiry_does_not_leak_capacity():
+    """A timed-out acquire must leave the pool fully usable: the next
+    release still satisfies the next acquire (no phantom slot)."""
+    from dmlc_tpu.concurrency import BufferPool
+
+    pool = BufferPool(lambda: object(), capacity=2)
+    a = pool.acquire()
+    b = pool.acquire()
+    for _ in range(3):
+        assert pool.acquire(timeout=0.01) is None
+    pool.release(a)
+    assert pool.acquire(timeout=0.01) is a
+    pool.release(b)
+    pool.release(a)
+    assert pool.acquire() is not None
+    assert pool.acquire() is not None
+    assert pool.created == 2  # timeouts never minted extra buffers
