@@ -14,7 +14,9 @@ gs:// behave identically (GCS writes use the resumable-upload stream).
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, Optional
+import os
+import re
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -22,6 +24,39 @@ from ..base import DMLCError, check
 from ..io.stream import Stream
 
 MANIFEST = "manifest.json"
+
+
+def _local_path(uri: str) -> Optional[str]:
+    """Filesystem path for local URIs, None for object stores."""
+    if uri.startswith("file://"):
+        return uri[len("file://"):]
+    return None if "://" in uri else uri
+
+
+def _commit_manifest(uri: str, data: bytes) -> None:
+    """Write the manifest LAST and ATOMICALLY — the commit record of a
+    checkpoint.  Shards without a committed manifest are invisible to
+    restore, so a preemption at ANY point mid-save leaves the previous
+    committed step as the restore target instead of a torn one.
+
+    Local paths go through write-to-temp + fsync + rename (atomic on
+    POSIX); object stores get a plain PUT, which is already all-or-
+    nothing at the object level."""
+    from ..resilience import fault_point
+
+    fault_point("checkpoint.commit", uri=uri)
+    target = _join(uri, MANIFEST)
+    path = _local_path(target)
+    if path is None:
+        with Stream.create(target, "w") as s:
+            s.write(data)
+        return
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def _leaf_key(path) -> str:
@@ -143,8 +178,10 @@ def save_pytree(uri: str, tree: Any, *, process_index: int = 0) -> None:
         telemetry.inc("checkpoint", "bytes_written", nbytes)
         telemetry.inc("checkpoint", "saves")
         if process_index == 0:
-            with Stream.create(_join(uri, MANIFEST), "w") as s:
-                s.write(json.dumps(manifest, indent=1).encode())
+            # shards first, manifest last: the atomic manifest commit is
+            # what makes the checkpoint exist at all (crash consistency)
+            _commit_manifest(uri,
+                             json.dumps(manifest, indent=1).encode())
 
 
 def _parse_index(ikey: str, shape) -> tuple:
@@ -312,12 +349,19 @@ def _restore_pytree(uri: str, template: Any, *, mesh=None) -> Any:
 
 
 class CheckpointManager:
-    """Step-numbered checkpoints with latest-pointer and retention.
+    """Step-numbered checkpoints with crash-consistent restore and
+    retention.
 
     The policy layer the reference leaves to users (SURVEY.md §5),
     matching common trainer needs: save(step, tree), restore latest,
     keep the newest ``max_to_keep`` (local paths only for deletion).
-    """
+
+    Crash consistency: a checkpoint EXISTS only once its manifest is
+    committed (written last, atomically — see ``_commit_manifest``).
+    ``latest_step``/``restore_latest`` scan the step directories and
+    skip any without a committed manifest, so a preemption mid-save can
+    never be restored from; the ``LATEST`` file is written as a
+    human/ops hint but is never trusted as the restore pointer."""
 
     def __init__(self, base_uri: str, *, max_to_keep: int = 3):
         check(max_to_keep >= 1,
@@ -336,13 +380,58 @@ class CheckpointManager:
                 s.write(str(step).encode())
             self._retain()
 
-    def latest_step(self) -> Optional[int]:
-        s = Stream.create(_join(self.base, "LATEST"), "r", allow_null=True)
+    def _has_manifest(self, step: int) -> bool:
+        s = Stream.create(_join(self._step_dir(step), MANIFEST), "r",
+                          allow_null=True)
         if s is None:
+            return False
+        s.close()
+        return True
+
+    def _step_dirs(self) -> Optional[List[int]]:
+        """Step numbers with a step_* directory under base (committed
+        or not); None when the base cannot be listed (no checkpoint
+        yet, or an exotic store)."""
+        from ..io.filesys import FileSystem
+        from ..io.uri import URI
+
+        base = URI(self.base if "://" in self.base
+                   else "file://" + self.base)
+        try:
+            fs = FileSystem.get_instance(base)
+            entries = fs.list_directory(base)
+        except OSError:
             return None
-        with s:
-            raw = s.read(64).strip()
-        return int(raw) if raw else None
+        steps = []
+        for f in entries:
+            name = f.path.name.rstrip("/").rsplit("/", 1)[-1]
+            m = re.match(r"^step_(\d+)$", name)
+            if m:
+                steps.append(int(m.group(1)))
+        return steps
+
+    def latest_step(self) -> Optional[int]:
+        """Newest step with a COMMITTED manifest.  Directory scan, not
+        the LATEST pointer: after a preemption mid-save the newest step
+        dir is torn (shards, no manifest) and must be skipped."""
+        steps = self._step_dirs()
+        if steps is None:
+            # unlistable store: fall back to the LATEST hint, but still
+            # require its manifest to be committed
+            s = Stream.create(_join(self.base, "LATEST"), "r",
+                              allow_null=True)
+            if s is None:
+                return None
+            with s:
+                raw = s.read(64).strip()
+            if not raw:
+                return None
+            step = int(raw)
+            return step if self._has_manifest(step) else None
+        for step in sorted(steps, reverse=True):
+            if self._has_manifest(step):
+                return step
+        return None
 
     def restore_latest(self, template: Any, *, mesh=None):
         step = self.latest_step()
@@ -351,16 +440,27 @@ class CheckpointManager:
         return step, restore_pytree(self._step_dir(step), template, mesh=mesh)
 
     def _retain(self) -> None:
-        import os
-        import re
         import shutil
 
         if not os.path.isdir(self.base):
             return  # retention is local-only; object stores keep all
-        steps = []
+        committed, torn = [], []
         for name in os.listdir(self.base):
             m = re.match(r"^step_(\d+)$", name)
             if m:
-                steps.append(int(m.group(1)))
-        for old in sorted(steps)[: -self.max_to_keep or None]:
+                step = int(m.group(1))
+                (committed if self._has_manifest(step)
+                 else torn).append(step)
+        # keep the newest max_to_keep COMMITTED checkpoints: a torn dir
+        # (preempted save) must never push a restorable step out of the
+        # retention window
+        for old in sorted(committed)[: -self.max_to_keep or None]:
             shutil.rmtree(self._step_dir(old), ignore_errors=True)
+        # torn dirs older than the newest committed step are dead
+        # litter (their save will never be completed); newer ones may
+        # be another process's save in flight — leave those alone
+        if committed:
+            for step in torn:
+                if step < max(committed):
+                    shutil.rmtree(self._step_dir(step),
+                                  ignore_errors=True)

@@ -158,12 +158,21 @@ class DeviceFeed:
     shape ``[n_parts]``: 1.0 where the partition contributed real rows,
     0.0 where a drained partition was padded with (cached, pre-placed)
     zero shards — consumers down-weight epoch-tail padding with it.
+
+    Elasticity: instead of explicit ``part_sources``, pass a
+    ``source_builder(part_index, num_parts) -> factory`` plus
+    ``world=(rank, world_size)`` — this process then reads global
+    partitions ``rank*n_local + lp`` of ``world_size*n_local`` (the
+    InputSplit byte-range contract makes that deterministic for any
+    world size), and :meth:`resize` re-partitions the feed in place
+    when the world changes under a run.
     """
 
-    def __init__(self, mesh, part_sources, *,
+    def __init__(self, mesh, part_sources=None, *,
                  queue_depth: Optional[int] = None,
                  axes=(AXIS_DP, AXIS_SP), log_every_mb: int = 10,
-                 num_workers: int = 0):
+                 num_workers: int = 0, source_builder=None,
+                 world=None):
         import jax
 
         if queue_depth is not None:
@@ -177,9 +186,16 @@ class DeviceFeed:
         n_parts = 1
         for a in axes:
             n_parts *= cfg.axis_size(a)
+        self._n_parts = n_parts
+        self._source_builder = source_builder
+        self._world = self._check_world(world) if world is not None \
+            else (0, 1)
+        if part_sources is None:
+            check(source_builder is not None,
+                  "DeviceFeed needs part_sources or a source_builder")
+            part_sources = self._build_sources()
         check(len(part_sources) == n_parts,
               f"need {n_parts} partition sources, got {len(part_sources)}")
-        self._n_parts = n_parts
         self._multi_epoch = all(callable(s) for s in part_sources)
         self._sources = part_sources
         self._epochs_started = 0
@@ -492,6 +508,53 @@ class DeviceFeed:
     def _make_staging(self) -> _StagingBuf:
         return _StagingBuf(self._template, self._n_parts)
 
+    # ---- elastic repartition -------------------------------------------
+    @staticmethod
+    def _check_world(world) -> tuple:
+        rank, wsize = world
+        check(wsize >= 1 and 0 <= rank < wsize,
+              f"world must be (rank, world_size) with 0 <= rank < "
+              f"world_size, got {world}")
+        return (int(rank), int(wsize))
+
+    def _build_sources(self) -> list:
+        rank, wsize = self._world
+        total = wsize * self._n_parts
+        return [self._source_builder(rank * self._n_parts + lp, total)
+                for lp in range(self._n_parts)]
+
+    @property
+    def world(self) -> tuple:
+        return self._world
+
+    def resize(self, world) -> None:
+        """Elastic repartition: rebuild the per-partition iterators for
+        a new ``(rank, world_size)`` in place.
+
+        The in-flight epoch is abandoned (its partial coverage is
+        superseded — on a resize the trainer restores from the last
+        checkpoint anyway); the next iteration starts a FRESH epoch
+        whose partitions tile the dataset exactly once under the new
+        byte-range split.  The local mesh (and so per-batch shapes,
+        staging pools, shard maps, cached zero shards) is untouched —
+        only the global partition ids change."""
+        from .. import telemetry
+
+        check(self._source_builder is not None,
+              "this feed was built from explicit part_sources; elastic "
+              "resize needs a source_builder (the recordio_/libsvm_ "
+              "feed factories provide one)")
+        world = self._check_world(world)
+        old = self._world
+        self.close()
+        self._world = world
+        self._sources = self._build_sources()
+        self._multi_epoch = True
+        telemetry.inc("feed", "resizes")
+        telemetry.record_event("feed_resized", old_world=list(old),
+                               world=list(world),
+                               local_parts=self._n_parts)
+
     def _parser_worker(self, w: int) -> None:
         my_parts = list(range(w, self._n_parts, self._workers))
         step = 0
@@ -551,18 +614,18 @@ class DeviceFeed:
 
 
 def libsvm_feed(uri: str, mesh, *, batch_size: int, max_nnz: int,
-                fmt: str = "libsvm", queue_depth: Optional[int] = None) -> DeviceFeed:
+                fmt: str = "libsvm", queue_depth: Optional[int] = None,
+                world=None) -> DeviceFeed:
     """Sparse text formats (libsvm/csv/libfm) → sharded padded-CSR batches.
 
     ``batch_size`` is per partition; the global leading dim is
-    batch_size * dp_size * sp_size.
+    batch_size * dp_size * sp_size.  ``world=(rank, world_size)``
+    partitions across an elastic multi-process world (resizable via
+    :meth:`DeviceFeed.resize`).
     """
     from ..data import create_row_iter
 
-    cfg = mesh_config(mesh)
-    n_parts = cfg.data_parts
-
-    def part_iter(part: int):
+    def part_iter(part: int, n_parts: int):
         it = create_row_iter(uri, part, n_parts, fmt)
         ncol = it.num_col()
         out = None
@@ -578,8 +641,9 @@ def libsvm_feed(uri: str, mesh, *, batch_size: int, max_nnz: int,
 
     # factories, not iterators: each epoch re-creates the row iters (which
     # hit the DiskRowIter/#cachefile cache when the URI requests one)
-    factories = [functools.partial(part_iter, p) for p in range(n_parts)]
-    return DeviceFeed(mesh, factories, queue_depth=queue_depth)
+    builder = lambda p, n: functools.partial(part_iter, p, n)  # noqa: E731
+    return DeviceFeed(mesh, queue_depth=queue_depth,
+                      source_builder=builder, world=world)
 
 
 def _chunk_spans(mv: memoryview):
@@ -686,7 +750,8 @@ def _gather_rows_into(mv: memoryview, sp, lo: int, hi: int,
 
 def recordio_packed_feed(uri: str, mesh, *, buf_bytes: int,
                          max_records: int = 4096,
-                         queue_depth: Optional[int] = None) -> DeviceFeed:
+                         queue_depth: Optional[int] = None,
+                         world=None) -> DeviceFeed:
     """RecordIO shards → packed batches with NO per-record padding:
     {data [buf_bytes] uint8, offsets [max_records+1] int32, count [1]}.
 
@@ -694,13 +759,12 @@ def recordio_packed_feed(uri: str, mesh, *, buf_bytes: int,
     between mean and max record size; the packed layout ships payload
     bytes back-to-back (static buf_bytes, zero tail) with record offsets
     for on-device slicing.  Records larger than buf_bytes are truncated.
+    ``world=(rank, world_size)`` partitions across an elastic
+    multi-process world (resizable via :meth:`DeviceFeed.resize`).
     """
     from ..io import input_split
 
-    cfg = mesh_config(mesh)
-    n_parts = cfg.data_parts
-
-    def part_iter(part: int):
+    def part_iter(part: int, n_parts: int):
         from .. import native
 
         split = input_split.create(uri, part, n_parts, "recordio")
@@ -769,25 +833,26 @@ def recordio_packed_feed(uri: str, mesh, *, buf_bytes: int,
         finally:
             split.close()
 
-    factories = [functools.partial(part_iter, p) for p in range(n_parts)]
-    return DeviceFeed(mesh, factories, queue_depth=queue_depth)
+    builder = lambda p, n: functools.partial(part_iter, p, n)  # noqa: E731
+    return DeviceFeed(mesh, queue_depth=queue_depth,
+                      source_builder=builder, world=world)
 
 
 def recordio_feed(uri: str, mesh, *, batch_records: int, max_bytes: int,
-                  queue_depth: Optional[int] = None) -> DeviceFeed:
+                  queue_depth: Optional[int] = None,
+                  world=None) -> DeviceFeed:
     """RecordIO shards → {data [B, max_bytes] uint8, length [B] int32}.
 
     Payload decode (e.g. images) happens on device or downstream; this
     feed moves raw record bytes into HBM at full InputSplit throughput.
     Batch assembly is chunk-at-a-time: the native span scan + one numpy
     gather per chunk (cpp/dmlc_native.cc dmlc_recordio_spans), not a
-    per-record copy loop."""
+    per-record copy loop.  ``world=(rank, world_size)`` partitions
+    across an elastic multi-process world (resizable via
+    :meth:`DeviceFeed.resize`)."""
     from ..io import input_split
 
-    cfg = mesh_config(mesh)
-    n_parts = cfg.data_parts
-
-    def part_iter(part: int):
+    def part_iter(part: int, n_parts: int):
         split = input_split.create(uri, part, n_parts, "recordio")
         try:
             # ONE batch buffer per iterator, filled in place chunk by
@@ -824,5 +889,6 @@ def recordio_feed(uri: str, mesh, *, batch_records: int, max_bytes: int,
         finally:
             split.close()
 
-    factories = [functools.partial(part_iter, p) for p in range(n_parts)]
-    return DeviceFeed(mesh, factories, queue_depth=queue_depth)
+    builder = lambda p, n: functools.partial(part_iter, p, n)  # noqa: E731
+    return DeviceFeed(mesh, queue_depth=queue_depth,
+                      source_builder=builder, world=world)

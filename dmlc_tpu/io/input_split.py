@@ -403,6 +403,45 @@ class InputSplitBase(InputSplit):
         raise NotImplementedError
 
     # ---- partitioning (input_split_base.cc:30-64) -----------------------
+    def _advance_boundary(self, off: int) -> int:
+        """A partition boundary advanced to the next record start —
+        unless it falls exactly on a file boundary, where it stays put
+        (input_split_base.cc:49-57).  Pure: no partition state is
+        touched, so any process can compute any boundary."""
+        if off >= self._file_offset[-1]:
+            return self._file_offset[-1]
+        fi = bisect_right(self._file_offset, off) - 1
+        if off == self._file_offset[fi]:
+            return off
+        local = off - self._file_offset[fi]
+        if self._mmap_ok:
+            return off + self.seek_record_begin_mm(
+                self._get_map(fi), local, self._files[fi].size)
+        fs = self._filesys.open_for_read(self._files[fi].path)
+        try:
+            fs.seek(local)
+            return off + self.seek_record_begin(fs)
+        finally:
+            fs.close()
+
+    def partition_spans(self, num_parts: int) -> List[Tuple[int, int]]:
+        """Record-aligned byte spans ``[(begin, end), ...]`` for every
+        partition index under ``num_parts`` — the deterministic
+        repartition contract behind elastic world resize: the spans are
+        a pure function of (total size, num_parts, align) plus the
+        record-boundary advancement, so for ANY ``num_parts`` the spans
+        tile the byte space exactly (``spans[i][1] == spans[i+1][0]``,
+        first begins at a record start, last ends at the total) and two
+        worlds of different sizes agree on the split with no
+        coordination.  Does not disturb the current partition state."""
+        check(num_parts >= 1, f"num_parts must be >= 1, got {num_parts}")
+        ntotal = self._file_offset[-1]
+        nstep = (ntotal + num_parts - 1) // num_parts
+        nstep = ((nstep + self._align - 1) // self._align) * self._align
+        cuts = [self._advance_boundary(min(nstep * i, ntotal))
+                for i in range(num_parts + 1)]
+        return list(zip(cuts[:-1], cuts[1:]))
+
     def reset_partition(self, rank: int, nsplit: int) -> None:
         ntotal = self._file_offset[-1]
         nstep = (ntotal + nsplit - 1) // nsplit

@@ -38,7 +38,7 @@ from ..models import transformer as tfm
 from .kv_cache import PagedKVCache
 from .scheduler import ACTIVE, ContinuousBatchScheduler, Request
 
-__all__ = ["InferenceEngine", "AdmissionFull"]
+__all__ = ["InferenceEngine", "AdmissionFull", "EngineDraining"]
 
 logger = logging.getLogger("dmlc_tpu.serving")
 
@@ -49,6 +49,11 @@ class AdmissionFull(DMLCError):
 
 class RequestTooLarge(DMLCError):
     """The request could never fit the KV pool, even alone (HTTP 413)."""
+
+
+class EngineDraining(DMLCError):
+    """The engine stopped admitting (SIGTERM drain); HTTP 503 +
+    Retry-After — in-flight generations keep decoding to completion."""
 
 
 _JIT_CACHE: dict = {}
@@ -111,6 +116,8 @@ class InferenceEngine:
         self._slots: BufferPool = BufferPool(object, capacity=depth)
         self._prefill, self._decode = _jitted_programs()
         self._stop = threading.Event()
+        self._draining = threading.Event()
+        self._stepping = False  # an iteration is mid-flight (see drain)
         self._thread: Optional[threading.Thread] = None
         self._flops_declared = False
 
@@ -122,6 +129,10 @@ class InferenceEngine:
         queue slot frees up within ``timeout`` (default
         ``admit_timeout_s``), ``ValueError`` when the request could
         never be served (bad ids, context beyond total cache)."""
+        if self._draining.is_set():
+            raise EngineDraining(
+                "engine is draining (shutdown notice); retry against "
+                "another replica")
         mnt = (max_new_tokens if max_new_tokens is not None
                else self.default_max_new_tokens)
         req = Request(prompt_ids, mnt, eos_id=self.eos_id)
@@ -178,6 +189,53 @@ class InferenceEngine:
             target=self._loop, daemon=True, name="serving-engine")
         self._thread.start()
 
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def begin_drain(self) -> None:
+        """Stop admitting; the decode loop keeps running so active (and
+        already-queued) generations finish."""
+        if not self._draining.is_set():
+            self._draining.set()
+            telemetry.set_gauge("serving", "draining", 1)
+            telemetry.record_event("serving_drain_begin",
+                                   active=self.scheduler.n_active,
+                                   waiting=self.scheduler.n_waiting)
+
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Graceful preemption shutdown: stop admitting, finish every
+        in-flight generation within ``timeout_s``
+        (``DMLC_SERVE_DRAIN_S``, default 30), then close.  Returns True
+        when the backlog fully drained, False when the deadline cut it
+        off (the remaining requests are failed by close())."""
+        t = (timeout_s if timeout_s is not None
+             else get_env("DMLC_SERVE_DRAIN_S", 30.0))
+        self.begin_drain()
+        deadline = time.monotonic() + t
+        # a request transits waiting -> stepping (popped, mid-prefill)
+        # -> active, only ever forward, and submits are already
+        # refused.  Reading the stages in FLOW ORDER (waiting first,
+        # active last) guarantees at least one read sees any in-flight
+        # request: whatever stage it occupied at the first read, by the
+        # time later reads happen it can only be in a stage not yet
+        # read — so "all three false" truly means drained, and close()
+        # can never sweep a live generation.
+        while (self.scheduler.n_waiting or self._stepping
+               or self.scheduler.n_active):
+            if time.monotonic() > deadline:
+                logger.warning(
+                    "drain deadline (%.1fs) hit with %d active / %d "
+                    "waiting; failing the rest", t,
+                    self.scheduler.n_active, self.scheduler.n_waiting)
+                self.close()
+                telemetry.record_event("serving_drain_end", clean=False)
+                return False
+            time.sleep(0.02)
+        self.close()
+        telemetry.record_event("serving_drain_end", clean=True)
+        return True
+
     def close(self) -> None:
         """Stop the loop; fail whatever is still queued or active (their
         waiters wake with an error) and wake blocked submitters."""
@@ -227,16 +285,20 @@ class InferenceEngine:
         one decode token for every active request.  Returns whether any
         work happened (the loop's idle signal).  Public so tests can
         single-step the engine deterministically."""
-        did = False
-        req = self.scheduler.next_prefill()
-        if req is not None:
-            self._run_prefill(req)
-            did = True
-        active = self.scheduler.active_requests()
-        if active:
-            self._run_decode(active)
-            did = True
-        return did
+        self._stepping = True
+        try:
+            did = False
+            req = self.scheduler.next_prefill()
+            if req is not None:
+                self._run_prefill(req)
+                did = True
+            active = self.scheduler.active_requests()
+            if active:
+                self._run_decode(active)
+                did = True
+            return did
+        finally:
+            self._stepping = False
 
     def _finish(self, req: Request, error: Optional[str] = None) -> None:
         self.scheduler.finish(req, error=error)

@@ -13,6 +13,13 @@ within the engine's timeout the client gets **429** with Retry-After,
 not a silently growing queue.  Malformed bodies get 400; a request the
 cache could never hold gets 413; an engine-side failure gets 503.
 
+Graceful drain (preemption notice): ``drain()`` — or SIGTERM once
+``install_drain_handler()`` armed it — stops admitting (new /generate
+requests get **503 + Retry-After**, pointing the load balancer at
+another replica), lets active decodes finish within
+``DMLC_SERVE_DRAIN_S``, then closes the listener; in-flight
+generations are never dropped by the shutdown notice itself.
+
 Endpoints:
   POST /generate   {"prompt": [int, ...], "max_tokens": int?}
                    → request result document (scheduler.Request.result)
@@ -25,11 +32,14 @@ from __future__ import annotations
 
 import json
 import logging
+import signal
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
 
 from .. import telemetry
-from .engine import AdmissionFull, InferenceEngine, RequestTooLarge
+from .engine import (AdmissionFull, EngineDraining, InferenceEngine,
+                     RequestTooLarge)
 
 __all__ = ["ServingHTTPServer"]
 
@@ -78,6 +88,13 @@ class ServingHTTPServer:
                 if path != "/generate":
                     self._send(404, "text/plain", b"not found\n")
                     return
+                if eng.draining:
+                    # shutting down on a preemption notice: point the
+                    # client (or its load balancer) elsewhere while the
+                    # in-flight generations finish
+                    self._send_json(503, {"error": "server draining"},
+                                    extra_headers={"Retry-After": "5"})
+                    return
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     if n > MAX_BODY_BYTES:
@@ -104,6 +121,10 @@ class ServingHTTPServer:
                 except RequestTooLarge as e:
                     self._send_json(413, {"error": str(e)})
                     return
+                except EngineDraining as e:
+                    self._send_json(503, {"error": str(e)},
+                                    extra_headers={"Retry-After": "5"})
+                    return
                 except ValueError as e:
                     # content errors (out-of-vocab ids, bad bounds) are
                     # the client's 400, not a size problem
@@ -127,6 +148,8 @@ class ServingHTTPServer:
         self.host = host
         self.port = self._httpd.server_address[1]
         self.engine = engine
+        self._drain_done = threading.Event()
+        self._closed = False
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
             name="serving-http")
@@ -136,7 +159,44 @@ class ServingHTTPServer:
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
 
+    def drain(self, timeout_s=None) -> bool:
+        """Graceful shutdown: stop admitting (new /generate → 503 +
+        Retry-After), finish active decodes within ``timeout_s``
+        (``DMLC_SERVE_DRAIN_S``), then close the listener.  Returns
+        whether the backlog drained cleanly."""
+        logger.info("serving drain: refusing new work, finishing %d "
+                    "active / %d waiting", self.engine.scheduler.n_active,
+                    self.engine.scheduler.n_waiting)
+        clean = self.engine.drain(timeout_s)
+        self.close()
+        return clean
+
+    def install_drain_handler(self) -> None:
+        """Arm SIGTERM as the drain trigger (main thread only — signal
+        module constraint).  A preemption notice then drains instead of
+        dropping in-flight generations; ``wait_drained()`` blocks until
+        the drain completes (or ``DMLC_SERVE_DRAIN_S`` cuts it off)."""
+        def run_drain():
+            try:
+                self.drain()
+            finally:
+                self._drain_done.set()
+
+        def on_term(signum, frame):  # noqa: ARG001 - signal API
+            # the handler must return fast; drain on a helper thread
+            threading.Thread(target=run_drain, daemon=True,
+                             name="serving-drain").start()
+
+        signal.signal(signal.SIGTERM, on_term)
+
+    def wait_drained(self, timeout: Optional[float] = None) -> bool:
+        """Block until a signal-triggered drain has fully completed."""
+        return self._drain_done.wait(timeout)
+
     def close(self) -> None:
+        if self._closed:  # drain() + the caller's finally both close
+            return
+        self._closed = True
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5.0)

@@ -185,6 +185,21 @@ class TelemetryAggregator:
         with self._lock:
             self._seen[rank] = time.monotonic()
 
+    def remap_ranks(self, mapping: Dict[int, int]) -> None:
+        """Atomically renumber the per-rank snapshot/heartbeat stores
+        into a new generation's rank space (elastic world resize):
+        entries for ranks absent from ``mapping`` are dropped.  Without
+        this, a survivor's heartbeat age would be split between its old
+        and new rank ids and the failure detector would declare phantom
+        deaths after every resize."""
+        with self._lock:
+            self._ranks = {mapping[r]: s for r, s in self._ranks.items()
+                           if r in mapping}
+            self._seen = {mapping[r]: t for r, t in self._seen.items()
+                          if r in mapping}
+            self._flagged = {(mapping[r], s, n)
+                             for (r, s, n) in self._flagged if r in mapping}
+
     # ---- views ----------------------------------------------------------
     def ranks(self) -> Dict[int, float]:
         """rank → heartbeat age in seconds (monotonic-clock based, so a
@@ -343,11 +358,16 @@ class TelemetryHTTPServer:
     into Perfetto / chrome://tracing.  ``anomaly_source`` (zero-arg
     callable returning a JSON-able dict, e.g. ``Watchdog.report``)
     enables ``GET /anomalies``: the live per-rank step-health and
-    anomaly-flag document that ``dmlc top`` polls."""
+    anomaly-flag document that ``dmlc top`` polls.  ``resize_handler``
+    (a callable taking the parsed JSON body, returning a JSON-able
+    dict) enables ``POST /resize`` — the elastic tracker's operator
+    scale-up endpoint; a ``ValueError`` from the handler maps to 400, a
+    ``RuntimeError`` (e.g. tracker not elastic) to 409."""
 
     def __init__(self, aggregator: TelemetryAggregator,
                  host: str = "127.0.0.1", port: int = 0,
-                 trace_source=None, anomaly_source=None):
+                 trace_source=None, anomaly_source=None,
+                 resize_handler=None):
         agg = aggregator
 
         class Handler(BaseHTTPRequestHandler):
@@ -387,6 +407,30 @@ class TelemetryHTTPServer:
                     self._send(200, "application/json", body)
                 else:
                     self._send(404, "text/plain", b"not found\n")
+
+            def do_POST(self):  # noqa: N802 - http.server API
+                path = self.path.split("?", 1)[0]
+                if path != "/resize" or resize_handler is None:
+                    self._send(404, "text/plain", b"not found\n")
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    if n > (1 << 16):
+                        raise ValueError("body too large")
+                    doc = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(doc, dict):
+                        raise ValueError("body must be a JSON object")
+                    out = resize_handler(doc)
+                except (ValueError, TypeError, json.JSONDecodeError) as e:
+                    self._send(400, "application/json",
+                               json.dumps({"error": str(e)}).encode())
+                    return
+                except RuntimeError as e:  # tracker not elastic
+                    self._send(409, "application/json",
+                               json.dumps({"error": str(e)}).encode())
+                    return
+                self._send(200, "application/json",
+                           json.dumps(out).encode())
 
             def log_message(self, fmt, *args):  # quiet: scrapes are periodic
                 logger.debug("telemetry http: " + fmt, *args)

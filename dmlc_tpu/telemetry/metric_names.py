@@ -29,6 +29,14 @@ METRIC_NAMES = frozenset({
     "dmlc_anomaly_regression_flags",
     "dmlc_anomaly_feed_stall_flags",
     "dmlc_anomaly_goodput_collapse_flags",
+    # elastic world resize (tracker generations + client + launcher)
+    "dmlc_elastic_resizes_total",
+    "dmlc_elastic_shrinks_total",
+    "dmlc_elastic_grows_total",
+    "dmlc_elastic_generation",
+    "dmlc_elastic_world_size",
+    "dmlc_elastic_client_resizes",
+    "dmlc_elastic_gang_reschedules",
     # checkpoint
     "dmlc_checkpoint_bytes_read",
     "dmlc_checkpoint_bytes_written",
@@ -52,6 +60,7 @@ METRIC_NAMES = frozenset({
     "dmlc_feed_device_put_secs",
     "dmlc_feed_producer_stall_secs",
     "dmlc_feed_queue_depth",
+    "dmlc_feed_resizes",
     "dmlc_feed_stage_stall_secs",
     # flash attention
     "dmlc_flash_fwd_calls",
@@ -106,6 +115,7 @@ METRIC_NAMES = frozenset({
     "dmlc_serving_completed",
     "dmlc_serving_decode_batch",
     "dmlc_serving_decode_steps",
+    "dmlc_serving_draining",
     "dmlc_serving_failed",
     "dmlc_serving_kv_alloc_failures",
     "dmlc_serving_kv_blocks_in_use",
@@ -134,6 +144,7 @@ METRIC_NAMES = frozenset({
     "dmlc_build_info",
     "dmlc_heartbeat_age_seconds",
     "dmlc_tracker_ranks_reporting",
+    "dmlc_tracker_rejected_announces",
     # training loop examples
     "dmlc_train_steps",
     # smoke-harness fixtures (scripts/telemetry_smoke.py workers)
@@ -160,6 +171,7 @@ NON_METRIC_TOKENS = frozenset({
     "dmlc_top",
     "dmlc_tracker",       # reference repo path tracker/dmlc_tracker/…
     "dmlc_anomaly",       # prose prefix for the dmlc_anomaly_* family
+    "dmlc_elastic",       # prose prefix for the dmlc_elastic_* family
     "dmlc_serving",       # prose prefix for the dmlc_serving_* family
     "dmlc_serve",         # bin/dmlc-serve launcher name in prose
     "dmlc_recordio_spans",  # native ABI symbol (dmlc_native.cc)

@@ -15,4 +15,4 @@ the control plane: gang-scheduling, retries, rank contract, env vars.
 
 from .protocol import MAGIC, FrameSocket, link_maps  # noqa: F401
 from .rendezvous import PSTracker, RabitTracker, submit_job  # noqa: F401
-from .client import TrackerClient  # noqa: F401
+from .client import TrackerClient, WorldResized  # noqa: F401

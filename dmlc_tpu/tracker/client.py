@@ -8,11 +8,24 @@ data (the TPU data plane is XLA collectives, parallel/collectives.py).
 Peer links established through tracker brokering are real TCP
 connections; peers identify themselves with (MAGIC, rank) frames after
 connect.
+
+Elasticity: against an elastic tracker (``DMLC_ELASTIC=1``) the world
+size is a run-time variable.  Every host-collective array frame carries
+the world *generation* id, so traffic from a stale generation is
+rejected instead of folded into the reduction; a collective interrupted
+by a peer loss (or by a tracker-announced generation change, delivered
+as a piggyback on the heartbeat reply) raises the retryable
+:class:`WorldResized` instead of hanging — bounded by the
+``DMLC_CLIENT_*`` socket timeouts — and :meth:`TrackerClient.resize`
+re-enters rendezvous to learn the new rank/world and rebuild the
+overlay.  Against a non-elastic tracker nothing changes: peer loss
+stays an ``OSError`` and ``recover()`` keeps the same-rank semantics.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
 import select
 import socket
@@ -21,10 +34,27 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..base import DMLCError, check
 from ..resilience import RetryPolicy, fault_point
-from .protocol import MAGIC, FrameSocket
+from .protocol import MAGIC, FrameSocket, recover_cmd
 
-__all__ = ["TrackerClient"]
+__all__ = ["TrackerClient", "WorldResized"]
+
+logger = logging.getLogger("dmlc_tpu.tracker")
+
+
+class WorldResized(DMLCError):
+    """The elastic world changed under a collective: a peer was lost, a
+    stale-generation frame arrived, or the tracker announced a new
+    generation.  Retryable — the raising client has already torn down
+    its peer links (waking peers blocked on them, so the whole gang
+    cascades out of the dead collective); call
+    :meth:`TrackerClient.resize` to re-enter rendezvous, learn the new
+    rank/world, restore state from the last checkpoint, and retry."""
+
+    def __init__(self, msg: str, gen: int = -1):
+        super().__init__(msg)
+        self.gen = gen
 
 
 def _ring_min_bytes() -> int:
@@ -64,6 +94,12 @@ def _op_timeout() -> Optional[float]:
     return t if t > 0 else None
 
 
+def _resize_timeout() -> float:
+    """Upper bound on one resize() re-rendezvous, settle-wait included
+    (DMLC_ELASTIC_RESIZE_TIMEOUT_S, default 120)."""
+    return float(os.environ.get("DMLC_ELASTIC_RESIZE_TIMEOUT_S", "120"))
+
+
 def _dial_policy() -> RetryPolicy:
     """Reconnect-with-backoff for tracker dials (DMLC_CLIENT_RETRIES,
     default 5): rides out a tracker restart / slow bind instead of
@@ -93,6 +129,15 @@ class TrackerClient:
         self.ring_next = -1
         self.links: Dict[int, FrameSocket] = {}
         self._listener: Optional[socket.socket] = None
+        # elastic state: generation of the topology this client holds,
+        # and whether the tracker runs elastic at all (learned from the
+        # `gen` query after every rendezvous).  _resize_pending is set by
+        # the heartbeat thread (gen piggyback on the metrics reply) and
+        # consumed on the worker thread at the next collective entry —
+        # a plain bool flag, single-writer/single-reader.
+        self.gen = 0
+        self.elastic = False
+        self._resize_pending = False
 
     # ---- tracker session helpers ---------------------------------------
     def _dial(self) -> FrameSocket:
@@ -196,6 +241,13 @@ class TrackerClient:
             ps.send_int(MAGIC)
             ps.send_int(self.rank)
             self.links[peer_rank] = ps
+        # learn the world generation this topology belongs to (and
+        # whether the tracker is elastic at all) — a separate short
+        # session so the topology wire format stays C-ABI compatible
+        info = self._query_gen()
+        self.gen = int(info.get("gen", 0))
+        self.elastic = bool(info.get("elastic", False))
+        self._resize_pending = False
         return self
 
     def _dial_peer(self, host: str, port: int, peer_rank: int) -> FrameSocket:
@@ -222,10 +274,136 @@ class TrackerClient:
     def recover(self) -> "TrackerClient":
         """Reconnect after restart keeping our rank (tracker 'recover')."""
         assert self.rank >= 0
+        self._links_down()
+        return self.start(cmd="recover")
+
+    # ---- elastic world resize ------------------------------------------
+    def _links_down(self) -> None:
+        """Close every peer link.  Beyond local cleanup this is the
+        resize *cascade*: a peer blocked mid-collective on one of these
+        sockets wakes with a ConnectionError, raises its own
+        WorldResized, closes ITS links — so one lost rank propagates to
+        the whole gang without any tracker push channel."""
         for fs in self.links.values():
             fs.close()
         self.links = {}
-        return self.start(cmd="recover")
+
+    def _resized(self, why: str, cause: Optional[BaseException] = None):
+        from .. import telemetry
+
+        self._links_down()
+        telemetry.record_event("world_resized_signal", rank=self.rank,
+                               gen=self.gen, why=why)
+        err = WorldResized(
+            f"rank {self.rank} (gen {self.gen}): {why}; call resize() to "
+            f"re-enter rendezvous", gen=self.gen)
+        if cause is not None:
+            raise err from cause
+        raise err
+
+    @property
+    def resize_pending(self) -> bool:
+        """True once the tracker has announced a newer generation (via
+        the heartbeat reply) than the topology this client holds."""
+        return self._resize_pending
+
+    def check_resized(self) -> None:
+        """Raise :class:`WorldResized` if the tracker announced a new
+        generation since the last rendezvous — the cheap per-step check
+        for loops that do not touch a host collective every step."""
+        if self.elastic and self._resize_pending:
+            self._resized("world generation advanced (tracker heartbeat)")
+
+    def _query_gen(self) -> dict:
+        """Short ``gen`` session: the tracker's current generation,
+        world size, elastic flag and dead-rank count."""
+        fs = self._session("gen", self.rank, -1)
+        try:
+            return json.loads(fs.recv_str())
+        finally:
+            fs.close()
+
+    def _await_settle(self, old_gen: int, deadline: float) -> int:
+        """Wait for the membership change behind a WorldResized to
+        settle before re-entering rendezvous: either the tracker opened
+        a new generation (gen advances — shrink past grace, or a
+        scale-up), or the lost rank was re-admitted at its old rank
+        within the grace window (dead count returns to zero — the PR 2
+        supervised-restart path, same generation).  Re-entering blind
+        would park this worker in a brokering round that waits on a
+        peer the tracker has not yet culled."""
+        seen_dead = False
+        poll = 0.05
+        while True:
+            try:
+                info = self._query_gen()
+            except (OSError, ValueError):
+                info = None  # tracker mid-restart: keep polling
+            if info is not None:
+                gen = int(info.get("gen", 0))
+                if gen > old_gen:
+                    return gen
+                if int(info.get("dead", 0)) > 0:
+                    seen_dead = True
+                elif seen_dead:
+                    return gen  # same-gen readmission completed
+            if time.monotonic() > deadline:
+                logger.warning(
+                    "rank %d: resize settle-wait timed out (gen still "
+                    "%d); attempting a same-generation recover", self.rank,
+                    old_gen)
+                return old_gen
+            time.sleep(poll)
+            poll = min(poll * 1.5, 0.5)
+
+    def resize(self, timeout_s: Optional[float] = None) -> "TrackerClient":
+        """Re-enter rendezvous after :class:`WorldResized`.
+
+        Waits for the tracker's membership change to settle, then
+        announces ``recover@<old-gen>`` — the tracker translates the
+        stale rank through its generation maps into this worker's rank
+        in the new dense ``[0, N')`` space (or admits it as a scale-up
+        join if it was evicted while away) and re-brokers the overlay.
+        On return ``rank``/``world_size``/``gen`` describe the new
+        world; the caller owns restoring training state (checkpoint
+        restore onto the new mesh) and repartitioning data
+        (``DeviceFeed.resize``).  Bounded by ``timeout_s`` (default
+        ``DMLC_ELASTIC_RESIZE_TIMEOUT_S``)."""
+        from .. import telemetry
+
+        check(self.rank >= 0, "resize() before a successful rendezvous")
+        t = _resize_timeout() if timeout_s is None else float(timeout_s)
+        deadline = time.monotonic() + t
+        rank0, gen0 = self.rank, self.gen
+        self._links_down()
+        self._resize_pending = False
+        last: Optional[BaseException] = None
+        while True:
+            settled = self._await_settle(gen0, deadline)
+            cmd = recover_cmd(gen0) if settled > gen0 else "recover"
+            self.rank = rank0  # announce in terms of the OLD identity
+            try:
+                self.start(cmd=cmd)
+            except (OSError, ConnectionError) as e:
+                # a racing second resize (another death mid-recovery)
+                # can break this rendezvous; retry against the newest
+                # generation until the deadline
+                last = e
+                self._links_down()
+                if time.monotonic() > deadline:
+                    raise DMLCError(
+                        f"rank {rank0}: resize did not complete within "
+                        f"{t:.0f}s: {last}") from last
+                time.sleep(0.2)
+                continue
+            telemetry.inc("elastic", "client_resizes")
+            telemetry.record_event(
+                "client_resized", old_rank=rank0, rank=self.rank,
+                old_gen=gen0, gen=self.gen, world=self.world_size)
+            logger.info(
+                "rank %d (gen %d) resized -> rank %d/%d (gen %d)",
+                rank0, gen0, self.rank, self.world_size, self.gen)
+            return self
 
     # ---- tracker utility commands --------------------------------------
     def log(self, msg: str) -> None:
@@ -237,10 +415,20 @@ class TrackerClient:
         """Push a telemetry heartbeat (JSON snapshot) to the tracker's
         aggregator over a short ``metrics`` session — same session shape
         as the ``print`` relay.  See telemetry.heartbeat.HeartbeatSender
-        for the periodic-push wrapper."""
+        for the periodic-push wrapper.
+
+        The tracker's reply carries its current world generation: the
+        heartbeat doubles as the scale-up push channel — when the
+        generation advances with no link dying (a grow resize), this is
+        how a survivor learns it must re-enter rendezvous."""
         fs = self._session("metrics", self.rank, -1)
-        fs.send_str(payload)
-        fs.close()
+        try:
+            fs.send_str(payload)
+            gen = fs.recv_int()
+        finally:
+            fs.close()
+        if self.elastic and gen > self.gen:
+            self._resize_pending = True
 
     def clock_ping(self) -> tuple:
         """One NTP-style clock exchange with the tracker: returns
@@ -270,7 +458,12 @@ class TrackerClient:
             t0, float(reply["t1"]), float(reply["t2"]), t3)
 
     def shutdown(self) -> None:
-        fs = self._session("shutdown", self.rank, -1)
+        # elastic: stamp the generation our rank belongs to — a resize
+        # we never re-brokered into may have renumbered it, and the
+        # tracker must mark the right completion slot (or ignore us if
+        # we were evicted while finishing)
+        cmd = f"shutdown@{self.gen}" if self.elastic else "shutdown"
+        fs = self._session(cmd, self.rank, -1)
         fs.close()
         for ps in self.links.values():
             ps.close()
@@ -280,11 +473,22 @@ class TrackerClient:
 
     # ---- host-side tree collectives ------------------------------------
     def _send_array(self, fs: FrameSocket, arr: np.ndarray) -> None:
+        # every array frame is generation-stamped: (gen, nbytes, data).
+        # Python-to-Python only — the C-ABI workers run their own
+        # collective framing over their own links, never these.
         data = arr.tobytes()
+        fs.send_int(self.gen)
         fs.send_int(len(data))
         fs.sock.sendall(data)
 
     def _recv_array(self, fs: FrameSocket, like: np.ndarray) -> np.ndarray:
+        g = fs.recv_int()
+        if g != self.gen:
+            # a stale (or future) generation's traffic must never be
+            # folded into this reduction — reject the frame and force
+            # both sides back through rendezvous
+            self._resized(f"stale-generation frame (peer gen {g}, "
+                          f"ours {self.gen})")
         n = fs.recv_int()
         return np.frombuffer(fs.recv_all(n), dtype=like.dtype).reshape(like.shape)
 
@@ -333,14 +537,23 @@ class TrackerClient:
                 f"rank {self.rank}: ring allreduce selected but ring "
                 f"links ({self.ring_prev}, {self.ring_next}) are not "
                 "established — topology bug or partial recovery")
+        self.check_resized()
         telemetry.record_event("barrier_enter", site="allreduce", op=op,
                                rank=self.rank, bytes=int(arr.nbytes))
         with telemetry.span("collective.allreduce", stage="collective",
                             args={"op": op, "bytes": int(arr.nbytes),
                                   "rank": self.rank, "algo": algo}):
-            if algo == "ring":
-                return self._ring_allreduce(arr, op)
-            return self._tree_allreduce(arr, op)
+            try:
+                if algo == "ring":
+                    return self._ring_allreduce(arr, op)
+                return self._tree_allreduce(arr, op)
+            except OSError as e:
+                if self.elastic:
+                    # peer lost mid-fold (preemption): retryable resize
+                    # signal instead of a crash; closing our links below
+                    # cascades the wake-up to peers blocked on us
+                    self._resized(f"peer lost mid-allreduce: {e}", cause=e)
+                raise
 
     def _tree_allreduce(self, arr: np.ndarray, op: str) -> np.ndarray:
         from .. import telemetry
@@ -418,6 +631,15 @@ class TrackerClient:
 
         fold = {"sum": np.add, "max": np.maximum, "min": np.minimum}[op]
         n, rank = self.world_size, self.rank
+        # the ring's bulk transfers are raw (headerless) byte streams,
+        # so the generation check happens ONCE up front: exchange gen
+        # ids around the ring (world == 2 collapses both directions
+        # onto one socket, which still works)
+        self.links[self.ring_next].send_int(self.gen)
+        peer_gen = self.links[self.ring_prev].recv_int()
+        if peer_gen != self.gen:
+            self._resized(f"stale-generation ring peer (gen {peer_gen}, "
+                          f"ours {self.gen})")
         out = arr.copy()
         flat = out.view(np.uint8).reshape(-1)
         item = out.itemsize
@@ -458,18 +680,24 @@ class TrackerClient:
         if self.world_size <= 1:
             return arr.copy()
         assert root == 0, "tree broadcast is rooted at rank 0"
+        self.check_resized()
         telemetry.record_event("barrier_enter", site="broadcast",
                                rank=self.rank, bytes=int(arr.nbytes))
         with telemetry.span("collective.broadcast", stage="collective",
                             args={"bytes": int(arr.nbytes),
                                   "rank": self.rank}):
-            children = [r for r in self.tree_nbrs if r != self.parent]
-            out = arr
-            if self.parent >= 0:
-                t0 = time.perf_counter()
-                out = self._recv_array(self.links[self.parent], arr)
-                telemetry.observe_duration("collective", "barrier_wait",
-                                           time.perf_counter() - t0)
-            for c in children:
-                self._send_array(self.links[c], out)
+            try:
+                children = [r for r in self.tree_nbrs if r != self.parent]
+                out = arr
+                if self.parent >= 0:
+                    t0 = time.perf_counter()
+                    out = self._recv_array(self.links[self.parent], arr)
+                    telemetry.observe_duration("collective", "barrier_wait",
+                                               time.perf_counter() - t0)
+                for c in children:
+                    self._send_array(self.links[c], out)
+            except OSError as e:
+                if self.elastic:
+                    self._resized(f"peer lost mid-broadcast: {e}", cause=e)
+                raise
         return out.copy() if out is arr else out

@@ -24,13 +24,22 @@ from .rendezvous import submit_job
 
 logger = logging.getLogger("dmlc_tpu.tracker")
 
-# env vars forwarded to remote tasks (reference ssh.py:26 plus JAX/TPU)
+# env vars forwarded to remote tasks (reference ssh.py:26 plus JAX/TPU
+# plus the elastic-world knobs — every worker must agree on them)
 PASS_ENVS = [
     "OMP_NUM_THREADS", "LD_LIBRARY_PATH", "PYTHONPATH", "DMLC_INTERFACE",
     "AWS_ACCESS_KEY_ID", "AWS_SECRET_ACCESS_KEY",
     "GOOGLE_APPLICATION_CREDENTIALS", "JAX_PLATFORMS", "XLA_FLAGS",
     "TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES",
+    "DMLC_ELASTIC", "DMLC_ELASTIC_GRACE_S",
+    "DMLC_ELASTIC_RESIZE_TIMEOUT_S",
 ]
+
+
+def _elastic() -> bool:
+    from ..base import get_env
+
+    return get_env("DMLC_ELASTIC", False)
 
 
 _postmortem_scan_lock = threading.Lock()
@@ -189,6 +198,15 @@ def submit_local(args):
             telemetry.record_event("task_budget_exhausted", role=role,
                                    task_id=task_id,
                                    attempts=args.max_attempts)
+            if _elastic():
+                # the world already resized past this task (or will at
+                # the grace window); the survivors carry the job, so a
+                # permanently-lost rank is not a job failure
+                logger.warning(
+                    "%s %d restart budget exhausted; elastic world "
+                    "resizes past it and the job continues", role,
+                    task_id)
+                return
             failures.append((role, task_id, args.max_attempts))
 
         for role, tid in _roles(n_workers, n_servers):
@@ -329,6 +347,18 @@ class GangScheduler:
             # (shared FS, or local-transport tests); remote-only dumps
             # stay on the failing host for manual collection
             collect_postmortems(self._collected, role, task_id)
+            if _elastic():
+                # elastic job: the WORLD survived this task's loss (the
+                # tracker shrinks past it at the grace window); the
+                # reschedule below is a gang-reschedule of the lost
+                # slice — it re-joins as a same-rank readmission inside
+                # grace, or as a scale-up generation after eviction,
+                # never by restarting the surviving world
+                telemetry.inc("elastic", "gang_reschedules")
+                telemetry.record_event(
+                    "elastic_gang_reschedule", role=role,
+                    task_id=task_id, host=host, attempt=attempt,
+                    exit=ret)
             if attempt + 1 < self.max_attempts:
                 # supervised restart onto a (possibly different) healthy
                 # host; surfaces as dmlc_resilience_task_restarts
@@ -340,6 +370,13 @@ class GangScheduler:
         telemetry.record_event("task_budget_exhausted", role=role,
                                task_id=task_id,
                                attempts=self.max_attempts)
+        if _elastic():
+            # elastic jobs outlive a permanently-lost slice: the world
+            # shrank past it at the grace window, survivors keep going
+            logger.warning(
+                "%s %d restart budget exhausted; elastic world resizes "
+                "past it and the job continues", role, task_id)
+            return
         raise RuntimeError(
             f"{role} {task_id} failed after {self.max_attempts} attempts")
 
@@ -469,6 +506,15 @@ def submit_tpu_vm(args):
     The TPU-native stand-in for the YARN backend: slice hosts come from
     --host-file (e.g. `gcloud compute tpus tpu-vm list` output); tasks are
     placed round-robin with attempt counters and failing-host blacklist.
+
+    With ``DMLC_ELASTIC=1`` a preempted slice no longer restarts the
+    world: the tracker runs elastic resize generations, so while this
+    scheduler gang-reschedules the lost tasks onto healthy hosts
+    (``dmlc_elastic_gang_reschedules``), the surviving ranks shrink to
+    N-1 at the grace window and keep training; the rescheduled tasks
+    re-join as a same-rank readmission (inside grace) or a scale-up
+    generation (after eviction).  Every resize lands in the tracker's
+    event ring and on /metrics as ``dmlc_elastic_*``.
     """
     hosts = read_host_file(args.host_file)
     command, remote_dir, cache_env, hosts = _stage_cache(args, hosts)

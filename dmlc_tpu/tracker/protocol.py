@@ -24,6 +24,31 @@ MAGIC = 0xFF99
 _INT = struct.Struct("@i")
 
 
+def recover_cmd(gen: int) -> str:
+    """Announce command for an elastic re-rendezvous: ``recover@<gen>``
+    where ``<gen>`` is the generation the worker's current rank belongs
+    to.  The base announce wire format (rank, world, jobid, cmd) is
+    untouched — the generation rides inside the free-form command
+    string, so the C-ABI workers (cpp/dmlc_collective.cc speaks the
+    plain ``start``/``recover`` protocol byte-for-byte) never see it."""
+    return f"recover@{int(gen)}"
+
+
+def parse_worker_cmd(cmd: str):
+    """``(base_cmd, announced_gen)`` for an announce command.
+
+    ``recover@3`` → ``("recover", 3)``; ``shutdown@3`` likewise (an
+    elastic worker's rank is meaningful only relative to a generation,
+    and a finishing worker may not have re-brokered into the newest
+    one).  Every other command (including plain ``recover``, which
+    means "my rank is from the CURRENT generation" — the reference
+    same-rank restart semantics) parses to ``(cmd, None)``."""
+    base, sep, gen = cmd.partition("@")
+    if sep and base in ("recover", "shutdown") and gen.isdigit():
+        return base, int(gen)
+    return cmd, None
+
+
 class FrameSocket:
     """int32/string framing over a TCP socket."""
 
@@ -110,6 +135,10 @@ def link_maps(n: int):
     After relabeling, ring_map[r] == ((r-1) % n, (r+1) % n); tree edges
     are expressed in the new labels.
     """
+    if n == 0:
+        # an elastic world can shrink to nothing (every member lost or
+        # cleanly finished); an empty overlay is valid, not an error
+        return {}, {}, {}
     tree, parent = binomial_tree(n)
     order = _dfs_ring(tree, parent, 0)
     assert len(order) == n

@@ -19,7 +19,8 @@ import time
 from typing import Dict, List, Optional
 
 from ..base import DMLCError
-from .protocol import MAGIC, FrameSocket, link_maps, resolve_ip
+from .protocol import MAGIC, FrameSocket, link_maps, parse_worker_cmd, \
+    resolve_ip
 
 logger = logging.getLogger("dmlc_tpu.tracker")
 
@@ -213,12 +214,33 @@ class RabitTracker:
     existing ``recover``/job-map path clears the flag and counts as
     ``resilience.worker_readmitted`` — the tracker's half of supervised
     restart (the launcher's restart budget owns re-running the task).
+
+    Elastic mode (``elastic=True`` or ``DMLC_ELASTIC=1``) makes the
+    world size a run-time variable via *resize generations*: a rank
+    still dead ``elastic_grace_s`` (``DMLC_ELASTIC_GRACE_S``, default 5)
+    past its death declaration is evicted — the tracker opens a new
+    generation, renumbering survivors into a dense ``[0, N')`` rank
+    space, rebuilding the tree+ring overlay, and re-brokering links as
+    each survivor re-enters rendezvous (``recover@<gen>`` announces are
+    translated through per-generation rank maps).  Scale-up arrives via
+    ``POST /resize`` on the metrics server (or implicitly: a join
+    announce against a full world grows it by one) and is pushed to
+    survivors as the generation id piggybacked on every heartbeat
+    reply.  Resizes are applied by the accept-loop thread at session
+    boundaries, so generation state needs no extra locking; every
+    resize lands in the event ring (``world_resized``) and on /metrics
+    (``dmlc_elastic_*``).
     """
+
+    #: generations of rank-translation history kept for stale recovers
+    MAX_RANK_MAP_HISTORY = 8
 
     def __init__(self, host_ip: str, n_workers: int,
                  port: int = 9091, port_end: int = 9999,
                  metrics_port: Optional[int] = None,
-                 miss_window_s: Optional[float] = None):
+                 miss_window_s: Optional[float] = None,
+                 elastic: Optional[bool] = None,
+                 elastic_grace_s: Optional[float] = None):
         family = socket.getaddrinfo(host_ip, None)[0][0]
         sock = socket.socket(family, socket.SOCK_STREAM)
         for p in range(port, port_end):
@@ -241,6 +263,30 @@ class RabitTracker:
             miss_window_s = float(
                 os.environ.get("DMLC_TRACKER_MISS_WINDOW_S", "0"))
         self.miss_window_s = miss_window_s
+        if elastic is None:
+            from ..base import get_env
+
+            elastic = get_env("DMLC_ELASTIC", False)
+        self.elastic = bool(elastic)
+        if elastic_grace_s is None:
+            elastic_grace_s = float(
+                os.environ.get("DMLC_ELASTIC_GRACE_S", "5"))
+        self.elastic_grace_s = elastic_grace_s
+        self.gen = 0
+        self._resize_lock = threading.Lock()
+        self._resize_req: Optional[Dict] = None
+        self._rank_maps: Dict[int, Dict[int, int]] = {}  # gen -> old->new
+        self._dead_since: Dict[int, float] = {}          # rank -> monotonic
+        self._evicted_total = 0
+        # accept-loop world state (mutated only on the accept thread)
+        self._world = n_workers
+        self._tree_map = None
+        self._parent_map = None
+        self._ring_map = None
+        self._job_map: Dict[str, int] = {}
+        self._todo: List[int] = []
+        self._pending: List["WorkerEntry"] = []
+        self._shutdown: Dict[int, "WorkerEntry"] = {}
         self.dead_ranks: set = set()
         self._finished_ranks: set = set()  # clean shutdowns: never "dead"
         self._dead_lock = threading.Lock()
@@ -260,7 +306,10 @@ class RabitTracker:
                 include_buckets=True))
         self.telemetry.extra_health = lambda: {
             "dead_ranks": self._dead_snapshot(),
-            "clock_offsets": self._clock_snapshot()}
+            "clock_offsets": self._clock_snapshot(),
+            "elastic": {"enabled": self.elastic, "gen": self.gen,
+                        "world": self._world,
+                        "evicted_total": self._evicted_total}}
         # flight recorder: workers ship span rings incrementally with
         # their heartbeats; /trace serves the clock-corrected merge,
         # with the tracker's own spans riding along as the reference row
@@ -282,7 +331,8 @@ class RabitTracker:
             self.metrics_server = TelemetryHTTPServer(
                 self.telemetry, host=host_ip, port=metrics_port,
                 trace_source=self.flight.to_chrome_trace,
-                anomaly_source=self.watchdog.report)
+                anomaly_source=self.watchdog.report,
+                resize_handler=self._http_resize)
             self.metrics_port = self.metrics_server.port
             logger.info("tracker /metrics + /trace + /anomalies on %s:%d",
                         host_ip, self.metrics_port)
@@ -294,48 +344,269 @@ class RabitTracker:
             "DMLC_TRACKER_PORT": str(self.port),
         }
 
+    def _fail(self, msg: str) -> DMLCError:
+        # protocol violations from REGISTERED workers corrupt the
+        # job's rank/link state: fail the whole tracker loudly (the
+        # reference dies on a bare assert here; we say why) — the
+        # launcher's retry machinery owns restarting the job
+        return DMLCError(f"tracker protocol violation: {msg}")
+
+    def _reject_announce(self, w: "WorkerEntry", why: str) -> None:
+        """A malformed announce (world_size mismatch, recover without a
+        rank, rank beyond the world, unknown command) is the announcing
+        CONNECTION's problem, not the job's: drop it, count it, keep
+        brokering.  The reference tracker dies on a bare assert here and
+        takes the whole accept loop — and every other worker — with it."""
+        from .. import telemetry
+
+        telemetry.inc("tracker", "rejected_announces")
+        telemetry.record_event("announce_rejected", host=w.host,
+                               cmd=w.cmd, rank=w.rank, why=why)
+        logger.warning("rejected %r announce from %s (rank %d): %s",
+                       w.cmd, w.host, w.rank, why)
+        w.sock.close()
+
+    def _broker(self, entry: "WorkerEntry", rank: int) -> None:
+        # a worker dying (or going silent past DMLC_TRACKER_TIMEOUT)
+        # mid-brokering leaves the overlay unbuildable: error out so
+        # join()/_await_job abort instead of hanging the whole gang.
+        # In elastic mode the job OUTLIVES individual workers: the
+        # half-brokered rank is declared dead instead (grace then
+        # shrinks the world past it) and the loop keeps serving.
+        try:
+            entry.assign_rank(rank, self._registry, self._tree_map,
+                              self._parent_map, self._ring_map)
+        except socket.timeout as e:
+            if self.elastic:
+                self._broker_casualty(entry, rank, f"went silent: {e}")
+                return
+            raise DMLCError(
+                f"worker rank {rank} ({entry.host}) went silent "
+                f"mid-brokering (DMLC_TRACKER_TIMEOUT="
+                f"{_sock_timeout()}s)") from e
+        except OSError as e:
+            if self.elastic:
+                self._broker_casualty(entry, rank, f"died: {e}")
+                return
+            raise DMLCError(
+                f"worker rank {rank} ({entry.host}) died "
+                f"mid-brokering: {e}") from e
+        self._entries[rank] = entry
+        if entry.jobid != "NULL":
+            self._job_map[entry.jobid] = rank
+        self._note_admitted(rank, entry.cmd)
+
+    def _broker_casualty(self, entry: "WorkerEntry", rank: int,
+                         why: str) -> None:
+        """Elastic-mode brokering failure: the rank is treated as a
+        fresh death (registry cull + dead flag), so the grace window
+        shrinks the world past it instead of the tracker dying."""
+        logger.warning("worker rank %d (%s) %s mid-brokering; declaring "
+                       "dead (elastic mode keeps serving)", rank,
+                       entry.host, why)
+        entry.sock.close()
+        self._registry.drop(rank)
+        self._declare_dead(rank, 0.0)
+
+    # ---- elastic resize machinery --------------------------------------
+    def request_resize(self, world: Optional[int] = None, remove=(),
+                       reason: str = "operator") -> int:
+        """Record a pending membership change; thread-safe.  The change
+        is APPLIED by the accept-loop thread at its next session
+        boundary (heartbeats arrive continuously, so that is prompt) —
+        resizing between sessions means generation state never needs a
+        lock against mid-brokering mutation.  Returns the current
+        generation (the resize, once applied, will be a later one)."""
+        from .. import telemetry
+
+        if not self.elastic:
+            raise RuntimeError(
+                "tracker is not elastic; start it with elastic=True or "
+                "DMLC_ELASTIC=1 to resize the world at run time")
+        remove = set(remove)
+        with self._resize_lock:
+            req = self._resize_req or {"world": None, "remove": set(),
+                                       "reasons": []}
+            if world is not None:
+                world = int(world)
+                req["world"] = max(world, req["world"] or 0)
+            req["remove"] |= remove
+            if reason not in req["reasons"]:
+                req["reasons"].append(reason)
+            self._resize_req = req
+        telemetry.record_event("resize_requested", world=world,
+                               remove=sorted(remove), reason=reason,
+                               gen=self.gen)
+        logger.info("resize requested (%s): world=%s remove=%s",
+                    reason, world, sorted(remove))
+        return self.gen
+
+    def _http_resize(self, doc: Dict) -> Dict:
+        """POST /resize handler: {'world': N} grows (or re-targets) the
+        world; survivors learn via the heartbeat generation piggyback."""
+        world = doc.get("world")
+        if world is not None:
+            world = int(world)
+            if not 0 < world <= 65536:
+                raise ValueError(f"world {world} out of range")
+        gen = self.request_resize(world=world,
+                                  reason=str(doc.get("reason", "operator")))
+        return {"requested": True, "gen": gen, "world_target": world,
+                "current_world": self._world}
+
+    def _apply_pending_resize(self) -> None:
+        """Accept-loop thread only: open a new generation if a resize
+        request is pending."""
+        if not self.elastic:
+            return
+        with self._resize_lock:
+            req, self._resize_req = self._resize_req, None
+        if req is None:
+            return
+        if self._tree_map is None:
+            # world not formed yet: just re-target the initial size
+            if req["world"]:
+                self._world = req["world"]
+                logger.info("pre-start resize: initial world now %d",
+                            self._world)
+            return
+        self._open_generation(req)
+
+    def _open_generation(self, req: Dict) -> None:
+        """Renumber survivors into a dense [0, N') rank space, rebuild
+        the overlay maps, and reset brokering state.  Survivors carry
+        their old rank into ``recover@<gen>`` announces and are
+        translated through ``_rank_maps``; new ranks (scale-up) fill
+        ``todo`` and are assigned to joining workers."""
+        from .. import telemetry
+
+        remove = set(req["remove"])
+        # a slot still in todo has no worker behind it: carrying it into
+        # the new generation would mint a phantom member that never
+        # heartbeats and never brokers, wedging everyone else's
+        # rendezvous.  Its expected joiner (if any) re-enters through
+        # the pending claim / implicit-grow paths instead.
+        unassigned = set(self._todo)
+        survivors = [r for r in range(self._world)
+                     if r not in remove and r not in self._shutdown
+                     and r not in unassigned]
+        target = req["world"] or len(survivors)
+        if target < len(survivors):
+            logger.warning(
+                "resize target %d below survivor count %d; clamping "
+                "(evicting live ranks needs them killed, not resized)",
+                target, len(survivors))
+            target = len(survivors)
+        # joiners parked in _pending keep their claim on a slot across
+        # the resize — without this a shrink that rebuilt todo empty
+        # would strand them forever (and their presence would suppress
+        # the implicit +1 grow for anyone after them)
+        target = max(target, len(survivors) + len(self._pending))
+        rank_map = {old: new for new, old in enumerate(survivors)}
+        self._rank_maps[self.gen] = rank_map
+        for g in list(self._rank_maps):
+            if g <= self.gen - self.MAX_RANK_MAP_HISTORY:
+                del self._rank_maps[g]
+        old_world, old_gen = self._world, self.gen
+        self.gen += 1
+        self._world = target
+        self._tree_map, self._parent_map, self._ring_map = \
+            link_maps(target)
+        self._todo = list(range(len(survivors), target))
+        self._job_map = {jid: rank_map[r]
+                         for jid, r in self._job_map.items()
+                         if r in rank_map}
+        self._shutdown = {}
+        # stale listeners and rendezvous sockets of the old generation
+        # must never be handed out as dial targets again
+        self._registry = AcceptRegistry()
+        for entry in self._entries.values():
+            entry.sock.close()
+        self._entries = {}
+        with self._dead_lock:
+            self._evicted_total += len(remove & self.dead_ranks)
+            # dead bookkeeping follows the renumbering too: a rank dead
+            # but still inside grace IS a survivor and keeps its flag
+            # under the new id; entries for removed ranks drop out (a
+            # stale old-generation id left behind would later evict
+            # whichever LIVE worker now holds that number)
+            self.dead_ranks = {rank_map[r] for r in self.dead_ranks
+                               if r in rank_map}
+            self._dead_since = {rank_map[r]: t
+                                for r, t in self._dead_since.items()
+                                if r in rank_map}
+            self._finished_ranks.clear()
+        # heartbeat bookkeeping follows the renumbering: a survivor's
+        # age must not be split between its old and new rank ids (the
+        # failure detector would re-declare phantom deaths)
+        self.telemetry.remap_ranks(rank_map)
+        for old, new in rank_map.items():
+            if old != new:
+                self.watchdog.drop(old)
+        for r in remove:
+            self.watchdog.drop(r)
+        telemetry.inc("elastic", "resizes_total")
+        telemetry.inc("elastic", "shrinks_total"
+                      if target < old_world else "grows_total")
+        telemetry.set_gauge("elastic", "generation", self.gen)
+        telemetry.set_gauge("elastic", "world_size", self._world)
+        telemetry.record_event(
+            "world_resized", gen=self.gen, world=target,
+            old_world=old_world, survivors=len(survivors),
+            removed=sorted(remove), new_slots=len(self._todo),
+            reasons=req["reasons"])
+        logger.info(
+            "@tracker generation %d -> %d: world %d -> %d (%d survivors "
+            "renumbered, %d removed, %d new slots) [%s]", old_gen,
+            self.gen, old_world, target, len(survivors), len(remove),
+            len(self._todo), ",".join(req["reasons"]))
+        if self._pending and self._todo \
+                and len(self._pending) >= len(self._todo):
+            self._assign_pending()
+
+    def _translate_rank(self, rank: int, announced_gen: int) -> Optional[int]:
+        """Chase a rank from ``announced_gen`` through the per-generation
+        maps into the current generation; None once it left membership
+        (evicted while away — the caller re-admits it as a scale-up
+        join) or the history no longer reaches back that far."""
+        if announced_gen > self.gen:
+            return None
+        for g in range(announced_gen, self.gen):
+            m = self._rank_maps.get(g)
+            if m is None or rank not in m:
+                return None
+            rank = m[rank]
+        return rank
+
+    def _gen_doc(self) -> str:
+        with self._dead_lock:
+            n_dead = len(self.dead_ranks)
+        return json.dumps({"gen": self.gen, "world": self._world,
+                           "elastic": self.elastic, "dead": n_dead})
+
     def _accept_loop(self, n_workers: int) -> None:
-        shutdown: Dict[int, WorkerEntry] = {}
-        registry = AcceptRegistry()
-        self._registry = registry
-        job_map: Dict[str, int] = {}
-        pending: List[WorkerEntry] = []
-        tree_map = None
-        parent_map = ring_map = None
-        todo: List[int] = []
+        self._world = n_workers
+        self._registry = AcceptRegistry()
 
-        def fail(msg: str) -> DMLCError:
-            # protocol violations from REGISTERED workers corrupt the
-            # job's rank/link state: fail the whole tracker loudly (the
-            # reference dies on a bare assert here; we say why) — the
-            # launcher's retry machinery owns restarting the job
-            return DMLCError(f"tracker protocol violation: {msg}")
-
-        def broker(entry: "WorkerEntry", rank: int) -> None:
-            # a worker dying (or going silent past DMLC_TRACKER_TIMEOUT)
-            # mid-brokering leaves the overlay unbuildable: error out so
-            # join()/_await_job abort instead of hanging the whole gang
-            try:
-                entry.assign_rank(rank, registry, tree_map, parent_map,
-                                  ring_map)
-            except socket.timeout as e:
-                raise DMLCError(
-                    f"worker rank {rank} ({entry.host}) went silent "
-                    f"mid-brokering (DMLC_TRACKER_TIMEOUT="
-                    f"{_sock_timeout()}s)") from e
-            except OSError as e:
-                raise DMLCError(
-                    f"worker rank {rank} ({entry.host}) died "
-                    f"mid-brokering: {e}") from e
-            self._entries[rank] = entry
-            self._note_admitted(rank, entry.cmd)
-
-        while len(shutdown) != n_workers:
+        while True:
+            if self._tree_map is not None \
+                    and len(self._shutdown) >= self._world:
+                break  # every member of the current generation finished
             fd, addr = self.sock.accept()
+            # apply membership changes at the session boundary, BEFORE
+            # this session is interpreted: a joiner's announce must see
+            # the grown world, and the heartbeat reply below must carry
+            # the post-resize generation
+            self._apply_pending_resize()
             try:
                 w = WorkerEntry(fd, addr)
                 if w.cmd == "print":
                     logger.info("%s", w.sock.recv_str().strip())
+                    continue
+                if w.cmd == "gen":
+                    # elastic status probe: resize()'s settle-wait polls
+                    # this until the membership change lands
+                    w.sock.send_str(self._gen_doc())
                     continue
                 if w.cmd == "metrics":
                     # telemetry heartbeat: latest snapshot for this rank
@@ -356,6 +627,14 @@ class RabitTracker:
                         logger.warning(
                             "rank %d sent malformed telemetry: %r",
                             w.rank, e)
+                        doc = None
+                    # the reply carries the current generation — the
+                    # scale-up push channel (a grow resize severs no
+                    # links, so the heartbeat is how survivors learn);
+                    # sent even for malformed beats so the sender's
+                    # reply read never stalls on its own bad payload
+                    w.sock.send_int(self.gen)
+                    if doc is None:
                         continue
                     self.telemetry.update(w.rank, doc)
                     trace = doc.get("trace")
@@ -383,71 +662,130 @@ class RabitTracker:
                                addr[0], e)
                 fd.close()
                 continue
-            if w.cmd == "shutdown":
-                if w.rank < 0 or w.rank >= n_workers or w.rank in shutdown:
-                    raise fail(f"shutdown from rank {w.rank} "
-                               f"(out of range for {n_workers} workers, "
-                               f"already shut down, or never assigned)")
-                if w.rank in registry:
-                    raise fail(f"rank {w.rank} shut down while peers "
-                               f"still expect to dial it")
-                shutdown[w.rank] = w
+            base_cmd, announced_gen = parse_worker_cmd(w.cmd)
+            if base_cmd == "shutdown":
+                rank = w.rank
+                if self.elastic and announced_gen is not None \
+                        and announced_gen < self.gen:
+                    # the finishing worker may never have re-brokered
+                    # into the newest generation: chase its rank through
+                    # the maps so the RIGHT completion slot is marked
+                    rank = self._translate_rank(w.rank, announced_gen)
+                    if rank is None:
+                        logger.info(
+                            "shutdown from evicted rank %d of gen %d "
+                            "(%s); no longer a member — ignored",
+                            w.rank, announced_gen, w.host)
+                        w.sock.close()
+                        continue
+                if rank < 0 or rank >= self._world \
+                        or rank in self._shutdown:
+                    raise self._fail(
+                        f"shutdown from rank {rank} "
+                        f"(out of range for {self._world} workers, "
+                        f"already shut down, or never assigned)")
+                if rank in self._registry:
+                    raise self._fail(f"rank {rank} shut down while "
+                                     f"peers still expect to dial it")
+                self._shutdown[rank] = w
                 # a cleanly-finished rank leaves the failure detector's
                 # watch: its heartbeat age grows forever from here, and
                 # flagging it dead would corrupt the death counters
-                self._entries.pop(w.rank, None)
+                self._entries.pop(rank, None)
                 with self._dead_lock:
-                    self._finished_ranks.add(w.rank)
-                    self.dead_ranks.discard(w.rank)
-                logger.debug("shutdown from rank %d", w.rank)
+                    self._finished_ranks.add(rank)
+                    self.dead_ranks.discard(rank)
+                logger.debug("shutdown from rank %d", rank)
                 continue
-            if w.cmd not in ("start", "recover"):
-                raise fail(f"unknown command {w.cmd!r} from {w.host}")
-            if tree_map is None:
-                if w.cmd != "start":
-                    raise fail(f"{w.cmd!r} from {w.host} before any "
-                               f"worker started")
-                if w.world_size > 0:
-                    n_workers = w.world_size
-                tree_map, parent_map, ring_map = link_maps(n_workers)
-                todo = list(range(n_workers))
-            elif w.world_size not in (-1, n_workers):
-                raise fail(f"{w.host} announced world_size "
-                           f"{w.world_size} != {n_workers}")
-            if w.cmd == "recover" and w.rank < 0:
-                raise fail(f"recover without a rank from {w.host}")
-
-            rank = w.decide_rank(job_map)
-            # a client-supplied rank must be a real slot — an out-of-range
-            # value would KeyError deep inside the topology send instead
-            # of dying diagnosably here
-            if rank >= n_workers:
-                raise fail(f"{w.cmd!r} from {w.host} announced rank "
-                           f"{rank} >= world size {n_workers}")
-            if rank == -1:
-                if not todo:
-                    raise fail(f"{w.host} asked for a rank but all "
-                               f"{n_workers} slots are assigned")
-                pending.append(w)
-                if len(pending) == len(todo):
-                    pending.sort(key=lambda x: x.host)  # locality
-                    for p in pending:
-                        rank = todo.pop(0)
-                        if p.jobid != "NULL":
-                            job_map[p.jobid] = rank
-                        broker(p, rank)
-                        logger.debug("assigned rank %d to %s", p.rank, p.host)
-                    pending = []
-                if not todo:
-                    logger.info("@tracker all %d workers started", n_workers)
-                    self.start_time = time.time()
-            else:
-                broker(w, rank)
-                logger.debug("%s from rank %d", w.cmd, w.rank)
+            self._handle_announce(w)
         self.end_time = time.time()
         if self.start_time is not None:
             logger.info("@tracker %.3f secs between start and finish",
                         self.end_time - self.start_time)
+
+    def _handle_announce(self, w: "WorkerEntry") -> None:
+        """One start/recover announce: resolve the rank (translating
+        elastic recovers across generations), then broker."""
+        cmd, announced_gen = parse_worker_cmd(w.cmd)
+        if cmd not in ("start", "recover"):
+            self._reject_announce(w, "unknown command")
+            return
+        if self._tree_map is None:
+            if cmd != "start":
+                self._reject_announce(w, "recover before any worker "
+                                      "started")
+                return
+            if w.world_size > 0:
+                self._world = w.world_size
+            self._tree_map, self._parent_map, self._ring_map = \
+                link_maps(self._world)
+            self._todo = list(range(self._world))
+        elif w.world_size not in (-1, self._world):
+            self._reject_announce(
+                w, f"announced world_size {w.world_size} != "
+                   f"{self._world}")
+            return
+        if cmd == "recover" and w.rank < 0:
+            self._reject_announce(w, "recover without a rank")
+            return
+
+        if self.elastic and announced_gen is not None \
+                and announced_gen < self.gen:
+            # an elastic re-rendezvous carrying a rank from an older
+            # generation: chase it through the rank maps; a worker that
+            # was evicted while away re-joins as a scale-up
+            rank = self._translate_rank(w.rank, announced_gen)
+            if rank is None:
+                logger.info(
+                    "rank %d of gen %d (%s) no longer a member; "
+                    "re-admitting as a scale-up join", w.rank,
+                    announced_gen, w.host)
+                rank = -1
+        else:
+            rank = w.decide_rank(self._job_map)
+        # a client-supplied rank must be a real slot — an out-of-range
+        # value would KeyError deep inside the topology send instead
+        # of dying diagnosably here
+        if rank >= self._world:
+            self._reject_announce(
+                w, f"rank {rank} >= world size {self._world}")
+            return
+        if rank == -1:
+            if not self._todo and not self._pending:
+                if not self.elastic:
+                    raise self._fail(
+                        f"{w.host} asked for a rank but all "
+                        f"{self._world} slots are assigned")
+                # elastic: a join against a full world is an implicit
+                # scale-up generation of +1 (a gang-rescheduled slice
+                # arriving after its old ranks were evicted lands here)
+                self.request_resize(world=self._world + 1, reason="join")
+                self._apply_pending_resize()
+            self._pending.append(w)
+            if self._todo and len(self._pending) >= len(self._todo):
+                self._assign_pending()
+        else:
+            self._broker(w, rank)
+            logger.debug("%s from rank %d", w.cmd, rank)
+
+    def _assign_pending(self) -> None:
+        """Batch-assign waiting joiners to the open ``todo`` slots
+        (sorted by host for locality).  A resize can leave more joiners
+        waiting than slots; the overflow stays pending for the next
+        generation."""
+        self._pending.sort(key=lambda x: x.host)
+        assign, self._pending = (self._pending[:len(self._todo)],
+                                 self._pending[len(self._todo):])
+        for p in assign:
+            rank = self._todo.pop(0)
+            if p.jobid != "NULL":
+                self._job_map[p.jobid] = rank
+            self._broker(p, rank)
+            logger.debug("assigned rank %d to %s", p.rank, p.host)
+        if not self._todo:
+            logger.info("@tracker all %d workers started", self._world)
+            if self.start_time is None:
+                self.start_time = time.time()
 
     # ---- heartbeat-driven failure detection ----------------------------
     def _dead_snapshot(self) -> List[int]:
@@ -463,6 +801,7 @@ class RabitTracker:
         with self._dead_lock:
             was_dead = rank in self.dead_ranks
             self.dead_ranks.discard(rank)
+            self._dead_since.pop(rank, None)
             self._finished_ranks.discard(rank)
         self.telemetry.touch(rank)  # restart the miss-window clock
         if was_dead:
@@ -480,6 +819,9 @@ class RabitTracker:
             if rank in self.dead_ranks:
                 return
             self.dead_ranks.add(rank)
+            # elastic grace clock: a rank still dead this long past the
+            # declaration is evicted via a shrink generation
+            self._dead_since.setdefault(rank, time.monotonic())
         telemetry.inc("resilience", "worker_declared_dead")
         telemetry.record_event("declared_dead", rank=rank,
                                age_s=round(age, 3),
@@ -505,6 +847,11 @@ class RabitTracker:
             for rank, age in self.telemetry.ranks().items():
                 if rank in finished:
                     continue  # clean shutdown: silence is expected
+                if rank >= self._world:
+                    # a pre-resize rank id lingering in the heartbeat
+                    # store (its owner now beats under a renumbered
+                    # rank): never a death, just stale bookkeeping
+                    continue
                 if age > self.miss_window_s:
                     self._declare_dead(rank, age)
                 else:
@@ -512,6 +859,20 @@ class RabitTracker:
                     # before its brokering finished): clear the flag
                     with self._dead_lock:
                         self.dead_ranks.discard(rank)
+                        self._dead_since.pop(rank, None)
+            if self.elastic:
+                now = time.monotonic()
+                with self._dead_lock:
+                    expired = sorted(
+                        r for r, t in self._dead_since.items()
+                        if r in self.dead_ranks
+                        and now - t > self.elastic_grace_s)
+                if expired:
+                    # still dead past the grace window: evict via a
+                    # shrink generation (idempotent until applied by
+                    # the accept loop at its next session)
+                    self.request_resize(remove=expired,
+                                        reason="grace_expired")
 
     def start(self, n_workers: Optional[int] = None) -> None:
         n = self.n_workers if n_workers is None else n_workers

@@ -14,6 +14,15 @@ whatever mesh the local devices support (1 chip → trivial mesh; under a
 multi-chip runtime the same code shards over dp).  Run
 `python examples/train_lm_recordio.py --make-data out.rec` first to
 generate a synthetic shard.
+
+Elastic mode (DMLC_ELASTIC=1 under an elastic tracker, ckpt_dir
+required): each process joins the tracker world, partitions data by
+(rank, world) through the byte-range contract, averages gradients over
+the host collective, and SURVIVES the world resizing mid-run — a
+collective interrupted by a preempted peer raises WorldResized; the
+loop re-enters rendezvous (possibly under a new rank), repartitions the
+feed in place, restores params+optimizer state from the last COMMITTED
+checkpoint onto the mesh, and keeps training without a process restart.
 """
 
 import os
@@ -44,6 +53,98 @@ def make_data(path, n_records=2048, seed=0):
     print(f"wrote {n_records} records to {path}")
 
 
+def _elastic_enabled() -> bool:
+    from dmlc_tpu.base import get_env
+
+    return get_env("DMLC_ELASTIC", False) \
+        and bool(os.environ.get("DMLC_TRACKER_URI"))
+
+
+class _ElasticTrainer:
+    """The elastic half of the loop: tracker membership, host-collective
+    gradient averaging, and the WorldResized recovery protocol."""
+
+    def __init__(self, manager, mesh):
+        from dmlc_tpu.telemetry import HeartbeatSender
+        from dmlc_tpu.tracker.client import TrackerClient
+
+        self.client = TrackerClient().start()
+        self.hb = HeartbeatSender(self.client, interval=1.0)
+        self.manager = manager
+        self.mesh = mesh
+
+    @property
+    def world(self):
+        return (self.client.rank, self.client.world_size)
+
+    @staticmethod
+    def _flatten(tree):
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        flat = np.concatenate(
+            [np.asarray(v, np.float64).ravel() for v in leaves])
+        return leaves, treedef, flat
+
+    @staticmethod
+    def _unflatten(leaves, treedef, flat):
+        import jax
+
+        out, pos = [], 0
+        for v in leaves:
+            n = int(np.size(v))
+            out.append(flat[pos: pos + n].reshape(np.shape(v)).astype(
+                np.asarray(v).dtype))
+            pos += n
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def allreduce_grads(self, grads, loss: float):
+        """Average gradients (and the loss) over the elastic world via
+        the host collective; raises WorldResized on membership change."""
+        leaves, treedef, flat = self._flatten(grads)
+        flat = np.concatenate([flat.astype(np.float32),
+                               np.asarray([loss], np.float32)])
+        total = self.client.allreduce_sum(flat)
+        total /= float(self.client.world_size)
+        return (self._unflatten(leaves, treedef, total[:-1]),
+                float(total[-1]))
+
+    def resync(self, feed, params, opt_state, done: int):
+        """WorldResized recovery: re-enter rendezvous, repartition the
+        feed, then make rank 0's state authoritative everywhere.
+
+        Rank 0 restores the last COMMITTED checkpoint when one exists
+        (its own memory otherwise — early preemptions before the first
+        save) and broadcasts (params, opt_state, step) to the new
+        world: the interrupted step's allreduce may have completed on
+        some ranks and not others, so replicas are one step apart
+        until this broadcast realigns them.  May itself raise
+        WorldResized (another resize mid-recovery); callers loop."""
+        self.client.resize()
+        feed.resize(self.world)
+        if self.client.rank == 0:
+            step, restored = self.manager.restore_latest(
+                {"params": params, "opt": opt_state}, mesh=self.mesh)
+            if step is not None:
+                params, opt_state, done = (restored["params"],
+                                           restored["opt"], step)
+        leaves, treedef, flat = self._flatten((params, opt_state))
+        if self.client.rank != 0:
+            flat = np.zeros_like(flat)  # shapes/dtypes are uniform
+        flat = self.client.broadcast(
+            np.concatenate([flat, [float(done)]]), root=0)
+        params, opt_state = self._unflatten(leaves, treedef, flat[:-1])
+        done = int(flat[-1])
+        print(f"resized into rank {self.client.rank}/"
+              f"{self.client.world_size} (gen {self.client.gen}); "
+              f"resynced at step {done}", flush=True)
+        return params, opt_state, done
+
+    def close(self):
+        self.hb.close()
+        self.client.shutdown()
+
+
 def main():
     if len(sys.argv) < 2:
         print("usage: train_lm_recordio.py (<shards.rec> [steps] "
@@ -63,15 +164,20 @@ def main():
     from dmlc_tpu import metrics
     from dmlc_tpu.feed import recordio_feed
     from dmlc_tpu.models import (TransformerConfig, init_params,
-                                 make_train_step)
+                                 make_train_step, unsharded_loss)
     from dmlc_tpu.parallel import build_mesh
     from dmlc_tpu.parallel.collectives import initialize_distributed
+    from dmlc_tpu.tracker.client import WorldResized
 
-    # under dmlc-submit with world > 1 this joins every launched process
-    # into one jax.distributed job (coordinator allocated by the tracker,
-    # DMLC_JAX_COORD_URI/PORT) so jax.devices() below spans the whole pod;
-    # no-op single-process
-    initialize_distributed()
+    elastic = _elastic_enabled()
+    if not elastic:
+        # under dmlc-submit with world > 1 this joins every launched
+        # process into one jax.distributed job (coordinator allocated by
+        # the tracker, DMLC_JAX_COORD_URI/PORT) so jax.devices() below
+        # spans the whole pod; no-op single-process.  Elastic mode keeps
+        # processes independent instead — jax.distributed gangs cannot
+        # resize, the host collective can.
+        initialize_distributed()
 
     n_dev = len(jax.devices())
     mesh = build_mesh(n_dev, dp=n_dev, sp=1, tp=1, pp=1, ep=1)
@@ -82,13 +188,20 @@ def main():
         else "float32",
         remat=True)
     params = init_params(jax.random.PRNGKey(0), cfg, n_stages=1)
-    # ledger=False: this loop drives the step ledger ITSELF so the
-    # batch fetch lands inside the step window — feed.wait is then
-    # billed to the step's feed-wait share (make_train_step's built-in
-    # ledger would only see the compute half)
-    step, init_state = make_train_step(
-        mesh, cfg, optimizer=optax.adamw(3e-4), ledger=False)
-    opt_state = init_state(params)
+    optimizer = optax.adamw(3e-4)
+    if not elastic:
+        # ledger=False: this loop drives the step ledger ITSELF so the
+        # batch fetch lands inside the step window — feed.wait is then
+        # billed to the step's feed-wait share (make_train_step's
+        # built-in ledger would only see the compute half)
+        step, init_state = make_train_step(
+            mesh, cfg, optimizer=optimizer, ledger=False)
+        opt_state = init_state(params)
+    else:
+        # elastic mode shards nothing across processes at the XLA layer
+        # (a jax.distributed gang cannot resize); every process holds a
+        # full replica and the host collective averages gradients
+        opt_state = optimizer.init(params)
 
     manager = start_at = None
     if ckpt_dir:
@@ -103,57 +216,108 @@ def main():
             params, opt_state = restored["params"], restored["opt"]
             print(f"resumed from step {start_at}", flush=True)
 
+    trainer = None
+    if elastic:
+        assert manager is not None, \
+            "elastic mode needs a checkpoint dir (resize restores from it)"
+        trainer = _ElasticTrainer(manager, mesh)
+        # elastic gradient path: local loss+grads, host-allreduce mean,
+        # then a jitted optax apply — the data plane XLA cannot resize,
+        # the host collective can
+        loss_and_grad = jax.jit(jax.value_and_grad(
+            lambda p, ids, labels: unsharded_loss(p, ids, labels, cfg)))
+
+        @jax.jit
+        def apply_update(p, o, grads):
+            updates, o2 = optimizer.update(grads, o, p)
+            return optax.apply_updates(p, updates), o2
+
     per_part = 8  # records per partition per batch
     feed = recordio_feed(uri, mesh, batch_records=per_part,
-                         max_bytes=(SEQ + 1) * 4)
+                         max_bytes=(SEQ + 1) * 4,
+                         world=trainer.world if trainer else None)
     from dmlc_tpu import telemetry
     from dmlc_tpu.models import train_flops_per_token
 
     telemetry.declare_flops_per_token(train_flops_per_token(cfg, SEQ))
     done = 0
+    # non-elastic: done counts NEW steps this process trains; saves are
+    # numbered base+done so a resumed run never re-commits old numbers
+    base = start_at or 0
     # data fast-forward: this feed is deterministic, so replaying
     # start_at batches puts the stream exactly where the saved run was
     # (a demo-grade skip — it pays full pipeline + transfer cost per
     # discarded batch; production resumes would skip at the host side)
     skip = start_at or 0
+    if elastic and start_at:
+        # elastic restores are repartition points, not replays: done is
+        # the ABSOLUTE step (base stays 0) and the stream restarts
+        done = start_at
+        skip = 0
     feed_iter = iter(feed)
+    loss = float("nan")
+    need_resync = False
     while done < steps:
         # the step ledger opens BEFORE the batch pull so the feed's
         # consumer wait (feed.wait span) is billed to this step's
         # feed-wait share; skipped/tail batches abandon the open step
         # (the next step_begin unwinds it) and are never recorded
         telemetry.step_begin()
-        batch = next(feed_iter, None)
-        if batch is None:
-            feed_iter = iter(feed)  # next epoch
+        try:
+            if trainer is not None:
+                if need_resync:
+                    params, opt_state, done = trainer.resync(
+                        feed, params, opt_state, done)
+                    feed_iter = iter(feed)
+                    need_resync = False
+                trainer.client.check_resized()
+            batch = next(feed_iter, None)
+            if batch is None:
+                feed_iter = iter(feed)  # next epoch
+                continue
+            # epoch-tail short batch: its zero-padded rows would train on
+            # all-zero tokens (garbage targets).  Dropped BEFORE the
+            # resume fast-forward so never-trained batches don't consume
+            # `skip` — step count stays equal to trained-batch count
+            if np.any(np.asarray(batch["length"]) == 0):
+                continue
+            if skip > 0:
+                skip -= 1
+                continue
+            with metrics.annotate("train_step"):
+                data = jnp.asarray(batch["data"])
+                toks = jax.lax.bitcast_convert_type(
+                    data.reshape(-1, SEQ + 1, 4), jnp.int32
+                ).reshape(-1, SEQ + 1)
+                ids, labels = toks[:, :-1], toks[:, 1:]
+                if trainer is None:
+                    params, opt_state, loss = step(params, opt_state, ids,
+                                                   labels)
+                else:
+                    local_loss, grads = loss_and_grad(params, ids, labels)
+                    grads, loss = trainer.allreduce_grads(
+                        grads, float(local_loss))
+                    params, opt_state = apply_update(params, opt_state,
+                                                     grads)
+        except WorldResized:
+            # recovery happens at the top of the next iteration (the
+            # resync broadcast can itself hit another resize, and it
+            # must run under this same handler)
+            need_resync = True
             continue
-        # epoch-tail short batch: its zero-padded rows would train on
-        # all-zero tokens (garbage targets).  Dropped BEFORE the
-        # resume fast-forward so never-trained batches don't consume
-        # `skip` — step count stays equal to trained-batch count
-        if np.any(np.asarray(batch["length"]) == 0):
-            continue
-        if skip > 0:
-            skip -= 1
-            continue
-        with metrics.annotate("train_step"):
-            data = jnp.asarray(batch["data"])
-            toks = jax.lax.bitcast_convert_type(
-                data.reshape(-1, SEQ + 1, 4), jnp.int32
-            ).reshape(-1, SEQ + 1)
-            ids, labels = toks[:, :-1], toks[:, 1:]
-            params, opt_state, loss = step(params, opt_state, ids,
-                                           labels)
         telemetry.step_end(tokens=int(ids.size))
         done += 1
         if done % 10 == 0 or done == 1:
             print(f"step {done}: loss {float(loss):.4f}", flush=True)
-        if manager is not None and done % 20 == 0:
-            manager.save((start_at or 0) + done,
-                         {"params": params, "opt": opt_state})
-    if manager is not None and done % 20 != 0:  # periodic save already hit
-        manager.save((start_at or 0) + done,
-                     {"params": params, "opt": opt_state})
+        if manager is not None and done % 20 == 0 \
+                and (trainer is None or trainer.client.rank == 0):
+            manager.save(base + done, {"params": params, "opt": opt_state})
+    if manager is not None and done % 20 != 0 \
+            and (trainer is None or trainer.client.rank == 0):
+        # periodic save already hit on multiples of 20
+        manager.save(base + done, {"params": params, "opt": opt_state})
+    if trainer is not None:
+        trainer.close()
     snap = metrics.snapshot()
     fed = snap.get("feed", {})
     led = telemetry.ledger().summary()

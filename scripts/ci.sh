@@ -40,6 +40,12 @@
 #      nonzero per-user tokens/s asserted, /metrics scraped for the
 #      dmlc_serving_* + step-ledger families, BENCH_serving.json
 #      emitted with p50/p99 TTFT, tokens/s/user, and decode MFU
+#  10. elastic smoke: fault-injected kill mid-training on an elastic
+#      tracker — world shrinks 3->2 past the grace window (survivors
+#      resize in place: re-rendezvous, repartition, checkpoint
+#      restore; no process restart), POST /resize + a fresh worker
+#      grows it back to 3, and the per-step loss trajectory matches an
+#      uninterrupted oracle; dmlc_elastic_* asserted on /metrics
 #
 # Usage: scripts/ci.sh [pytest-args...]
 set -u
@@ -159,5 +165,9 @@ echo "== stage 9: serving smoke (continuous batching + paged KV) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/serving_smoke.py \
     || { echo "FAIL: serving smoke"; exit 1; }
 
+echo "== stage 10: elastic smoke (kill -> shrink -> grow -> parity) =="
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/elastic_smoke.py \
+    || { echo "FAIL: elastic smoke"; exit 1; }
+
 echo "== CI OK (native=$NATIVE_OK tsan=$TSAN_OK asan=$ASAN_OK" \
-     "telemetry=1 chaos=1 perf=1 serving=1) =="
+     "telemetry=1 chaos=1 perf=1 serving=1 elastic=1) =="

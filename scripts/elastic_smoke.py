@@ -1,0 +1,350 @@
+#!/usr/bin/env python
+"""Elastic world resize end-to-end smoke (ci.sh stage 10).
+
+A real 3-worker elastic job trains a deterministic full-batch linear
+model over a RecordIO dataset partitioned by the byte-range contract,
+with gradients averaged over the host collective.  The harness then
+walks the whole elastic lifecycle:
+
+  1. rank 2 is fault-injected to DIE (os._exit, no shutdown) at a fixed
+     step; the tracker's failure detector declares it dead and the
+     elastic grace window EVICTS it — a new generation renumbers the
+     survivors into a dense [0, 2) world;
+  2. the survivors' in-flight allreduce raises the retryable
+     WorldResized (no hang), they re-enter rendezvous with resize(),
+     repartition their data for num_parts=2, restore the last COMMITTED
+     checkpoint, and keep training — NO survivor process restart;
+  3. the harness then POSTs /resize {"world": 3} and launches a fresh
+     worker: the tracker opens a scale-up generation, survivors learn
+     it from the heartbeat piggyback, and the world grows back to 3;
+  4. the job runs to completion; because the full-batch gradient is
+     world-size invariant, rank 0's per-step loss trajectory must match
+     an uninterrupted single-process oracle within float tolerance;
+  5. /metrics shows dmlc_elastic_resizes_total >= 2 (the shrink and the
+     grow), the death counter, and /healthz reports the final
+     generation and world size.
+
+Exit 0 on success, 1 with a diagnostic on any failure.
+"""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_FEATURES = 7
+N_RECORDS = 240
+STEPS = 40
+KILL_STEP = 8
+GROW_AT = 20
+LR = 0.05
+PACE_S = 0.2           # per-step pacing so the failure detector can act
+MISS_WINDOW_S = 1.0
+GRACE_S = 1.0
+
+
+def fail(msg: str) -> None:
+    print(f"elastic smoke FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+# ---------------------------------------------------------------------------
+# shared model math (worker and oracle run the SAME code)
+# ---------------------------------------------------------------------------
+
+def make_data(path: str):
+    import numpy as np
+
+    from dmlc_tpu.io.recordio import RecordIOWriter
+    from dmlc_tpu.io.stream import Stream
+
+    rng = np.random.default_rng(42)
+    w_true = rng.standard_normal(N_FEATURES)
+    X = rng.standard_normal((N_RECORDS, N_FEATURES))
+    y = X @ w_true + 0.01 * rng.standard_normal(N_RECORDS)
+    with Stream.create(path, "w") as s:
+        w = RecordIOWriter(s)
+        for i in range(N_RECORDS):
+            row = np.concatenate([X[i], [y[i]]]).astype(np.float32)
+            w.write_record(row.tobytes())
+    return X.astype(np.float64), y.astype(np.float64)
+
+
+def grad_and_loss(X, y, w):
+    """Per-partition sums: [grad(7), count, loss_sum] — summing these
+    over any partitioning of the rows gives the identical full-batch
+    quantities, which is what makes the loss trajectory world-size
+    invariant."""
+    import numpy as np
+
+    r = X @ w - y
+    return np.concatenate([X.T @ r, [float(len(y)), 0.5 * float(r @ r)]])
+
+
+def oracle_trajectory(X, y):
+    import numpy as np
+
+    w = np.zeros(N_FEATURES)
+    losses = {}
+    for step in range(1, STEPS + 1):
+        tot = grad_and_loss(X, y, w)
+        w = w - LR * tot[:N_FEATURES] / tot[N_FEATURES]
+        losses[step] = tot[N_FEATURES + 1] / tot[N_FEATURES]
+    return losses, w
+
+
+# ---------------------------------------------------------------------------
+# worker (run as: elastic_smoke.py --worker)
+# ---------------------------------------------------------------------------
+
+def worker_main() -> None:
+    import numpy as np
+
+    from dmlc_tpu.checkpoint import CheckpointManager
+    from dmlc_tpu.io import input_split
+    from dmlc_tpu.resilience import fault_point
+    from dmlc_tpu.telemetry import HeartbeatSender
+    from dmlc_tpu.tracker.client import TrackerClient, WorldResized
+
+    uri = os.environ["ELASTIC_SMOKE_DATA"]
+    log_path = os.environ["ELASTIC_SMOKE_LOG"]
+    manager = CheckpointManager(os.environ["ELASTIC_SMOKE_CKPT"],
+                                max_to_keep=3)
+
+    def load_part(rank, world):
+        split = input_split.create(uri, rank, world, "recordio",
+                                   threaded=False)
+        rows = [np.frombuffer(bytes(r), np.float32).astype(np.float64)
+                for r in split]
+        split.close()
+        if not rows:
+            return (np.zeros((0, N_FEATURES)), np.zeros(0))
+        m = np.stack(rows)
+        return m[:, :N_FEATURES], m[:, N_FEATURES]
+
+    c = TrackerClient().start()
+    hb = HeartbeatSender(c, interval=0.2)
+    hb.send_once()
+    w = np.zeros(N_FEATURES)
+    step = 0
+    X, y = load_part(c.rank, c.world_size)
+    need_sync = True  # initial broadcast aligns (w, step) everywhere
+    while step < STEPS:
+        try:
+            if need_sync:
+                # rank 0's state is authoritative: the survivors' (or a
+                # fresh joiner's) in-memory state may be mid-step, so
+                # rank 0 restores the last COMMITTED checkpoint and
+                # broadcasts (w, step) to the new world
+                if c.rank == 0:
+                    got_step, restored = manager.restore_latest(
+                        {"w": w})
+                    if got_step is not None:
+                        w, step = restored["w"].astype(np.float64), \
+                            got_step
+                    payload = np.concatenate([w, [float(step)]])
+                else:
+                    payload = np.zeros(N_FEATURES + 1)
+                payload = c.broadcast(payload, root=0)
+                w, step = payload[:N_FEATURES], int(payload[N_FEATURES])
+                X, y = load_part(c.rank, c.world_size)
+                need_sync = False
+            c.check_resized()
+            fault_point("elastic.step", rank=c.rank, step=step + 1)
+            tot = c.allreduce_sum(grad_and_loss(X, y, w))
+        except WorldResized:
+            c.resize()
+            need_sync = True
+            continue
+        w = w - LR * tot[:N_FEATURES] / tot[N_FEATURES]
+        loss = tot[N_FEATURES + 1] / tot[N_FEATURES]
+        step += 1
+        if c.rank == 0:
+            manager.save(step, {"w": w})
+            with open(log_path, "a") as f:
+                f.write(f"{step} {loss:.12e}\n")
+        time.sleep(PACE_S)
+    if c.rank == 0:
+        np.save(os.environ["ELASTIC_SMOKE_WOUT"], w)
+    with open(os.environ["ELASTIC_SMOKE_DONE"] + f".{os.getpid()}",
+              "w") as f:
+        f.write(f"rank={c.rank} world={c.world_size} gen={c.gen}")
+    hb.close()
+    c.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+
+def _healthz(port):
+    return json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/healthz", timeout=10).read())
+
+
+def _metric(body: str, name: str) -> float:
+    m = re.search(rf'^{name}{{rank="tracker"}} ([0-9.eE+-]+)$', body,
+                  re.MULTILINE)
+    return float(m.group(1)) if m else 0.0
+
+
+def _log_steps(log_path):
+    losses = {}
+    if os.path.exists(log_path):
+        for line in open(log_path):
+            parts = line.split()
+            if len(parts) == 2:
+                losses[int(parts[0])] = float(parts[1])  # last wins
+    return losses
+
+
+def _spawn_worker(env_base, task_id, fault_spec=None):
+    env = dict(env_base, DMLC_TASK_ID=str(task_id))
+    if fault_spec:
+        env["DMLC_FAULT_SPEC"] = fault_spec
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker"], env=env)
+
+
+def main() -> None:
+    import numpy as np
+
+    from dmlc_tpu import telemetry
+    from dmlc_tpu.tracker import RabitTracker
+
+    telemetry.reset()
+    with tempfile.TemporaryDirectory() as tmp:
+        data = os.path.join(tmp, "data.rec")
+        X, y = make_data(data)
+        oracle, oracle_w = oracle_trajectory(X, y)
+
+        tracker = RabitTracker("127.0.0.1", 3, metrics_port=0,
+                               miss_window_s=MISS_WINDOW_S, elastic=True,
+                               elastic_grace_s=GRACE_S)
+        tracker.start(3)
+        log_path = os.path.join(tmp, "loss.log")
+        env = dict(
+            os.environ,
+            DMLC_TRACKER_URI="127.0.0.1",
+            DMLC_TRACKER_PORT=str(tracker.port),
+            DMLC_CLIENT_OP_TIMEOUT_S="60",
+            ELASTIC_SMOKE_DATA=data,
+            ELASTIC_SMOKE_CKPT=os.path.join(tmp, "ckpt"),
+            ELASTIC_SMOKE_LOG=log_path,
+            ELASTIC_SMOKE_WOUT=os.path.join(tmp, "w_final.npy"),
+            ELASTIC_SMOKE_DONE=os.path.join(tmp, "done"),
+        )
+        env.pop("DMLC_FAULT_SPEC", None)
+        spec = f"elastic.step@rank:2@step:{KILL_STEP}=kill:137:1"
+        procs = [_spawn_worker(env, i, fault_spec=spec) for i in range(3)]
+
+        # --- phase 1: the kill shrinks the world to 2 -----------------
+        deadline = time.monotonic() + 120
+        while True:
+            if time.monotonic() > deadline:
+                fail("world never shrank to 2 after the injected kill")
+            hz = _healthz(tracker.metrics_port)
+            if hz["elastic"]["gen"] >= 1 and hz["elastic"]["world"] == 2:
+                break
+            if tracker.error is not None:
+                fail(f"tracker died: {tracker.error}")
+            time.sleep(0.2)
+        print(f"elastic smoke: shrink OK (gen {hz['elastic']['gen']}, "
+              f"world 2) — survivors keep training", flush=True)
+
+        # training must CONTINUE in the shrunken world
+        deadline = time.monotonic() + 120
+        while max(_log_steps(log_path), default=0) < GROW_AT:
+            if time.monotonic() > deadline:
+                fail(f"training stalled after shrink at step "
+                     f"{max(_log_steps(log_path), default=0)}")
+            time.sleep(0.2)
+
+        # --- phase 2: grow back to 3 via POST /resize + fresh worker --
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{tracker.metrics_port}/resize",
+            data=json.dumps({"world": 3}).encode(),
+            headers={"Content-Type": "application/json"})
+        doc = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        if not doc.get("requested"):
+            fail(f"/resize rejected: {doc}")
+        procs.append(_spawn_worker(env, 3))
+
+        deadline = time.monotonic() + 120
+        while True:
+            if time.monotonic() > deadline:
+                fail("world never grew back to 3")
+            hz = _healthz(tracker.metrics_port)
+            if hz["elastic"]["world"] == 3 and hz["elastic"]["gen"] >= 2:
+                break
+            time.sleep(0.2)
+        print(f"elastic smoke: grow OK (gen {hz['elastic']['gen']}, "
+              f"world 3)", flush=True)
+
+        # --- completion -----------------------------------------------
+        exits = []
+        deadline = time.monotonic() + 180
+        for p in procs:
+            exits.append(p.wait(timeout=max(1, deadline -
+                                            time.monotonic())))
+        # rank assignment is arrival-order among same-host workers, so
+        # identify the killed one by its exit code: exactly one of the
+        # original three died with the injected 137, everyone else —
+        # the two survivors and the scale-up joiner — finished clean
+        # having never been restarted
+        if sorted(exits[:3]) != [0, 0, 137]:
+            fail(f"initial workers exited {exits[:3]} (want exactly one "
+                 f"injected 137 and two clean survivors)")
+        if exits[3] != 0:
+            fail(f"scale-up joiner exited {exits[3]}")
+        tracker.join(timeout=60)
+
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{tracker.metrics_port}/metrics",
+            timeout=10).read().decode()
+
+        # --- loss-trajectory parity with the uninterrupted oracle -----
+        losses = _log_steps(log_path)
+        missing = [s for s in range(1, STEPS + 1) if s not in losses]
+        if missing:
+            fail(f"loss log missing steps {missing[:10]}")
+        worst = max(abs(losses[s] - oracle[s])
+                    / max(abs(oracle[s]), 1e-12)
+                    for s in range(1, STEPS + 1))
+        if worst > 1e-6:
+            fail(f"loss trajectory diverged from the oracle: max rel "
+                 f"err {worst:.3e}")
+        # different partitionings reassociate the float sums, so exact
+        # equality is not expected — but anything beyond reduction-order
+        # noise is a real divergence
+        w_final = np.load(env["ELASTIC_SMOKE_WOUT"])
+        if not np.allclose(w_final, oracle_w, rtol=1e-6, atol=1e-9):
+            fail(f"final weights diverged: {w_final} vs {oracle_w}")
+        print(f"elastic smoke: loss trajectory matches oracle over "
+              f"{STEPS} steps (max rel err {worst:.2e})", flush=True)
+        tracker.close()
+
+    for name, want in (("dmlc_elastic_resizes_total", 2),
+                       ("dmlc_elastic_shrinks_total", 1),
+                       ("dmlc_elastic_grows_total", 1),
+                       ("dmlc_resilience_worker_declared_dead", 1)):
+        got = _metric(body, name)
+        if got < want:
+            fail(f"/metrics {name} = {got} (< {want}); payload:\n"
+                 f"{body[:3000]}")
+        print(f"elastic smoke: {name} = {got:g} OK", flush=True)
+    print("elastic smoke OK")
+
+
+if __name__ == "__main__":
+    if "--worker" in sys.argv:
+        worker_main()
+    else:
+        main()

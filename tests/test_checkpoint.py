@@ -1,5 +1,7 @@
 """Sharded checkpoint round trips: local and gs://, full model state."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -215,3 +217,93 @@ def test_restore_dot_prefixed_leaf_keys_do_not_collide(tmp_path):
     got = restore_pytree(uri, tree)
     np.testing.assert_array_equal(got["w"], tree["w"])
     np.testing.assert_array_equal(got["w.scale"], tree["w.scale"])
+
+
+# ---------------------------------------------------------------------------
+# Crash-consistent commits (ISSUE 7): shards first, manifest last + atomic
+# ---------------------------------------------------------------------------
+
+def test_torn_save_is_skipped_by_restore_latest(tmp_path):
+    """A step dir with shards but no committed manifest (preemption
+    mid-save) must be invisible: latest_step/restore_latest land on the
+    previous committed step, whatever LATEST claims."""
+    from dmlc_tpu.checkpoint import CheckpointManager
+
+    base = str(tmp_path / "mgr")
+    mgr = CheckpointManager(base, max_to_keep=5)
+    t1 = {"w": np.full((4,), 1.0, np.float32)}
+    t2 = {"w": np.full((4,), 2.0, np.float32)}
+    mgr.save(1, t1)
+    mgr.save(2, t2)
+    # simulate the preemption: step 3's shards landed, manifest did not,
+    # but LATEST was (wrongly) advanced by some other failure mode
+    import shutil
+    shutil.copytree(tmp_path / "mgr" / "step_00000002",
+                    tmp_path / "mgr" / "step_00000003")
+    (tmp_path / "mgr" / "step_00000003" / "manifest.json").unlink()
+    (tmp_path / "mgr" / "LATEST").write_text("3")
+
+    assert mgr.latest_step() == 2
+    step, got = mgr.restore_latest(t1)
+    assert step == 2
+    np.testing.assert_array_equal(got["w"], t2["w"])
+
+
+def test_fault_injected_commit_preserves_previous_step(tmp_path,
+                                                       monkeypatch):
+    """Kill the save at the manifest-commit fault point: the interrupted
+    step never becomes restorable and the previous one survives."""
+    from dmlc_tpu.checkpoint import CheckpointManager
+    from dmlc_tpu.resilience import reset_injector
+
+    base = str(tmp_path / "mgr2")
+    mgr = CheckpointManager(base)
+    t1 = {"w": np.full((4,), 1.0, np.float32)}
+    mgr.save(7, t1)
+    monkeypatch.setenv("DMLC_FAULT_SPEC", "checkpoint.commit=error")
+    reset_injector()
+    with pytest.raises(ConnectionError):  # FaultInjected's torn-I/O shape
+        mgr.save(8, {"w": np.full((4,), 8.0, np.float32)})
+    monkeypatch.setenv("DMLC_FAULT_SPEC", "")
+    reset_injector()
+    assert mgr.latest_step() == 7
+    step, got = mgr.restore_latest(t1)
+    assert step == 7
+    np.testing.assert_array_equal(got["w"], t1["w"])
+    # the next successful save supersedes the torn dir and retention
+    # clears the litter
+    mgr.save(9, {"w": np.full((4,), 9.0, np.float32)})
+    assert mgr.latest_step() == 9
+    import os
+    assert not os.path.isdir(os.path.join(base, "step_00000008"))
+
+
+def test_manifest_commit_leaves_no_temp(tmp_path):
+    """The atomic rename path must not leave manifest temp files."""
+    uri = str(tmp_path / "atomic")
+    save_pytree(uri, {"w": np.zeros((2,), np.float32)})
+    names = os.listdir(uri)
+    assert "manifest.json" in names
+    assert not [n for n in names if ".tmp." in n]
+
+
+def test_retention_counts_committed_only(tmp_path):
+    """A torn (manifest-less) newer dir must not push a committed step
+    out of the max_to_keep window."""
+    from dmlc_tpu.checkpoint import CheckpointManager
+
+    base = str(tmp_path / "mgr3")
+    mgr = CheckpointManager(base, max_to_keep=2)
+    for step in (1, 2):
+        mgr.save(step, {"w": np.full((2,), float(step), np.float32)})
+    # torn future dir (in-flight save of another process)
+    torn = tmp_path / "mgr3" / "step_00000005"
+    torn.mkdir()
+    (torn / "w.0-2").write_bytes(b"\0" * 8)
+    mgr.save(3, {"w": np.full((2,), 3.0, np.float32)})
+    assert mgr.latest_step() == 3
+    # committed steps 2 and 3 kept; 1 retired; torn future dir untouched
+    names = sorted(os.listdir(base))
+    assert "step_00000001" not in names
+    assert {"step_00000002", "step_00000003",
+            "step_00000005"} <= set(names)

@@ -1,0 +1,474 @@
+"""Elastic world resize: the tracker's resize generations, the client's
+WorldResized/resize() path, stale-generation frame rejection, and the
+scale-up join flows (ISSUE 7 tentpole)."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dmlc_tpu import telemetry
+from dmlc_tpu.tracker import RabitTracker, TrackerClient, WorldResized
+
+MISS = 0.5    # failure-detector miss window
+GRACE = 0.5   # elastic eviction grace past the death declaration
+
+
+def _elastic_tracker(n, metrics_port=None):
+    t = RabitTracker("127.0.0.1", n, metrics_port=metrics_port,
+                     miss_window_s=MISS, elastic=True,
+                     elastic_grace_s=GRACE)
+    t.start(n)
+    return t
+
+
+def _client(tracker, jobid):
+    return TrackerClient("127.0.0.1", tracker.port, jobid=jobid)
+
+
+class _Worker(threading.Thread):
+    """One in-thread elastic worker: rendezvous + manual heartbeats on a
+    side thread (so the tracker's failure detector sees it alive)."""
+
+    def __init__(self, tracker, jobid, fn):
+        super().__init__(daemon=True)
+        self.tracker = tracker
+        self.jobid = jobid
+        self.fn = fn
+        self.result = None
+        self.error = None
+        self._hb_stop = threading.Event()
+        self._hb = None
+
+    def _beat_loop(self, client):
+        while not self._hb_stop.wait(0.1):
+            try:
+                client.send_metrics('{"counters": {}}')
+            except OSError:
+                return
+
+    def run(self):
+        try:
+            c = _client(self.tracker, self.jobid).start()
+            self._hb = threading.Thread(target=self._beat_loop, args=(c,),
+                                        daemon=True)
+            self._hb.start()
+            self.result = self.fn(c)
+        except BaseException as e:  # noqa: BLE001 - surfaced by the test
+            self.error = e
+        finally:
+            self._hb_stop.set()
+
+
+def test_gen_query_and_defaults():
+    """Every rendezvous learns the generation; non-elastic trackers
+    report elastic=False and collectives keep OSError semantics."""
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+    c = _client(tracker, "solo").start()
+    assert c.gen == 0 and c.elastic is False
+    c.shutdown()
+    tracker.join(timeout=15)
+    tracker.close()
+
+    tracker = _elastic_tracker(1)
+    c = _client(tracker, "solo").start()
+    assert c.gen == 0 and c.elastic is True
+    c.shutdown()
+    tracker.join(timeout=15)
+    tracker.close()
+
+
+def test_shrink_on_death_renumbers_survivors():
+    """Kill one of three ranks (no shutdown, heartbeats stop): the
+    tracker declares it dead, the grace window evicts it, survivors'
+    collectives raise WorldResized, resize() renumbers them into a
+    dense [0, 2) world, and a post-resize allreduce sums correctly —
+    with no survivor process/thread restart."""
+    telemetry.reset()
+    tracker = _elastic_tracker(3)
+    dead_rank = {}
+    barrier = threading.Barrier(3)
+
+    def fn(c):
+        first = float(c.allreduce_sum(
+            np.asarray([c.rank + 1.0], np.float64))[0])
+        assert first == 6.0
+        barrier.wait(timeout=20)
+        if c.rank == 2:
+            # preempted: vanish without a shutdown handshake
+            dead_rank[c.jobid] = c.rank
+            c._links_down()
+            return ("died", c.rank)
+        old_rank, old_gen = c.rank, c.gen
+        # keep folding until the world changes under us; the dead
+        # peer's closed links (or our own cascade) surface in-bound
+        for _ in range(200):
+            try:
+                c.allreduce_sum(np.ones(4, np.float64))
+                time.sleep(0.05)
+            except WorldResized:
+                break
+        else:
+            raise AssertionError("never saw WorldResized after the kill")
+        c.resize()
+        assert c.gen > old_gen
+        assert c.world_size == 2
+        post = float(c.allreduce_sum(
+            np.asarray([c.rank + 1.0], np.float64))[0])
+        assert post == 3.0  # dense [0,2) renumbering
+        out = ("survived", old_rank, c.rank, c.gen)
+        c.shutdown()
+        return out
+
+    workers = [_Worker(tracker, f"el{i}", fn) for i in range(3)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join(60)
+    errors = [w.error for w in workers if w.error is not None]
+    assert not errors, errors
+    tracker.join(timeout=30)
+    tracker.close()
+    survived = sorted(w.result for w in workers
+                      if w.result and w.result[0] == "survived")
+    died = [w.result for w in workers if w.result and w.result[0] == "died"]
+    assert len(survived) == 2 and len(died) == 1
+    new_ranks = sorted(r[2] for r in survived)
+    assert new_ranks == [0, 1]
+    assert tracker.gen >= 1
+    counters = telemetry.snapshot()["counters"]
+    assert counters["elastic"]["resizes_total"] >= 1
+    assert counters["elastic"]["shrinks_total"] >= 1
+
+
+def test_grow_via_request_resize_and_join():
+    """Operator scale-up: request_resize(world=3) + a fresh joiner.
+    The survivors learn the new generation from the heartbeat reply
+    (resize_pending), resize into the grown world, and a 3-way
+    allreduce completes."""
+    telemetry.reset()
+    tracker = _elastic_tracker(2)
+    grown = threading.Event()
+
+    def fn(c):
+        assert float(c.allreduce_sum(
+            np.asarray([1.0], np.float64))[0]) == 2.0
+        grown.wait(timeout=20)
+        # heartbeat piggyback flips resize_pending; the next collective
+        # raises instead of folding a stale 2-rank world
+        for _ in range(200):
+            try:
+                c.check_resized()
+                c.send_metrics('{"counters": {}}')
+                time.sleep(0.05)
+            except WorldResized:
+                break
+        else:
+            raise AssertionError("grow never reached the survivor")
+        c.resize()
+        assert c.world_size == 3
+        out = float(c.allreduce_sum(
+            np.asarray([c.rank + 1.0], np.float64))[0])
+        assert out == 6.0
+        c.shutdown()
+        return ("ok", c.rank)
+
+    workers = [_Worker(tracker, f"gw{i}", fn) for i in range(2)]
+    for w in workers:
+        w.start()
+    time.sleep(0.5)  # let the initial world form
+    tracker.request_resize(world=3, reason="test_grow")
+    grown.set()
+
+    def joiner(c):
+        assert c.world_size == 3
+        out = float(c.allreduce_sum(
+            np.asarray([c.rank + 1.0], np.float64))[0])
+        assert out == 6.0
+        c.shutdown()
+        return ("ok", c.rank)
+
+    j = _Worker(tracker, "gw2", joiner)
+    j.start()
+    for w in workers + [j]:
+        w.join(60)
+    errors = [w.error for w in workers + [j] if w.error is not None]
+    assert not errors, errors
+    ranks = sorted(w.result[1] for w in workers + [j])
+    assert ranks == [0, 1, 2]
+    tracker.join(timeout=30)
+    tracker.close()
+    counters = telemetry.snapshot()["counters"]
+    assert counters["elastic"]["grows_total"] >= 1
+
+
+def test_bare_join_grows_world_by_one():
+    """A join announce against a full elastic world is an implicit
+    scale-up generation of +1 (the gang-rescheduled-slice path)."""
+    tracker = _elastic_tracker(1)
+    c0 = _client(tracker, "bj0").start()
+    assert c0.world_size == 1
+    hb_stop = threading.Event()
+
+    def beat():
+        while not hb_stop.wait(0.1):
+            try:
+                c0.send_metrics('{"counters": {}}')
+            except OSError:
+                return
+
+    hb = threading.Thread(target=beat, daemon=True)
+    hb.start()
+    done = {}
+
+    def join_late():
+        c1 = _client(tracker, "bj1").start(world_size=-1)
+        done["rank"] = c1.rank
+        done["world"] = c1.world_size
+        out = c1.allreduce_sum(np.asarray([c1.rank + 1.0], np.float64))
+        done["sum"] = float(out[0])
+        c1.shutdown()
+
+    t = threading.Thread(target=join_late, daemon=True)
+    t.start()
+    # c0 discovers the grow via its heartbeat piggyback
+    deadline = time.monotonic() + 20
+    while not c0.resize_pending:
+        assert time.monotonic() < deadline, "grow never announced"
+        time.sleep(0.05)
+    with pytest.raises(WorldResized):
+        c0.check_resized()
+    c0.resize()
+    assert c0.world_size == 2
+    out = float(c0.allreduce_sum(
+        np.asarray([c0.rank + 1.0], np.float64))[0])
+    assert out == 3.0
+    c0.shutdown()
+    t.join(30)
+    hb_stop.set()
+    assert done == {"rank": 1, "world": 2, "sum": 3.0}
+    tracker.join(timeout=30)
+    tracker.close()
+
+
+def test_stale_generation_frame_rejected():
+    """A frame stamped with another generation must raise WorldResized
+    on the receiver instead of being folded into the reduction."""
+    tracker = _elastic_tracker(2)
+    results = {}
+    ready = threading.Barrier(2)
+
+    def fn_sender(c):
+        ready.wait(timeout=20)
+        peer = next(iter(c.links))
+        c.gen += 7  # forge a stale/future generation
+        try:
+            c._send_array(c.links[peer], np.ones(2, np.float64))
+        except OSError:
+            pass  # receiver tore the link down mid-send: the cascade
+        return "sent"
+
+    def fn_receiver(c):
+        ready.wait(timeout=20)
+        peer = next(iter(c.links))
+        with pytest.raises(WorldResized, match="stale-generation"):
+            c._recv_array(c.links[peer], np.ones(2, np.float64))
+        results["links_after"] = len(c.links)
+        return "rejected"
+
+    w0 = _Worker(tracker, "sg0", lambda c: (fn_sender if c.rank == 0
+                                            else fn_receiver)(c))
+    w1 = _Worker(tracker, "sg1", lambda c: (fn_sender if c.rank == 0
+                                            else fn_receiver)(c))
+    w0.start()
+    w1.start()
+    w0.join(30)
+    w1.join(30)
+    assert not w0.error and not w1.error, (w0.error, w1.error)
+    # the receiver tore down its links as part of the resize cascade
+    assert results["links_after"] == 0
+    tracker.close()
+
+
+def test_http_resize_endpoint():
+    """POST /resize on the metrics server records a grow request; a
+    non-elastic tracker answers 409."""
+    tracker = _elastic_tracker(1, metrics_port=0)
+    c = _client(tracker, "hr0").start()
+    body = json.dumps({"world": 2}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{tracker.metrics_port}/resize", data=body,
+        headers={"Content-Type": "application/json"})
+    doc = json.loads(urllib.request.urlopen(req, timeout=10).read())
+    assert doc["requested"] is True and doc["world_target"] == 2
+
+    def join_late():
+        c1 = _client(tracker, "hr1").start(world_size=-1)
+        c1.shutdown()
+
+    t = threading.Thread(target=join_late, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 20
+    while not c.resize_pending:
+        assert time.monotonic() < deadline, "resize never applied"
+        try:
+            c.send_metrics('{"counters": {}}')
+        except OSError:
+            pass
+        time.sleep(0.05)
+    c.resize()
+    assert c.world_size == 2
+    c.shutdown()
+    t.join(30)
+    tracker.join(timeout=30)
+    tracker.close()
+
+    plain = RabitTracker("127.0.0.1", 1, metrics_port=0)
+    plain.start(1)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{plain.metrics_port}/resize", data=b"{}",
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 409
+    plain.close()
+
+
+def test_late_replacement_joins_as_scale_up():
+    """A rank evicted past grace whose process finally comes back
+    (recover@old-gen) is re-admitted as a scale-up join with a fresh
+    rank — the gang-rescheduled slice, not a world restart."""
+    tracker = _elastic_tracker(2)
+
+    def fn(c):
+        if c.rank == 1:
+            c._links_down()
+            return ("died", c.rank, c.gen)
+        for _ in range(200):
+            try:
+                c.allreduce_sum(np.ones(2, np.float64))
+                time.sleep(0.05)
+            except WorldResized:
+                break
+        c.resize()
+        assert c.world_size == 1 and c.rank == 0
+        return ("survived", c.rank, c.gen, c)
+
+    workers = [_Worker(tracker, f"lr{i}", fn) for i in range(2)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join(60)
+    assert not any(w.error for w in workers), [w.error for w in workers]
+    survivor = next(w.result for w in workers
+                    if w.result[0] == "survived")
+    c0 = survivor[3]
+    hb_stop = threading.Event()
+
+    def beat():
+        while not hb_stop.wait(0.1):
+            try:
+                c0.send_metrics('{"counters": {}}')
+            except OSError:
+                return
+
+    threading.Thread(target=beat, daemon=True).start()
+    # the dead rank's process reappears long after eviction, announcing
+    # its stale generation-0 identity
+    late = _client(tracker, "lr-late")
+    late.rank = 1   # its old rank in gen 0
+    done = {}
+
+    def come_back():
+        late.gen = 0
+        late.resize(timeout_s=30)
+        done["rank"] = late.rank
+        done["world"] = late.world_size
+        late.shutdown()
+
+    t = threading.Thread(target=come_back, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 20
+    while not c0.resize_pending:
+        assert time.monotonic() < deadline, "late join never grew world"
+        time.sleep(0.05)
+    c0.resize()
+    assert c0.world_size == 2
+    c0.shutdown()
+    t.join(30)
+    hb_stop.set()
+    assert done["world"] == 2 and done["rank"] == 1
+    tracker.join(timeout=30)
+    tracker.close()
+
+
+def test_launcher_budget_exhaustion_not_fatal_in_elastic(monkeypatch):
+    """A permanently-lost task (restart budget exhausted) fails the job
+    in a fixed-size world but NOT in an elastic one — the world resized
+    past it and the survivors carry the job."""
+    from dmlc_tpu.tracker.launch import GangScheduler
+
+    calls = []
+
+    def runner(host, role, task_id, env):
+        calls.append(host)
+        return 137  # every attempt dies (preempted capacity gone)
+
+    monkeypatch.delenv("DMLC_ELASTIC", raising=False)
+    sched = GangScheduler(["h0", "h1"], runner, max_attempts=2)
+    with pytest.raises(RuntimeError, match="failed after 2 attempts"):
+        sched.run_task("worker", 1, {}, "tpu-vm")
+
+    monkeypatch.setenv("DMLC_ELASTIC", "1")
+    sched2 = GangScheduler(["h0", "h1"], runner, max_attempts=2)
+    sched2.run_task("worker", 1, {}, "tpu-vm")  # must NOT raise
+    counters = telemetry.snapshot()["counters"]
+    assert counters["elastic"]["gang_reschedules"] >= 1
+
+
+def test_stale_generation_shutdown_translated():
+    """A survivor that finishes WITHOUT re-brokering into the newest
+    generation shuts down with a stale rank: the gen-stamped shutdown
+    is translated into the right completion slot (and an evicted
+    worker's shutdown is ignored) — the job completes instead of the
+    tracker dying or a live worker's slot being marked finished."""
+    tracker = _elastic_tracker(3)
+
+    def fn(c):
+        if c.rank == 0:
+            # preempted: rank 0's death forces a renumbering of 1,2
+            c._links_down()
+            return ("died",)
+        old = c.rank
+        for _ in range(200):
+            try:
+                c.allreduce_sum(np.ones(2, np.float64))
+                time.sleep(0.05)
+            except WorldResized:
+                break
+        c.resize()
+        assert c.world_size == 2
+        if old == 1:
+            # this survivor finishes and shuts down under its NEW rank
+            c.shutdown()
+            return ("new-gen-shutdown", old, c.rank)
+        # this survivor pretends it never learned of the resize: it
+        # announces its OLD rank with the OLD generation stamp
+        c.rank, c.gen = old, 0
+        c.shutdown()
+        return ("stale-shutdown", old)
+
+    workers = [_Worker(tracker, f"ss{i}", fn) for i in range(3)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join(60)
+    assert not any(w.error for w in workers), [w.error for w in workers]
+    # the stale gen-0 rank 2 translated to gen-1 rank 1: quorum filled,
+    # the accept loop exits cleanly
+    tracker.join(timeout=30)
+    tracker.close()

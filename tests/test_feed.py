@@ -4,7 +4,7 @@
 import numpy as np
 import pytest
 
-from dmlc_tpu.feed import (libsvm_feed, pack_rowblock,
+from dmlc_tpu.feed import (DeviceFeed, libsvm_feed, pack_rowblock,
                            recordio_feed, recordio_packed_feed)
 from dmlc_tpu.parallel import build_mesh
 
@@ -445,3 +445,114 @@ def test_pack_rowblock_vectorized_matches_reference_loop():
     np.testing.assert_array_equal(out["value"], want_v)
     np.testing.assert_array_equal(out["index"], want_i)
     np.testing.assert_array_equal(out["mask"], want_m)
+
+
+# ---------------------------------------------------------------------------
+# Elastic feed resize (ISSUE 7): shrink mid-epoch, exactly-once coverage
+# ---------------------------------------------------------------------------
+
+def _make_indexed_rec(tmp_path, n=60, body_bytes=24, name="el.rec"):
+    """RecordIO file whose record i's first 4 bytes encode i."""
+    from dmlc_tpu.io.recordio import RecordIOWriter
+    from dmlc_tpu.io.stream import Stream
+
+    rng = np.random.default_rng(11)
+    path = str(tmp_path / name)
+    with Stream.create(path, "w") as s:
+        w = RecordIOWriter(s)
+        for i in range(n):
+            body = (np.int32(i).tobytes()
+                    + rng.integers(0, 256, body_bytes - 4,
+                                   dtype=np.uint8).tobytes())
+            w.write_record(body)
+    return path
+
+
+def _drain_ids(feed, max_batches=None):
+    """Record ids seen in one full (or truncated) epoch of the feed."""
+    ids = []
+    for k, b in enumerate(feed):
+        data = np.asarray(b["data"])
+        length = np.asarray(b["length"])
+        for row, ln in zip(data, length):
+            if ln > 0:
+                ids.append(int(np.frombuffer(row[:4].tobytes(),
+                                             np.int32)[0]))
+        if max_batches is not None and k + 1 >= max_batches:
+            feed.close()
+            break
+    return ids
+
+
+def test_feed_world_partitions_cover_exactly(tmp_path):
+    """world=(rank, W): each rank's feed serves its byte-range part;
+    the union over ranks is every record exactly once."""
+    path = _make_indexed_rec(tmp_path)
+    mesh1 = build_mesh(1, dp=1, sp=1, tp=1, pp=1, ep=1)
+    seen = []
+    for rank in range(3):
+        feed = recordio_feed(path, mesh1, batch_records=4, max_bytes=32,
+                             world=(rank, 3))
+        seen.extend(_drain_ids(feed))
+    assert sorted(seen) == list(range(60))
+    assert len(seen) == len(set(seen))
+
+
+def test_feed_resize_shrink_mid_epoch_exactly_once(tmp_path):
+    """Shrink 3 -> 2 mid-epoch: the abandoned partial epoch is
+    superseded; the FIRST full epoch after resize() covers every record
+    of the new partition exactly once — no loss, no dup across the
+    generation boundary."""
+    path = _make_indexed_rec(tmp_path)
+    mesh1 = build_mesh(1, dp=1, sp=1, tp=1, pp=1, ep=1)
+    feeds = [recordio_feed(path, mesh1, batch_records=4, max_bytes=32,
+                           world=(r, 3)) for r in range(3)]
+    # rank 0 and 1 consume part of an epoch; rank 2 is then "preempted"
+    _drain_ids(feeds[0], max_batches=2)
+    _drain_ids(feeds[1], max_batches=1)
+    feeds[2].close()
+    # survivors resize in place to the dense 2-rank world
+    feeds[0].resize((0, 2))
+    feeds[1].resize((1, 2))
+    assert feeds[0].world == (0, 2) and feeds[1].world == (1, 2)
+    post = _drain_ids(feeds[0]) + _drain_ids(feeds[1])
+    assert sorted(post) == list(range(60))
+    assert len(post) == len(set(post))
+    # and the feeds stay multi-epoch after a resize
+    again = _drain_ids(feeds[0]) + _drain_ids(feeds[1])
+    assert sorted(again) == sorted(post)
+
+
+def test_feed_resize_grow_and_determinism(tmp_path):
+    """Grow 2 -> 3 and re-shrink: every world's epoch coverage equals
+    the deterministic byte-range contract (two independently built
+    feeds of the same (rank, W) see identical record streams)."""
+    path = _make_indexed_rec(tmp_path)
+    mesh1 = build_mesh(1, dp=1, sp=1, tp=1, pp=1, ep=1)
+    feed = recordio_feed(path, mesh1, batch_records=4, max_bytes=32,
+                         world=(0, 2))
+    first = _drain_ids(feed)
+    feed.resize((1, 3))
+    grown = _drain_ids(feed)
+    fresh = recordio_feed(path, mesh1, batch_records=4, max_bytes=32,
+                          world=(1, 3))
+    assert grown == _drain_ids(fresh)
+    feed.resize((0, 2))
+    assert _drain_ids(feed) == first
+
+
+def test_feed_resize_requires_builder(tmp_path):
+    """Feeds built from explicit part_sources cannot resize."""
+    from dmlc_tpu.base import DMLCError
+
+    mesh1 = build_mesh(1, dp=1, sp=1, tp=1, pp=1, ep=1)
+
+    def factory():
+        def it():
+            yield {"x": np.zeros(4, np.float32)}
+        return it()
+
+    feed = DeviceFeed(mesh1, [factory])
+    with pytest.raises(DMLCError, match="source_builder"):
+        feed.resize((0, 1))
+    feed.close()

@@ -499,3 +499,74 @@ def test_builtin_network_protocols_resolve():
     for proto in ("hdfs://nn/path", "azure://c/b", "http://h/p",
                   "gs://b/k", "s3://b/k"):
         assert FileSystem.get_instance(URI(proto)) is not None
+
+
+# ---------- elastic repartition contract (ISSUE 7) ----------------------
+
+@pytest.mark.parametrize("fmt,maker", [
+    ("recordio", make_recordio_file),
+])
+@pytest.mark.parametrize("old_parts,new_parts",
+                         [(1, 3), (3, 1), (2, 5), (5, 2), (4, 3), (3, 7)])
+def test_repartition_covers_exactly_once(tmp_path, fmt, maker, old_parts,
+                                         new_parts):
+    """The elastic resize property: for ANY num_parts -> num_parts'
+    change, the union of the new byte-range partitions equals the old
+    coverage — every record exactly once, order preserved within each
+    partition — with no coordination between worlds."""
+    uri, recs = maker(tmp_path)
+
+    def partition_records(num_parts):
+        out = []
+        for part in range(num_parts):
+            sp = isplit.create(uri, part, num_parts, fmt, threaded=False)
+            out.append(read_all(sp))
+            sp.close()
+        return out
+
+    old = partition_records(old_parts)
+    new = partition_records(new_parts)
+    flat_old = [r for part in old for r in part]
+    flat_new = [r for part in new for r in part]
+    assert flat_old == recs
+    assert flat_new == recs  # exactly once, global order preserved
+
+
+@pytest.mark.parametrize("num_parts", [1, 2, 3, 5, 8, 16])
+def test_partition_spans_tile_byte_space(tmp_path, num_parts):
+    """partition_spans is the pure form of the repartition contract:
+    spans tile [first record, total] exactly and match what
+    reset_partition actually reads."""
+    uri, recs = make_recordio_file(tmp_path)
+    sp = isplit.create(uri, 0, 1, "recordio", threaded=False)
+    spans = sp.partition_spans(num_parts)
+    assert len(spans) == num_parts
+    total = sp.get_total_size()
+    assert spans[0][0] == 0
+    assert spans[-1][1] == total
+    for (b0, e0), (b1, e1) in zip(spans, spans[1:]):
+        assert e0 == b1, "spans must tile with no gap or overlap"
+        assert b0 <= e0
+    # spans agree with the partitions reset_partition serves: a part
+    # yields records iff its span is non-empty, and the concatenation
+    # over spans reproduces the dataset in order
+    got = []
+    for part, (b, e) in enumerate(spans):
+        sp.reset_partition(part, num_parts)
+        part_recs = read_all(sp)
+        assert bool(part_recs) == (e > b)
+        got.extend(part_recs)
+    assert got == recs
+    sp.close()
+
+
+def test_partition_spans_deterministic_across_instances(tmp_path):
+    """Two independent split instances (two worlds) agree on every
+    span for every num_parts — the no-coordination guarantee."""
+    uri, _ = make_recordio_file(tmp_path)
+    a = isplit.create(uri, 0, 1, "recordio", threaded=False)
+    b = isplit.create(uri, 0, 1, "recordio", threaded=False)
+    for n in (1, 2, 3, 5, 9):
+        assert a.partition_spans(n) == b.partition_spans(n)
+    a.close()
+    b.close()

@@ -495,3 +495,69 @@ def test_concurrent_http_streams_complete():
     finally:
         srv.close()
         eng.close()
+
+
+# ---------------------------------------------------------------------------
+# graceful drain (ISSUE 7): preemption notice must not drop in-flight work
+# ---------------------------------------------------------------------------
+
+def test_drain_finishes_active_rejects_new():
+    """drain(): already-submitted generations complete; new /generate
+    requests get 503 + Retry-After for the whole drain window."""
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=64, block_size=4,
+                          max_active=4, queue_depth=16)
+    eng.start()
+    srv = ServingHTTPServer(eng, port=0)
+    results = {}
+    try:
+        # a long-ish generation in flight when the notice lands
+        req = eng.submit([1, 2, 3], max_new_tokens=12)
+
+        def draining():
+            results["clean"] = srv.drain(timeout_s=60)
+
+        t = threading.Thread(target=draining, daemon=True)
+        t.start()
+        # wait for the drain to take effect, then poke the front door
+        deadline = time.monotonic() + 10
+        while not eng.draining and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert eng.draining
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url, {"prompt": [5, 6], "max_tokens": 1},
+                  timeout=30)
+        assert e.value.code == 503
+        assert e.value.headers.get("Retry-After") == "5"
+        t.join(120)
+        assert results["clean"] is True
+        # the in-flight generation was finished, not dropped
+        assert req.wait(5)
+        assert req.error is None and len(req.generated) == 12
+        # direct submits are refused too (embedded users)
+        from dmlc_tpu.serving.engine import EngineDraining
+
+        with pytest.raises(EngineDraining):
+            eng.submit([1], max_new_tokens=1)
+    finally:
+        srv.close()
+        eng.close()
+
+
+def test_drain_deadline_fails_leftovers():
+    """An engine that cannot finish (never started) hits the drain
+    deadline: drain() returns False and the backlog is failed, not
+    leaked."""
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=32, block_size=4,
+                          max_active=2, queue_depth=8)
+    # NOT started: the queued request can never decode
+    req = eng.submit([1, 2], max_new_tokens=4)
+    srv = ServingHTTPServer(eng, port=0)
+    try:
+        assert srv.drain(timeout_s=0.3) is False
+        assert req.wait(5)
+        assert req.error is not None
+    finally:
+        srv.close()
+        eng.close()

@@ -325,27 +325,47 @@ def test_extra_worker_beyond_world_size_fails_loudly():
     tracker.close()
 
 
-def test_out_of_range_rank_fails_loudly():
-    """A hostile rank beyond world size must neither count toward the
-    shutdown quorum (ending the job early) nor KeyError deep in the
-    topology send — both are named protocol violations."""
+def test_bad_announces_dropped_job_survives():
+    """Malformed announces — an out-of-range rank, a recover without a
+    rank, a world_size mismatch — are each DROPPED and counted
+    (dmlc_tracker_rejected_announces) instead of taking down the accept
+    loop: the registered worker keeps working and shuts down cleanly.
+    (The reference tracker dies on a bare assert for every one of
+    these.)"""
+    from dmlc_tpu import telemetry
+
+    telemetry.reset()
     tracker = RabitTracker("127.0.0.1", 1)
     tracker.start(1)
     c = TrackerClient("127.0.0.1", tracker.port, jobid="w0")
     c.start()
-    _raw_session(tracker.port, rank=99, cmd="recover")
-    with pytest.raises(RuntimeError, match="rank 99 >= world size"):
+    _raw_session(tracker.port, rank=99, cmd="recover")      # rank >= world
+    _raw_session(tracker.port, rank=-1, cmd="recover")      # no rank
+    _raw_session(tracker.port, rank=-1, world=7)            # world mismatch
+    _raw_session(tracker.port, cmd="frobnicate")            # unknown cmd
+    # the legit worker still works end to end on the same tracker
+    c.log("still alive")
+    c.shutdown()
+    tracker.join(timeout=15)
+    tracker.close()
+    rejected = telemetry.snapshot()["counters"]["tracker"][
+        "rejected_announces"]
+    assert rejected == 4, rejected
+
+
+def test_out_of_range_shutdown_fails_loudly():
+    """A hostile rank beyond world size must not count toward the
+    shutdown quorum (ending the job early) — unlike a malformed
+    announce, a bogus shutdown corrupts the job's completion state and
+    stays a named protocol violation."""
+    tracker = RabitTracker("127.0.0.1", 1)
+    tracker.start(1)
+    c = TrackerClient("127.0.0.1", tracker.port, jobid="w0")
+    c.start()
+    _raw_session(tracker.port, rank=99, cmd="shutdown")
+    with pytest.raises(RuntimeError, match="out of range"):
         tracker.join(timeout=15)
     tracker.close()
-
-    tracker2 = RabitTracker("127.0.0.1", 1)
-    tracker2.start(1)
-    c2 = TrackerClient("127.0.0.1", tracker2.port, jobid="w0")
-    c2.start()
-    _raw_session(tracker2.port, rank=99, cmd="shutdown")
-    with pytest.raises(RuntimeError, match="out of range"):
-        tracker2.join(timeout=15)
-    tracker2.close()
 
 
 def test_worker_death_during_batch_brokering():
