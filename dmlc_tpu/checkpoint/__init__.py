@@ -11,6 +11,8 @@ each host writes only its addressable shards.
 
 from .sharded import (  # noqa: F401
     CheckpointManager,
+    CorruptCheckpoint,
+    MissingLeaf,
     restore_pytree,
     save_pytree,
 )

@@ -23,6 +23,18 @@ import numpy as np
 from ..base import DMLCError, check
 from ..io.stream import Stream
 
+
+class CorruptCheckpoint(DMLCError):
+    """A checkpoint shard failed its CRC32C digest — the on-disk bytes
+    differ from what ``save_pytree`` recorded in the manifest."""
+
+
+class MissingLeaf(DMLCError):
+    """The restore template asks for a leaf the checkpoint's manifest
+    does not carry (e.g. a pre-PR checkpoint without the persisted
+    stream-position leaf).  Typed so callers can probe for optional
+    leaves without matching on message text."""
+
 MANIFEST = "manifest.json"
 
 
@@ -153,7 +165,16 @@ def save_pytree(uri: str, tree: Any, *, process_index: int = 0) -> None:
                 else str(np.asarray(arr).dtype),
                 "spec": _spec_to_json(arr),
                 "shards": {},
+                # per-shard CRC32C digest, recorded at save time and
+                # verified on restore: a flipped shard fails restore
+                # LOUDLY instead of poisoning the optimizer state, and
+                # restore_latest falls back to the previous committed
+                # step (additive manifest field: pre-digest checkpoints
+                # restore unverified)
+                "crc32c": {},
             }
+            from ..io.integrity import crc32c
+
             if hasattr(arr, "addressable_shards"):
                 for shard in arr.addressable_shards:
                     if shard.replica_id != 0:
@@ -162,6 +183,7 @@ def save_pytree(uri: str, tree: Any, *, process_index: int = 0) -> None:
                     fname = f"{key}.{ikey}"
                     entry["shards"][ikey] = fname
                     raw = np.ascontiguousarray(shard.data).tobytes()
+                    entry["crc32c"][ikey] = crc32c(raw)
                     nbytes += len(raw)
                     with Stream.create(_join(uri, fname), "w") as s:
                         s.write(raw)
@@ -171,6 +193,7 @@ def save_pytree(uri: str, tree: Any, *, process_index: int = 0) -> None:
                                   npa.shape)
                 entry["shards"][ikey] = f"{key}.{ikey}"
                 raw = np.ascontiguousarray(npa).tobytes()
+                entry["crc32c"][ikey] = crc32c(raw)
                 nbytes += len(raw)
                 with Stream.create(_join(uri, f"{key}.{ikey}"), "w") as s:
                     s.write(raw)
@@ -259,13 +282,33 @@ def _restore_pytree(uri: str, template: Any, *, mesh=None) -> Any:
     import jax
 
     with Stream.create(_join(uri, MANIFEST), "r") as s:
-        manifest = json.loads(_read_all(s))
-    check(manifest.get("format") == 1, "unknown checkpoint format")
-    leaves_meta = manifest["leaves"]
+        raw_manifest = _read_all(s)
+    # the manifest is the digest root of trust, so it carries no digest
+    # of its own — but a rotted manifest must still cost one checkpoint
+    # interval, not the job: parse/shape failures are CorruptCheckpoint
+    # (restore_latest falls back), while read errors stay transient
+    try:
+        manifest = json.loads(raw_manifest)
+        if not isinstance(manifest, dict):
+            raise ValueError("manifest is not a JSON object")
+    except ValueError as e:
+        raise CorruptCheckpoint(
+            f"checkpoint manifest at {uri} is unparseable ({e}) — "
+            f"the checkpoint is corrupt")
+    if manifest.get("format") != 1:
+        raise CorruptCheckpoint(
+            f"checkpoint manifest at {uri} has unknown format "
+            f"{manifest.get('format')!r} — the checkpoint is corrupt")
+    leaves_meta = manifest.get("leaves")
+    if leaves_meta is None:
+        raise CorruptCheckpoint(
+            f"checkpoint manifest at {uri} lacks its leaves table — "
+            f"the checkpoint is corrupt")
 
     paths, treedef = jax.tree_util.tree_flatten_with_path(template)
 
-    def load_shard_bytes(key: str, ikey: str, dtype, shape) -> np.ndarray:
+    def load_shard_bytes(key: str, ikey: str, dtype, shape,
+                         want_crc=None) -> np.ndarray:
         # shard filenames are derived deterministically (f"{key}.{ikey}"),
         # NOT looked up in the manifest: in a multi-host save every process
         # writes its own addressable shards but only process 0 writes the
@@ -275,6 +318,19 @@ def _restore_pytree(uri: str, template: Any, *, mesh=None) -> Any:
         from .. import telemetry
 
         telemetry.inc("checkpoint", "bytes_read", len(raw))
+        if want_crc is not None:
+            from ..io.integrity import crc32c
+
+            got = crc32c(raw)
+            if got != int(want_crc):
+                telemetry.inc("integrity", "checksum_failures")
+                telemetry.record_event("checkpoint_shard_corrupt",
+                                       uri=uri, shard=f"{key}.{ikey}")
+                raise CorruptCheckpoint(
+                    f"checkpoint shard {key}.{ikey} failed its CRC32C "
+                    f"digest (manifest {int(want_crc):#010x}, file "
+                    f"{got:#010x}) — the checkpoint at {uri} is "
+                    f"corrupt")
         return np.frombuffer(raw, dtype=dtype).reshape(shape)
 
     listing_cache: list = []
@@ -320,21 +376,27 @@ def _restore_pytree(uri: str, template: Any, *, mesh=None) -> Any:
         key = _leaf_key(path)
         meta = leaves_meta.get(key)
         if meta is None:
-            raise DMLCError(f"checkpoint missing leaf {key}")
+            raise MissingLeaf(f"checkpoint missing leaf {key}")
         shape = tuple(meta["shape"])
         dtype = np.dtype(meta["dtype"])
+        crcs = meta.get("crc32c") or {}
         if mesh is not None:
             spec = _spec_from_json(meta["spec"])
             sharding = jax.sharding.NamedSharding(mesh, spec)
 
-            def cb(index, key=key, shape=shape, dtype=dtype):
+            def cb(index, key=key, shape=shape, dtype=dtype, crcs=crcs):
                 ikey = _index_key(index, shape)
                 extent = tuple(
                     (0 if sl.start is None else sl.start,
                      dim if sl.stop is None else sl.stop)
                     for sl, dim in zip(index, shape))
                 sub_shape = tuple(b - a for a, b in extent)
-                return load_shard_bytes(key, ikey, dtype, sub_shape)
+                # digests cover the shards THIS manifest writer saved;
+                # other hosts' shards (and resharded reads) verify only
+                # when the shard layout matches — absent digest = no
+                # verification, never a false failure
+                return load_shard_bytes(key, ikey, dtype, sub_shape,
+                                        want_crc=crcs.get(ikey))
 
             out_leaves.append(
                 jax.make_array_from_callback(shape, sharding, cb))
@@ -343,7 +405,8 @@ def _restore_pytree(uri: str, template: Any, *, mesh=None) -> Any:
             for ikey in shard_keys_for(key, meta, shape):
                 idx = _parse_index(ikey, shape)
                 sub_shape = tuple(sl.stop - sl.start for sl in idx)
-                full[idx] = load_shard_bytes(key, ikey, dtype, sub_shape)
+                full[idx] = load_shard_bytes(key, ikey, dtype, sub_shape,
+                                             want_crc=crcs.get(ikey))
             out_leaves.append(full)
     return jax.tree_util.tree_unflatten(treedef, out_leaves)
 
@@ -410,6 +473,15 @@ class CheckpointManager:
                 steps.append(int(m.group(1)))
         return steps
 
+    def _committed_steps(self) -> List[int]:
+        """Committed step numbers, newest first (empty when the base
+        cannot be listed — the LATEST-hint fallback covers that)."""
+        steps = self._step_dirs()
+        if steps is None:
+            return []
+        return [s for s in sorted(steps, reverse=True)
+                if self._has_manifest(s)]
+
     def latest_step(self) -> Optional[int]:
         """Newest step with a COMMITTED manifest.  Directory scan, not
         the LATEST pointer: after a preemption mid-save the newest step
@@ -434,10 +506,36 @@ class CheckpointManager:
         return None
 
     def restore_latest(self, template: Any, *, mesh=None):
-        step = self.latest_step()
-        if step is None:
-            return None, None
-        return step, restore_pytree(self._step_dir(step), template, mesh=mesh)
+        """Restore the newest committed checkpoint, falling back a step
+        when a restore fails its shard digests (a silently flipped shard
+        must cost ONE checkpoint interval, not the job): each committed
+        step is tried newest-first; a corrupt one is logged and the next
+        older committed step restores instead.  Raises only when every
+        committed checkpoint is corrupt.  Only :class:`CorruptCheckpoint`
+        triggers the fallback — transient read errors and template
+        mismatches propagate rather than silently discarding the newest
+        committed step."""
+        candidates = self._committed_steps()
+        if not candidates:
+            step = self.latest_step()  # unlistable store: LATEST hint
+            if step is None:
+                return None, None
+            candidates = [step]
+        last_err: Optional[DMLCError] = None
+        for step in candidates:
+            try:
+                return step, restore_pytree(self._step_dir(step),
+                                            template, mesh=mesh)
+            except CorruptCheckpoint as e:
+                from ..logging import warning
+
+                last_err = e
+                warning(f"checkpoint step {step} failed to restore "
+                        f"({e}); falling back to the previous "
+                        f"committed step")
+        raise DMLCError(
+            f"no committed checkpoint under {self.base} restored "
+            f"cleanly (last error: {last_err})")
 
     def _retain(self) -> None:
         import shutil

@@ -327,12 +327,18 @@ long dmlc_parse_csv(const char* buf, long n, char delim, int nthread,
 }
 
 // ---------------------------------------------------------------------
-// RecordIO chunk scan (format: recordio.h:16-45).  Walks a 4-aligned
-// chunk of [magic|lrec|payload|pad4] cells; emits one (offset, len, flag)
-// triple per *logical* record: flag 0 => payload at offset, len bytes,
-// zero-copy; flag 1 => multi-segment record spanning [offset, offset+len)
-// including headers (Python reassembles).  Returns 0 ok, -1 capacity,
-// -2 malformed.
+// RecordIO chunk scan (format: recordio.h:16-45, plus the CRC32C record
+// variant: cflag|4 with a crc word between lrec and payload).  Walks a
+// 4-aligned chunk of [magic|lrec[|crc]|payload|pad4] cells; emits one
+// (offset, len, flag) triple per *logical* record:
+//   flag 0 => plain payload at offset, len bytes, zero-copy
+//   flag 1 => plain multi-segment region [offset, offset+len) incl.
+//             headers (Python reassembles)
+//   flag 2 => checksummed payload at offset (its crc word sits at
+//             offset-4), len bytes, zero-copy after verification
+//   flag 3 => checksummed multi-segment region incl. headers
+// Even flags are direct payload spans, odd flags need reassembly.
+// Returns 0 ok, -1 capacity, -2 malformed.
 long dmlc_recordio_spans(const uint8_t* buf, long n, uint32_t magic,
                          uint64_t* out, long max_spans, long* n_spans) {
   long count = 0;
@@ -344,20 +350,21 @@ long dmlc_recordio_spans(const uint8_t* buf, long n, uint32_t magic,
     memcpy(&lrec, buf + pos + 4, 4);
     uint32_t cflag = lrec >> 29u;
     uint32_t len = lrec & ((1u << 29u) - 1u);
-    long payload = pos + 8;
+    int ck = cflag >= 4u;              // checksummed variant
+    long payload = pos + 8 + (ck ? 4 : 0);
     long next = payload + ((len + 3u) & ~3u);
-    if (next > n) return -2;
-    if (cflag == 0) {
+    if (next > n || payload > n) return -2;
+    if (cflag == 0 || cflag == 4) {
       if (count >= max_spans) return -1;
       out[3 * count] = static_cast<uint64_t>(payload);
       out[3 * count + 1] = len;
-      out[3 * count + 2] = 0;
+      out[3 * count + 2] = ck ? 2 : 0;
       ++count;
       pos = next;
-    } else if (cflag == 1) {
+    } else if (cflag == 1 || cflag == 5) {
       long start = pos;
       pos = next;
-      // walk continuation cells (cflag 2) to the end cell (cflag 3)
+      // walk continuation cells (cflag 2 / 6) to the end cell (3 / 7)
       while (true) {
         if (pos + 8 > n) return -2;
         memcpy(&m, buf + pos, 4);
@@ -365,15 +372,16 @@ long dmlc_recordio_spans(const uint8_t* buf, long n, uint32_t magic,
         memcpy(&lrec, buf + pos + 4, 4);
         uint32_t cf = lrec >> 29u;
         uint32_t l2 = lrec & ((1u << 29u) - 1u);
-        pos += 8 + ((l2 + 3u) & ~3u);
+        if (ck && pos + 12 > n) return -2;
+        pos += 8 + (ck ? 4 : 0) + ((l2 + 3u) & ~3u);
         if (pos > n) return -2;
-        if (cf == 3) break;
-        if (cf != 2) return -2;
+        if (cf == (ck ? 7u : 3u)) break;
+        if (cf != (ck ? 6u : 2u)) return -2;
       }
       if (count >= max_spans) return -1;
       out[3 * count] = static_cast<uint64_t>(start);
       out[3 * count + 1] = static_cast<uint64_t>(pos - start);
-      out[3 * count + 2] = 1;
+      out[3 * count + 2] = ck ? 3 : 1;
       ++count;
     } else {
       return -2;  // chunk must start at a record head
@@ -384,7 +392,7 @@ long dmlc_recordio_spans(const uint8_t* buf, long n, uint32_t magic,
 }
 
 // Backward scan for the last record head (magic at 4-aligned offset with
-// cflag in {0,1}) — recordio_split.cc:26-42 behavior.
+// a head cflag: 0/1 plain, 4/5 checksummed) — recordio_split.cc:26-42.
 long dmlc_recordio_find_last(const uint8_t* buf, long n, uint32_t magic) {
   if (n < 8) return 0;
   for (long idx = ((n - 8) / 4) * 4; idx > 0; idx -= 4) {
@@ -394,10 +402,50 @@ long dmlc_recordio_find_last(const uint8_t* buf, long n, uint32_t magic) {
       uint32_t lrec;
       memcpy(&lrec, buf + idx + 4, 4);
       uint32_t cf = lrec >> 29u;
-      if (cf == 0 || cf == 1) return idx;
+      if (cf == 0 || cf == 1 || cf == 4 || cf == 5) return idx;
     }
   }
   return 0;
+}
+
+// ---------------------------------------------------------------------
+// CRC-32C (Castagnoli, reflected poly 0x82F63B78), slicing-by-8.
+// Table-driven so no SSE4.2 requirement; tables built once, lazily,
+// under the C++11 static-init guarantee (thread-safe).
+namespace crc32c_detail {
+struct Tables {
+  uint32_t t[8][256];
+  Tables() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1u) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
+      t[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; ++i)
+      for (int s = 1; s < 8; ++s)
+        t[s][i] = (t[s - 1][i] >> 8) ^ t[0][t[s - 1][i] & 0xFFu];
+  }
+};
+}  // namespace crc32c_detail
+
+uint32_t dmlc_crc32c(const uint8_t* buf, long n, uint32_t init) {
+  static const crc32c_detail::Tables tables;
+  const uint32_t(*t)[256] = tables.t;
+  uint32_t c = init ^ 0xFFFFFFFFu;
+  long i = 0;
+  for (; i + 8 <= n; i += 8) {
+    uint32_t lo, hi;
+    memcpy(&lo, buf + i, 4);
+    memcpy(&hi, buf + i + 4, 4);
+    lo ^= c;
+    c = t[7][lo & 0xFFu] ^ t[6][(lo >> 8) & 0xFFu] ^
+        t[5][(lo >> 16) & 0xFFu] ^ t[4][lo >> 24] ^
+        t[3][hi & 0xFFu] ^ t[2][(hi >> 8) & 0xFFu] ^
+        t[1][(hi >> 16) & 0xFFu] ^ t[0][hi >> 24];
+  }
+  for (; i < n; ++i) c = t[0][(c ^ buf[i]) & 0xFFu] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
 }
 
 // Shuffled-batch span gather (indexed_recordio_split.cc:158-211 role):
@@ -469,6 +517,6 @@ long dmlc_pack_spans(const char* src, long src_len, char* dst, long dst_cap,
   return i;
 }
 
-int dmlc_native_abi_version() { return 4; }
+int dmlc_native_abi_version() { return 5; }
 
 }  // extern "C"

@@ -646,47 +646,177 @@ def libsvm_feed(uri: str, mesh, *, batch_size: int, max_nnz: int,
                       source_builder=builder, world=world)
 
 
-def _chunk_spans(mv: memoryview):
-    """Span triples (offset, len, flag) for one record-aligned RecordIO
-    chunk: native scan, or a validated Python header walk as fallback."""
-    from .. import native
-    from ..io.recordio import KMAGIC, _MAGIC_BYTES, _U32, decode_flag, \
-        decode_length
+def _py_chunk_spans(mv: memoryview, source=None, base=None):
+    """Validated Python header walk producing (offset, len, flag)
+    triples — flags 0/1 plain, 2/3 checksummed (even = direct payload
+    span, odd = multi-segment region).  Under a non-``raise``
+    DMLC_INTEGRITY_POLICY a structurally corrupt region is counted and
+    resynced past (next record head) instead of failing the epoch;
+    ``source``/``base`` key the poisoned span for the quarantine
+    skip-list exactly like the verified-crc path."""
+    from ..io import integrity
+    from ..io.recordio import CRC_BIT, HEAD_CFLAGS, _MAGIC_BYTES, _U32, \
+        decode_flag, decode_length, find_next_record_head
 
-    sp = native.recordio_spans(mv, KMAGIC)
+    corrupt_seen = False
+
+    def bad(pos, what):
+        nonlocal corrupt_seen
+        corrupt_seen = True
+        nxt = min(n, pos + 4)
+        nxt += (-nxt) % 4
+        nxt = find_next_record_head(mv, nxt, n - n % 4)
+        integrity.handle_corrupt(  # raises under policy 'raise'
+            what, source=source,
+            begin=None if base is None else base + pos,
+            end=None if base is None else base + nxt)
+        return nxt
+
+    triples, pos, n = [], 0, len(mv)
+    while pos + 8 <= n:
+        if mv[pos:pos + 4] != _MAGIC_BYTES:
+            pos = bad(pos, "bad magic")
+            continue
+        lrec = _U32.unpack_from(mv, pos + 4)[0]
+        cflag, ln = decode_flag(lrec), decode_length(lrec)
+        ck = cflag >= CRC_BIT
+        hdr = 12 if ck else 8
+        if cflag & 3 == 0 and cflag in HEAD_CFLAGS:
+            nxt = pos + hdr + ((ln + 3) & ~3)
+            if nxt > n:
+                pos = bad(pos, "truncated payload")
+                continue
+            triples.append((pos + hdr, ln, 2 if ck else 0))
+            pos = nxt
+        elif cflag & 3 == 1 and cflag in HEAD_CFLAGS:
+            start = pos
+            pos += hdr + ((ln + 3) & ~3)
+            ok = True
+            while True:
+                if pos + hdr > n or mv[pos:pos + 4] != _MAGIC_BYTES:
+                    pos = bad(start, "torn multi-segment record")
+                    ok = False
+                    break
+                lrec = _U32.unpack_from(mv, pos + 4)[0]
+                cf, l2 = decode_flag(lrec), decode_length(lrec)
+                if cf & 3 not in (2, 3) or (cf >= CRC_BIT) != ck:
+                    pos = bad(start, "missing end segment")
+                    ok = False
+                    break
+                pos += hdr + ((l2 + 3) & ~3)
+                if pos > n:
+                    pos = bad(start, "truncated payload")
+                    ok = False
+                    break
+                if cf & 3 == 3:
+                    break
+            if ok:
+                triples.append((start, pos - start, 3 if ck else 1))
+        else:
+            pos = bad(pos, f"cflag {cflag} at record head")
+    if pos < n and not corrupt_seen:
+        # stray bytes no 8-byte header fits in — same contract as
+        # RecordIOChunkReader: loud under policy 'raise', counted
+        # otherwise (suppressed when this chunk already reported; the
+        # truncated-record report there covers these bytes)
+        integrity.handle_corrupt(
+            "torn tail (sub-word remainder)", source=source,
+            begin=None if base is None else base + pos,
+            end=None if base is None else base + n)
+    return np.asarray(triples, np.uint64).reshape(-1, 3)
+
+
+def _chunk_spans(mv: memoryview, source=None, base=None):
+    """Span triples (offset, len, flag) for one record-aligned RecordIO
+    chunk: native scan, or a validated Python header walk as fallback.
+    Checksummed spans (flags 2/3) are CRC32C-verified here; corrupt and
+    quarantined records are dropped per DMLC_INTEGRITY_POLICY.
+    ``source``/``base`` key quarantined spans as (uri, global byte
+    offset of the record head)."""
+    from .. import native
+    from ..io import integrity
+    from ..io.recordio import KMAGIC
+
+    try:
+        sp = native.recordio_spans(mv, KMAGIC)
+    except ValueError:
+        # structurally corrupt chunk: re-walk in Python so the fault is
+        # classified through the integrity policy (CorruptRecord under
+        # 'raise' — counted, with the poisoned span keyed — instead of
+        # the native scanner's bare ValueError; count + resync past it
+        # otherwise)
+        sp = _py_chunk_spans(mv, source, base)
     if sp is None:  # no native library: walk headers in Python
-        triples, pos, n = [], 0, len(mv)
-        while pos + 8 <= n:
-            check(mv[pos:pos + 4] == _MAGIC_BYTES, "invalid RecordIO chunk")
-            lrec = _U32.unpack_from(mv, pos + 4)[0]
-            cflag, ln = decode_flag(lrec), decode_length(lrec)
-            if cflag == 0:
-                triples.append((pos + 8, ln, 0))
-                pos += 8 + ((ln + 3) & ~3)
-                check(pos <= n, "invalid RecordIO chunk")
-            else:
-                check(cflag == 1, "invalid RecordIO chunk")
-                start = pos
-                pos += 8 + ((ln + 3) & ~3)
-                while True:
-                    check(pos + 8 <= n, "invalid RecordIO chunk")
-                    check(mv[pos:pos + 4] == _MAGIC_BYTES,
-                          "invalid RecordIO chunk")
-                    lrec = _U32.unpack_from(mv, pos + 4)[0]
-                    cf, l2 = decode_flag(lrec), decode_length(lrec)
-                    check(cf in (2, 3), "invalid RecordIO chunk")
-                    pos += 8 + ((l2 + 3) & ~3)
-                    check(pos <= n, "invalid RecordIO chunk")
-                    if cf == 3:
-                        break
-                triples.append((start, pos - start, 1))
-        sp = np.asarray(triples, np.uint64).reshape(-1, 3)
-    return sp
+        sp = _py_chunk_spans(mv, source, base)
+    return _verify_spans(mv, sp, source, base)
+
+
+def _verify_spans(mv: memoryview, sp, source, base):
+    """Filter a chunk's span table through the integrity layer: verify
+    checksummed records, apply the corruption policy, and drop
+    skip-listed (quarantined) spans on replay.  The all-plain fast path
+    is one vectorized compare per chunk."""
+    from ..io import integrity
+    from ..io.recordio import _U32, stored_crc
+
+    if sp.shape[0] == 0:
+        return sp
+    flags = sp[:, 2]
+    checked = flags >= 2
+    listed = integrity.has_quarantine(source)
+    if not checked.any() and not listed:
+        return sp
+    keep = np.ones(sp.shape[0], bool)
+    for i in np.nonzero(checked)[0]:
+        off, ln, flag = int(sp[i, 0]), int(sp[i, 1]), int(sp[i, 2])
+        head = off - 12 if flag == 2 else off
+        gbegin = None if base is None else base + head
+        if integrity.should_drop(source, gbegin):
+            keep[i] = False
+            continue
+        if flag == 2:
+            want = _U32.unpack_from(mv, off - 4)[0]
+            ok = stored_crc(integrity.crc32c(mv[off:off + ln])) == want
+        else:
+            ok = _verify_region(mv, off, ln)
+        if not ok:
+            integrity.handle_corrupt(
+                "crc32c mismatch", source=source, begin=gbegin,
+                end=None if gbegin is None else base + off + ln)
+            keep[i] = False
+    if listed and base is not None:
+        for i in np.nonzero(~checked)[0]:
+            off, flag = int(sp[i, 0]), int(sp[i, 2])
+            head = off - 8 if flag == 0 else off
+            if integrity.should_drop(source, base + head):
+                keep[i] = False
+    return sp if keep.all() else sp[keep]
+
+
+def _verify_region(mv: memoryview, off: int, ln: int) -> bool:
+    """CRC-verify every segment of one checksummed multi-segment
+    region (flag 3)."""
+    from ..io import integrity
+    from ..io.recordio import _U32, decode_length, stored_crc
+
+    pos, end = off, off + ln
+    while pos + 12 <= end:
+        lrec = _U32.unpack_from(mv, pos + 4)[0]
+        want = _U32.unpack_from(mv, pos + 8)[0]
+        n = decode_length(lrec)
+        seg = mv[pos + 12: pos + 12 + n]
+        if stored_crc(integrity.crc32c(seg)) != want:
+            return False
+        pos += 12 + ((n + 3) & ~3)
+    return True
 
 
 def _reassemble_region(mv: memoryview, off: int, ln: int) -> bytes:
-    """Reassemble one escaped-magic (multi-segment) record region."""
-    from ..io.recordio import _MAGIC_BYTES, _U32, decode_flag, decode_length
+    """Reassemble one escaped-magic (multi-segment) record region —
+    plain (8-byte headers) or checksummed (12-byte headers; the crc was
+    verified by the span scan)."""
+    from ..io.recordio import CRC_BIT, _MAGIC_BYTES, _U32, decode_flag, \
+        decode_length
 
     region = mv[off: off + ln]
     parts, pos = [], 0
@@ -694,24 +824,27 @@ def _reassemble_region(mv: memoryview, off: int, ln: int) -> bytes:
     while pos + 8 <= len(region):
         lrec = _U32.unpack_from(region, pos + 4)[0]
         cf, n = decode_flag(lrec), decode_length(lrec)
+        hdr = 12 if cf >= CRC_BIT else 8
         if not first:
             parts.append(_MAGIC_BYTES)
-        parts.append(bytes(region[pos + 8: pos + 8 + n]))
+        parts.append(bytes(region[pos + hdr: pos + hdr + n]))
         first = False
-        pos += 8 + ((n + 3) & ~3)
-        if cf in (0, 3):
+        pos += hdr + ((n + 3) & ~3)
+        if cf & 3 in (0, 3):
             break
     return b"".join(parts)
 
 
-def _chunk_record_views(mv: memoryview):
-    """Per-record uint8 numpy views over one chunk (zero-copy for flag-0
-    records; flag-1 reassembled as owned arrays)."""
-    sp = _chunk_spans(mv)
+def _chunk_record_views(mv: memoryview, sp=None):
+    """Per-record uint8 numpy views over one chunk (zero-copy for
+    direct-payload records — flags 0/2; multi-segment regions — flags
+    1/3 — reassembled as owned arrays)."""
+    if sp is None:
+        sp = _chunk_spans(mv)
     arr = np.frombuffer(mv, np.uint8)
     out = []
     for off, ln, flag in sp.tolist():
-        if flag == 0:
+        if flag % 2 == 0:
             out.append(arr[off: off + ln])
         else:
             out.append(np.frombuffer(
@@ -739,7 +872,7 @@ def _gather_rows_into(mv: memoryview, sp, lo: int, hi: int,
     np.take(arr, idx, out=out_rows[:g])
     out_rows[:g] *= (np.arange(max_bytes, dtype=np.int64)[None, :]
                      < lens[:, None])
-    for i in np.nonzero(sp[lo:hi, 2] == 1)[0]:  # escaped magic
+    for i in np.nonzero(sp[lo:hi, 2] % 2 == 1)[0]:  # escaped magic
         payload = _reassemble_region(mv, int(offs[i]), int(sp[lo + i, 1]))
         n = min(len(payload), max_bytes)
         out_rows[i, :n] = np.frombuffer(payload, np.uint8, n)
@@ -804,13 +937,17 @@ def recordio_packed_feed(uri: str, mesh, *, buf_bytes: int,
                 mv = split.next_chunk()
                 if mv is None:
                     break
-                sp = _chunk_spans(mv)
-                if (sp[:, 2] == 0).all():
+                sp = _chunk_spans(
+                    mv, source=uri,
+                    base=getattr(split, "last_chunk_begin", None))
+                if (sp[:, 2] % 2 == 0).all():
+                    # direct-payload spans (plain or verified
+                    # checksummed): pack straight from the chunk
                     src = mv
                     offs = sp[:, 0].astype(np.int64)
                     lens = sp[:, 1].astype(np.int64)
                 else:  # rare escaped-magic chunk: flatten, then pack
-                    views = _chunk_record_views(mv)
+                    views = _chunk_record_views(mv, sp)
                     lens = np.fromiter((v.size for v in views),
                                        np.int64, count=len(views))
                     src = (np.concatenate(views) if views
@@ -870,7 +1007,9 @@ def recordio_feed(uri: str, mesh, *, batch_records: int, max_bytes: int,
                 mv = split.next_chunk()
                 if mv is None:
                     break
-                sp = _chunk_spans(mv)
+                sp = _chunk_spans(
+                    mv, source=uri,
+                    base=getattr(split, "last_chunk_begin", None))
                 i, n_spans = 0, sp.shape[0]
                 while i < n_spans:
                     g = min(n_spans - i, batch_records - r, group_cap)

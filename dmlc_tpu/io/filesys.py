@@ -22,7 +22,15 @@ from ..base import DMLCError
 from .stream import SeekStream, Stream
 from .uri import URI
 
-__all__ = ["FileInfo", "FileSystem", "register_filesystem"]
+__all__ = ["FileInfo", "FileSystem", "UnsupportedListing",
+           "register_filesystem"]
+
+
+class UnsupportedListing(DMLCError):
+    """This backend cannot list directories BY DESIGN (plain HTTP) —
+    callers expanding URIs fall back to the literal path.  Backends
+    whose listing fails for a real reason (credentials, transport)
+    raise plain DMLCError/OSError instead, which propagates."""
 
 
 @dataclass

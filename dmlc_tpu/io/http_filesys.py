@@ -13,13 +13,23 @@ import urllib.error
 import urllib.request
 from typing import List, Optional
 
-from ..base import DMLCError, check
+from ..base import DMLCError, check, get_env
 from ..resilience import RetryPolicy, fault_point, maybe_corrupt
 from .filesys import FileInfo, FileSystem
 from .stream import SeekStream, Stream
 from .uri import URI
 
 __all__ = ["HTTPFileSystem", "HttpReadStream"]
+
+#: 1 = every ranged fill is fetched twice and the CRC32Cs compared —
+#: the classic double-read guard against silently corrupted storage
+#: responses (TCP checksums miss ~1 in 10^8 flipped frames; object
+#: stores re-serve hot blocks from caches that can rot).  Off by
+#: default: it doubles read traffic, so it is a knob for jobs whose
+#: input integrity matters more than ingest bandwidth (the integrity
+#: smoke arms it against injected ``storage.response=corrupt`` faults).
+ENV_VERIFY_READS = "DMLC_INTEGRITY_VERIFY_READS"
+ENV_READ_RETRIES = "DMLC_INTEGRITY_READ_RETRIES"
 
 
 class HttpReadStream(SeekStream):
@@ -99,6 +109,46 @@ class HttpReadStream(SeekStream):
                                       default_attempts=3, name="http")
         return policy.call(attempt)
 
+    def _verified_fill(self, start: int, size: int) -> bytes:
+        """One ranged fill through the integrity layer.
+
+        The chaos hook shared by every ranged-read backend (S3/GCS/
+        Azure/WebHDFS subclasses all route reads through here): an
+        armed ``storage.response=corrupt`` rule flips bytes in the
+        response, so integrity checks downstream (recordio CRCs,
+        checkpoint digests) exercise against torn storage replies.
+
+        With ``DMLC_INTEGRITY_VERIFY_READS=1`` each fill is fetched
+        TWICE and compared byte-for-byte; a mismatch means one response
+        was corrupted in flight — it is counted
+        (``dmlc_integrity_read_verify_failures``), and the pair is
+        re-fetched (up to ``DMLC_INTEGRITY_READ_RETRIES``) so the
+        injected/real corruption is *caught and healed*, never served.
+        Persistent disagreement raises: the source itself is rotten."""
+        out = maybe_corrupt("storage.response", self._fill(start, size))
+        if not get_env(ENV_VERIFY_READS, False) or not out:
+            return out
+        retries = max(1, get_env(ENV_READ_RETRIES, 4))
+        for attempt in range(retries):
+            confirm = maybe_corrupt("storage.response",
+                                    self._fill(start, size))
+            if out == confirm:  # exact memcmp — no CRC collision window
+                return out
+            from .. import telemetry
+
+            telemetry.inc("integrity", "read_verify_failures")
+            telemetry.record_event(
+                "read_verify_failure",
+                url=self._url.split("?")[0], start=start,
+                size=len(out), attempt=attempt)
+            if attempt + 1 < retries:  # no comparison follows the last
+                out = maybe_corrupt("storage.response",
+                                    self._fill(start, size))
+        raise DMLCError(
+            f"ranged read {self._url.split('?')[0]} [{start}, "
+            f"{start + size}) failed double-read verification "
+            f"{retries} times — persistent response corruption")
+
     def read(self, size: int) -> bytes:
         if self._pos >= self._size:
             return b""
@@ -107,19 +157,16 @@ class HttpReadStream(SeekStream):
         off = self._pos - self._buf_start
         if not (0 <= off < len(self._buf)):
             self._buf_start = self._pos
-            self._buf = self._fill(self._pos, max(size, self._buffer_bytes))
+            self._buf = self._verified_fill(self._pos,
+                                            max(size, self._buffer_bytes))
             off = 0
         out = self._buf[off : off + size]
         if len(out) < size:  # request spans past the buffered window
-            rest = self._fill(self._pos + len(out), size - len(out))
+            rest = self._verified_fill(self._pos + len(out),
+                                       size - len(out))
             out += rest
         self._pos += len(out)
-        # chaos hook shared by every ranged-read backend (S3/GCS/Azure/
-        # WebHDFS subclasses all route reads through here): an armed
-        # 'storage.response=corrupt' rule flips bytes so integrity
-        # checks downstream (recordio magic, checkpoint digests) can be
-        # exercised against torn storage replies
-        return maybe_corrupt("storage.response", out)
+        return out
 
     def write(self, data: bytes) -> int:
         raise DMLCError("HttpReadStream is read-only")
@@ -143,7 +190,9 @@ class HTTPFileSystem(FileSystem):
         return FileInfo(path=path, size=strm._size, type="file")
 
     def list_directory(self, path: URI) -> List[FileInfo]:
-        raise DMLCError("HTTP filesystem does not support listing")
+        from .filesys import UnsupportedListing
+
+        raise UnsupportedListing("HTTP filesystem does not support listing")
 
     def open(self, path: URI, mode: str, allow_null: bool = False
              ) -> Optional[Stream]:

@@ -37,8 +37,8 @@ from typing import List, Optional, Tuple
 
 from ..base import DMLCError, check
 from .. import native
-from .filesys import FileInfo, FileSystem
-from .recordio import KMAGIC, decode_flag, decode_length
+from .filesys import FileInfo, FileSystem, UnsupportedListing
+from .recordio import HEAD_CFLAGS, KMAGIC, decode_flag, decode_length
 from .stream import SeekStream
 from .uri import URI, URISpec
 
@@ -60,6 +60,7 @@ DEFAULT_CHUNK_BYTES = (2 << 20) * 4
 
 _MAGIC_BYTES = struct.pack("<I", KMAGIC)
 _U32 = struct.Struct("<I")
+_PY_SKIPPED = object()  # sentinel: policy dropped a corrupt record
 
 
 class ChunkCursor:
@@ -70,11 +71,16 @@ class ChunkCursor:
     path, bytes from the seam-stitch path, or an ``mmap`` for the
     zero-copy local fast path); the chunk occupies ``[start, end)`` in
     data coordinates — for mmap cursors that window is a view straight
-    into the page cache, never copied."""
+    into the page cache, never copied.  ``gbegin``, when known, is the
+    offset of ``start`` in the split's GLOBAL logical byte space — the
+    deterministic key the integrity quarantine skip-list records
+    poisoned spans under (io.integrity)."""
 
-    __slots__ = ("data", "start", "pos", "end", "mv", "spans", "span_i")
+    __slots__ = ("data", "start", "pos", "end", "mv", "spans", "span_i",
+                 "gbegin")
 
-    def __init__(self, data, end: Optional[int] = None, start: int = 0):
+    def __init__(self, data, end: Optional[int] = None, start: int = 0,
+                 gbegin: Optional[int] = None):
         self.data = data
         self.start = start
         self.pos = start
@@ -82,6 +88,7 @@ class ChunkCursor:
         self.mv: Optional[memoryview] = None  # cached memoryview(data)
         self.spans = None   # native whole-chunk scan cache (recordio)
         self.span_i = 0
+        self.gbegin = gbegin
 
 
 class InputSplit:
@@ -125,6 +132,9 @@ class InputSplitBase(InputSplit):
     ):
         self._filesys = filesys
         self._align = align_bytes
+        self._source_uri = uri   # quarantine skip-list source label
+        self.last_chunk_begin: Optional[int] = None  # global offset of
+        # the chunk most recently served by next_chunk (integrity keys)
         self._files: List[FileInfo] = []
         self._init_input_file_info(uri, recurse_directories)
         self._file_offset = [0]
@@ -216,7 +226,7 @@ class InputSplitBase(InputSplit):
             cut = self.find_last_record_begin(mm, lo, hi)
         if cut > lo:
             self._offset_curr = fbase + cut
-            return ChunkCursor(mm, start=lo, end=cut)
+            return ChunkCursor(mm, start=lo, end=cut, gbegin=curr)
         return self._GROW if window_end < in_file_end else self._STITCH
 
     def _load_cursor_mmap(self) -> Optional[ChunkCursor]:
@@ -289,7 +299,7 @@ class InputSplitBase(InputSplit):
                 else self.find_last_record_begin(buf, 0, total)
             if cut > 0:
                 self._offset_curr = curr + cut
-                return ChunkCursor(buf, end=cut)
+                return ChunkCursor(buf, end=cut, gbegin=curr)
             if take_end == end_part:
                 return None  # curr == end_part: nothing left
             if max_size is not None:
@@ -314,7 +324,12 @@ class InputSplitBase(InputSplit):
             dir_uri = URI(path.protocol + path.host + path.name[:pos])
             try:
                 dfiles = self._filesys.list_directory(dir_uri)
-            except OSError:
+            except (OSError, UnsupportedListing):
+                # no listing on this backend (plain HTTP) or an
+                # unlistable parent: take the path literally — ranged
+                # reads still work without a directory view.  Genuine
+                # listing failures (credentials, transport) raise plain
+                # DMLCError and propagate.
                 out.append(path)
                 continue
             target = self._strip_end(path.name, "/")
@@ -561,6 +576,9 @@ class InputSplitBase(InputSplit):
         if max_size <= len(self._overflow):
             return self._GROW
         olen = len(self._overflow)
+        # the carried overflow was already consumed from the stream, so
+        # this chunk's global begin sits olen bytes behind the cursor
+        gbegin = self._offset_curr - olen
         buf = self._take_buf(max_size)
         buf[:olen] = self._overflow
         total = olen + self._read_into(memoryview(buf), olen)
@@ -569,13 +587,13 @@ class InputSplitBase(InputSplit):
             self.recycle_chunk(buf)
             return None
         if total != max_size:  # partition tail: everything is one chunk
-            return ChunkCursor(buf, end=total)
+            return ChunkCursor(buf, end=total, gbegin=gbegin)
         cut = self.find_last_record_begin(buf, 0, total)
         self._overflow = bytes(memoryview(buf)[cut:total])
         if cut == 0:  # no record head in the whole buffer
             self.recycle_chunk(buf)
             return self._GROW
-        return ChunkCursor(buf, end=cut)
+        return ChunkCursor(buf, end=cut, gbegin=gbegin)
 
     def _load_cursor(self) -> Optional[ChunkCursor]:
         """Chunk::Load with geometric growth (input_split_base.cc:241-258)."""
@@ -644,6 +662,7 @@ class InputSplitBase(InputSplit):
         if cur is None:
             return None
         self._served = cur
+        self.last_chunk_begin = cur.gbegin
         return memoryview(cur.data)[cur.start : cur.end]
 
     def next_record(self) -> Optional[memoryview]:
@@ -767,8 +786,9 @@ class LineSplitter(InputSplitBase):
 
 
 class RecordIOSplitter(InputSplitBase):
-    """RecordIO records; boundary = magic + cflag in {0,1}
-    (src/io/recordio_split.cc)."""
+    """RecordIO records; boundary = magic + a head cflag — 0/1 plain,
+    4/5 checksummed (src/io/recordio_split.cc + the CRC32C record
+    variant, io.recordio)."""
 
     def __init__(self, filesys, uri, part_index=0, num_parts=1, recurse_directories=False):
         super().__init__(filesys, uri, align_bytes=4, recurse_directories=recurse_directories)
@@ -787,7 +807,7 @@ class RecordIOSplitter(InputSplitBase):
                 check(len(lrec) == 4, "invalid recordio format")
                 nstep += 4
                 cflag = decode_flag(_U32.unpack(lrec)[0])
-                if cflag in (0, 1):
+                if cflag in HEAD_CFLAGS:
                     break
         return nstep - 8
 
@@ -807,7 +827,7 @@ class RecordIOSplitter(InputSplitBase):
                 return begin
             if (idx - begin) % 4 == 0:
                 cflag = decode_flag(_U32.unpack_from(buf, idx + 4)[0])
-                if cflag in (0, 1):
+                if cflag in HEAD_CFLAGS:
                     return idx
             hi = idx + 3  # next candidate strictly below idx
 
@@ -825,31 +845,79 @@ class RecordIOSplitter(InputSplitBase):
                 continue
             check(idx + 8 <= end, "invalid recordio format")
             cflag = decode_flag(_U32.unpack_from(mm, idx + 4)[0])
-            if cflag in (0, 1):
+            if cflag in HEAD_CFLAGS:
                 return idx - off
             pos = idx + 8
 
+    def _gpos(self, chunk: ChunkCursor, pos: int) -> Optional[int]:
+        """Global byte offset of ``pos`` (quarantine span key), when the
+        chunk's placement in the logical byte space is known."""
+        return None if chunk.gbegin is None else chunk.gbegin + (
+            pos - chunk.start)
+
+    def _corrupt_at(self, chunk: ChunkCursor, begin: int,
+                    what: str) -> None:
+        """Count + apply DMLC_INTEGRITY_POLICY for a corrupt record whose
+        head is at chunk position ``begin`` (raises under ``raise``)."""
+        from .integrity import handle_corrupt
+
+        handle_corrupt(what, source=self._source_uri,
+                       begin=self._gpos(chunk, begin),
+                       end=self._gpos(chunk, min(chunk.pos, chunk.end)))
+
+    def _resync_chunk(self, chunk: ChunkCursor, frm: int) -> None:
+        from .recordio import find_next_record_head
+
+        if chunk.mv is None:
+            chunk.mv = memoryview(chunk.data)
+        frm = min(chunk.end, frm + 4)
+        rel = (frm - chunk.start) % 4
+        if rel:
+            frm += 4 - rel
+        # a torn tail can leave an unaligned end; the scan stops at the
+        # last aligned word (no record fits past it anyway)
+        end = chunk.end - (chunk.end - chunk.start) % 4
+        chunk.pos = (find_next_record_head(chunk.mv, frm, end)
+                     if frm < end else chunk.end)
+        if chunk.pos == end:
+            chunk.pos = chunk.end
+
     def extract_next_record(self, chunk: ChunkCursor) -> Optional[memoryview]:
-        if chunk.pos >= chunk.end:
-            return None
-        # native fast path: scan the whole chunk once, then serve spans
-        # as plain int triples (no per-record numpy unpacking)
-        if chunk.spans is None and chunk.pos == chunk.start:
-            try:
-                sp = native.recordio_spans(
-                    memoryview(chunk.data)[chunk.start : chunk.end], KMAGIC)
-            except ValueError as e:
-                raise DMLCError(str(e)) from e
-            if sp is not None:
-                base = chunk.start
-                lst = sp.tolist()
-                if base:
-                    for t in lst:
-                        t[0] += base
-                chunk.spans = lst
-                chunk.mv = memoryview(chunk.data)
-        sp = chunk.spans
-        if sp is not None:
+        from .integrity import should_drop
+
+        while True:
+            if chunk.pos >= chunk.end:
+                return None
+            # native fast path: scan the whole chunk once, then serve
+            # spans as plain int triples (no per-record numpy unpacking)
+            if chunk.spans is None and chunk.pos == chunk.start:
+                try:
+                    sp = native.recordio_spans(
+                        memoryview(chunk.data)[chunk.start : chunk.end],
+                        KMAGIC)
+                except ValueError as e:
+                    from .integrity import policy
+
+                    if policy() == "raise":
+                        raise DMLCError(str(e)) from e
+                    # corrupt chunk structure: the Python walk below
+                    # resyncs record-by-record under the active policy
+                    chunk.spans = ()
+                    sp = None
+                if sp is not None:
+                    base = chunk.start
+                    lst = sp.tolist()
+                    if base:
+                        for t in lst:
+                            t[0] += base
+                    chunk.spans = lst
+                    chunk.mv = memoryview(chunk.data)
+            sp = chunk.spans
+            if sp is None or sp == ():
+                rec = self._extract_py(chunk)
+                if rec is _PY_SKIPPED:
+                    continue
+                return rec
             i = chunk.span_i
             if i >= len(sp):
                 chunk.pos = chunk.end
@@ -858,43 +926,134 @@ class RecordIOSplitter(InputSplitBase):
             chunk.span_i = i + 1
             if flag == 0:
                 chunk.pos = off + ((length + 3) & ~3)
+                if should_drop(self._source_uri,
+                               self._gpos(chunk, off - 8)):
+                    continue
                 return chunk.mv[off : off + length]
-            # rare multi-segment record: reassemble via the Python walk
-            sub = ChunkCursor(chunk.data, start=off, end=off + length)
-            sub.spans = ()  # force the Python path below
-            chunk.pos = sub.end
-            return self._extract_py(sub)
-        return self._extract_py(chunk)
+            if flag == 2:
+                # checksummed complete record: crc word at off-4
+                chunk.pos = off + ((length + 3) & ~3)
+                head = off - 12
+                if should_drop(self._source_uri, self._gpos(chunk, head)):
+                    continue
+                from .integrity import crc32c
+                from .recordio import stored_crc
 
-    def _extract_py(self, chunk: ChunkCursor) -> Optional[memoryview]:
+                want = _U32.unpack_from(chunk.data, off - 4)[0]
+                seg = chunk.mv[off : off + length]
+                if stored_crc(crc32c(seg)) != want:
+                    self._corrupt_at(chunk, head, "crc32c mismatch")
+                    continue  # policy allowed the skip
+                return seg
+            # multi-segment record (flag 1 plain / 3 checksummed):
+            # reassemble + verify via the Python walk over the region
+            sub = ChunkCursor(chunk.data, start=off, end=off + length,
+                              gbegin=self._gpos(chunk, off))
+            sub.spans = ()  # force the Python path below
+            chunk.pos = off + length
+            if should_drop(self._source_uri, self._gpos(chunk, off)):
+                continue
+            rec = self._extract_py(sub)
+            if rec is _PY_SKIPPED or rec is None:
+                continue
+            return rec
+
+    def _extract_py(self, chunk: ChunkCursor):
+        """One record from ``chunk.pos`` via the header walk
+        (recordio_split.cc:44-82 + the checksummed variant).  Returns
+        the record, ``None`` at chunk end, or ``_PY_SKIPPED`` when the
+        policy dropped a corrupt record (the caller loops)."""
         if chunk.pos >= chunk.end:
             return None
-        check(chunk.pos + 8 <= chunk.end, "invalid RecordIO format")
         data = chunk.data
-        lrec = _U32.unpack_from(data, chunk.pos + 4)[0]
-        cflag = decode_flag(lrec)
-        clen = decode_length(lrec)
-        start = chunk.pos + 8
-        chunk.pos = start + (((clen + 3) >> 2) << 2)
-        check(chunk.pos <= chunk.end, "invalid RecordIO format")
-        if cflag == 0:
-            return memoryview(data)[start : start + clen]
-        # multi-segment reassembly (recordio_split.cc:44-82)
-        check(cflag == 1, "invalid RecordIO format")
-        parts = [bytes(data[start : start + clen])]
-        while cflag != 3:
-            check(chunk.pos + 8 <= chunk.end, "invalid RecordIO format")
-            check(
-                data[chunk.pos : chunk.pos + 4] == _MAGIC_BYTES,
-                "invalid RecordIO format",
-            )
-            lrec = _U32.unpack_from(data, chunk.pos + 4)[0]
+        begin = chunk.pos
+        if begin + 8 > chunk.end:
+            chunk.pos = chunk.end
+            self._corrupt_at(chunk, begin, "truncated header")
+            return _PY_SKIPPED
+        # resync/position updates run BEFORE the report so the span end
+        # (min(chunk.pos, chunk.end) inside _corrupt_at) covers the
+        # poisoned extent instead of a zero-length [begin, begin)
+        if data[begin : begin + 4] != _MAGIC_BYTES:
+            self._resync_chunk(chunk, begin)
+            self._corrupt_at(chunk, begin, "bad magic")
+            return _PY_SKIPPED
+        head_flag = decode_flag(_U32.unpack_from(data, begin + 4)[0])
+        from .recordio import CRC_BIT, HEAD_CFLAGS, stored_crc
+
+        if head_flag not in HEAD_CFLAGS:
+            self._resync_chunk(chunk, begin)
+            self._corrupt_at(chunk, begin, f"cflag {head_flag} at head")
+            return _PY_SKIPPED
+        checked = head_flag >= CRC_BIT
+        parts = []
+        bad = None
+        first = True
+        while True:
+            pos = chunk.pos
+            if pos + 8 > chunk.end or (
+                    not first
+                    and data[pos : pos + 4] != _MAGIC_BYTES):
+                self._resync_chunk(chunk, pos)
+                self._corrupt_at(chunk, begin, "torn record tail")
+                return _PY_SKIPPED
+            lrec = _U32.unpack_from(data, pos + 4)[0]
             cflag = decode_flag(lrec)
             clen = decode_length(lrec)
-            start = chunk.pos + 8
-            parts.append(_MAGIC_BYTES)
-            parts.append(bytes(data[start : start + clen]))
-            chunk.pos = start + (((clen + 3) >> 2) << 2)
+            if not first and (cflag & 3 not in (2, 3)
+                              or (cflag >= CRC_BIT) != checked):
+                # what we found may be the next record's head
+                if cflag in HEAD_CFLAGS:
+                    chunk.pos = pos
+                else:
+                    self._resync_chunk(chunk, pos)
+                self._corrupt_at(chunk, begin, "missing end segment")
+                return _PY_SKIPPED
+            start = pos + 8
+            want = None
+            if checked:
+                if start + 4 > chunk.end:
+                    chunk.pos = chunk.end
+                    self._corrupt_at(chunk, begin, "truncated crc word")
+                    return _PY_SKIPPED
+                want = _U32.unpack_from(data, start)[0]
+                start += 4
+            nxt = start + (((clen + 3) >> 2) << 2)
+            if nxt > chunk.end or start + clen > chunk.end:
+                chunk.pos = chunk.end
+                self._corrupt_at(chunk, begin, "truncated payload")
+                return _PY_SKIPPED
+            chunk.pos = nxt
+            seg = data[start : start + clen]
+            if checked:
+                from .integrity import crc32c
+
+                if stored_crc(crc32c(memoryview(data)[
+                        start : start + clen])) != want:
+                    bad = bad or "crc32c mismatch"
+            if first and cflag & 3 == 0:
+                if bad is not None:
+                    self._corrupt_at(chunk, begin, bad)
+                    return _PY_SKIPPED
+                from .integrity import should_drop
+
+                if should_drop(self._source_uri,
+                               self._gpos(chunk, begin)):
+                    return _PY_SKIPPED
+                return memoryview(data)[start : start + clen]
+            if not first:
+                parts.append(_MAGIC_BYTES)
+            parts.append(bytes(seg))
+            if cflag & 3 == 3:
+                break
+            first = False
+        if bad is not None:
+            self._corrupt_at(chunk, begin, bad)
+            return _PY_SKIPPED
+        from .integrity import should_drop
+
+        if should_drop(self._source_uri, self._gpos(chunk, begin)):
+            return _PY_SKIPPED
         return memoryview(b"".join(parts))
 
 

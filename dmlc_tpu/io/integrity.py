@@ -1,0 +1,227 @@
+"""End-to-end data integrity: CRC32C, the corruption policy knob, and
+the poison-span quarantine skip-list.
+
+Production-scale ingest sees silently flipped bits — in object-store
+responses, on local disks, in page caches.  The reference's RecordIO
+frames carry only the magic word, so a bit-flip inside a payload parses
+clean; this module supplies the three primitives the io/feed/checkpoint
+layers use to close that hole:
+
+  * :func:`crc32c` — CRC-32C (Castagnoli), the checksum stamped into
+    the versioned RecordIO record variant (``io.recordio``), the epoch
+    cache footer (``io.cached_input_split``) and checkpoint shard
+    manifests (``checkpoint.sharded``).  Native C fast path
+    (``cpp/dmlc_native.cc``), table-driven Python fallback.
+  * the ``DMLC_INTEGRITY_POLICY`` knob — what a reader does with a
+    record that fails its checksum (or a corrupted frame header):
+
+      ``raise``       (default) fail loudly — the pre-PR behavior for
+                      structural corruption, now extended to payloads
+      ``skip``        drop the record, count it, resync to the next
+                      record head, keep reading
+      ``quarantine``  like ``skip``, but also record the poisoned
+                      ``(source, span)`` in the process-wide skip-list
+                      so a rollback-and-replay (resilience.selfheal)
+                      deterministically replays AROUND the poison: the
+                      byte-range partition contract reproduces the same
+                      record begins, and readers drop quarantined spans
+                      on sight
+
+  * the quarantine registry itself — consulted by every RecordIO read
+    path (stream reader, chunk reader, splitter, packed feed) and
+    reported in self-heal postmortems as the suspect-span list.
+
+Every event lands in the ``dmlc_integrity_*`` metric family
+(telemetry/metric_names.py) and the structured event ring.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..base import DMLCError
+
+__all__ = [
+    "CorruptRecord",
+    "crc32c",
+    "policy",
+    "handle_corrupt",
+    "record_quarantine",
+    "is_quarantined",
+    "has_quarantine",
+    "should_drop",
+    "quarantined_spans",
+    "reset_quarantine",
+]
+
+ENV_POLICY = "DMLC_INTEGRITY_POLICY"
+_POLICIES = ("raise", "skip", "quarantine")
+
+
+class CorruptRecord(DMLCError):
+    """A record failed its integrity check under policy ``raise``."""
+
+
+# ---------------------------------------------------------------------------
+# CRC-32C (Castagnoli, reflected poly 0x82F63B78)
+# ---------------------------------------------------------------------------
+
+def _make_table() -> List[int]:
+    tbl = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ 0x82F63B78 if c & 1 else c >> 1
+        tbl.append(c)
+    return tbl
+
+
+_TABLE = _make_table()
+
+
+def _crc32c_py(data, value: int = 0) -> int:
+    mv = memoryview(data)
+    if mv.ndim != 1 or mv.itemsize != 1:
+        mv = mv.cast("B")
+    c = value ^ 0xFFFFFFFF
+    tbl = _TABLE
+    for b in mv.tobytes():
+        c = tbl[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF
+
+
+def crc32c(data, value: int = 0) -> int:
+    """CRC-32C of ``data`` (any bytes-like), chained from ``value``.
+
+    One algorithm everywhere: files stamped by the native path verify
+    under the Python fallback and vice versa."""
+    from .. import native
+
+    c = native.crc32c(data, value)
+    if c is not None:
+        return c
+    return _crc32c_py(data, value)
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+def policy() -> str:
+    """The active corruption policy (re-read per call: tests and the
+    self-heal rollback flip it at runtime)."""
+    p = os.environ.get(ENV_POLICY, "raise").strip().lower() or "raise"
+    if p not in _POLICIES:
+        raise DMLCError(
+            f"bad {ENV_POLICY}={p!r} (choose from {_POLICIES})")
+    return p
+
+
+# ---------------------------------------------------------------------------
+# quarantine skip-list
+# ---------------------------------------------------------------------------
+
+_lock = threading.Lock()
+# source -> {begin_offset: end_offset}; begins are the deterministic
+# record-head offsets the byte-range partition contract reproduces, so
+# a replay recognizes the same poison in any world size
+_spans: Dict[str, Dict[int, int]] = {}
+
+
+def record_quarantine(source: str, begin: int, end: int,
+                      part: Optional[int] = None) -> None:
+    """Add a poisoned span to the skip-list (idempotent per (source,
+    begin)) and count it."""
+    from .. import telemetry
+
+    with _lock:
+        per = _spans.setdefault(source, {})
+        fresh = begin not in per
+        per[begin] = max(end, per.get(begin, end))
+    if fresh:
+        telemetry.inc("integrity", "quarantined_spans")
+        telemetry.record_event("quarantine", source=source,
+                               begin=begin, end=end,
+                               part="" if part is None else str(part))
+
+
+def is_quarantined(source: Optional[str], begin: Optional[int]) -> bool:
+    if source is None or begin is None or not _spans:
+        # the unlocked emptiness probe is a benign race (_spans only
+        # ever grows between resets): it keeps the per-record hot read
+        # paths lock-free in the common nothing-quarantined case
+        return False
+    with _lock:
+        per = _spans.get(source)
+        return per is not None and begin in per
+
+
+def has_quarantine(source: Optional[str]) -> bool:
+    """True when ``source`` has any quarantined span — the per-chunk
+    probe readers use before paying for per-record consultation."""
+    if source is None or not _spans:
+        return False
+    with _lock:
+        return bool(_spans.get(source))
+
+
+def should_drop(source: Optional[str], begin: Optional[int]) -> bool:
+    """Skip-list consultation on the read path: True (and counted) when
+    the record at ``begin`` was quarantined and the replay must drop
+    it."""
+    if not is_quarantined(source, begin):
+        return False
+    from .. import telemetry
+
+    telemetry.inc("integrity", "skiplist_drops")
+    return True
+
+
+def quarantined_spans(source: Optional[str] = None
+                      ) -> List[Tuple[str, int, int]]:
+    """Snapshot of the skip-list — the self-heal postmortem's
+    suspect-span report."""
+    with _lock:
+        if source is not None:
+            return [(source, b, e)
+                    for b, e in sorted(_spans.get(source, {}).items())]
+        return [(s, b, e) for s, per in sorted(_spans.items())
+                for b, e in sorted(per.items())]
+
+
+def reset_quarantine() -> None:
+    with _lock:
+        _spans.clear()
+
+
+# ---------------------------------------------------------------------------
+# policy application
+# ---------------------------------------------------------------------------
+
+def handle_corrupt(what: str, *, source: Optional[str] = None,
+                   begin: Optional[int] = None,
+                   end: Optional[int] = None,
+                   part: Optional[int] = None) -> None:
+    """One corrupt record detected: count it, then apply the policy —
+    raise :class:`CorruptRecord` under ``raise``, return (caller skips /
+    resyncs) under ``skip``, additionally record the span under
+    ``quarantine``."""
+    from .. import telemetry
+
+    telemetry.inc("integrity", "corrupt_records")
+    p = policy()
+    where = (f"{source or '<stream>'}"
+             + (f" @[{begin},{end})" if begin is not None else ""))
+    telemetry.record_event("corrupt_record", what=what, where=where,
+                           policy=p)
+    if p == "raise":
+        raise CorruptRecord(f"corrupt record ({what}) at {where}")
+    if p == "quarantine" and source is not None and begin is not None:
+        record_quarantine(source, begin,
+                          end if end is not None else begin, part=part)
+    from ..logging import warning
+
+    warning(f"integrity: {what} at {where} — record "
+            f"{'quarantined' if p == 'quarantine' else 'skipped'}")

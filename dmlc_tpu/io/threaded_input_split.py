@@ -28,6 +28,10 @@ class ThreadedInputSplit(InputSplit):
         self._base = base
         self._cap = max_capacity
         self._chunk: Optional[ChunkCursor] = None
+        self.last_chunk_begin: Optional[int] = None  # of the chunk most
+        # recently served by next_chunk (integrity quarantine keys);
+        # rides the cursor through the prefetch queue, so prefetch depth
+        # never skews it
         self._last_produce_end: Optional[float] = None
         self._iter: ThreadedIter = ThreadedIter(
             self._produce, self._rewind, max_capacity=max_capacity
@@ -81,6 +85,7 @@ class ThreadedInputSplit(InputSplit):
         if not ok:
             return None
         self._chunk = cur
+        self.last_chunk_begin = cur.gbegin
         return memoryview(cur.data)[cur.pos : cur.end]
 
     def before_first(self) -> None:

@@ -568,7 +568,7 @@ def forward_decode(params, ids, positions, k_cache, v_cache, lengths,
 
 
 def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
-                    ledger: bool = True):
+                    ledger: bool = True, grad_norm: bool = False):
     """Build a jitted SPMD train step over ``mesh``.
 
     Returns (train_step, init_state) where
@@ -582,6 +582,12 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
     tracker watchdog and ``dmlc top`` read.  Wall time is host dispatch
     time; under steady-state async dispatch that converges to device
     step time (the dispatch queue is device-throttled).
+
+    With ``grad_norm`` the step additionally returns the global L2 norm
+    of the gradients as a fourth output — one scalar that goes
+    non-finite whenever ANY gradient does, which is what the self-heal
+    guard (resilience.selfheal) checks per step: a NaN that has not yet
+    reached the loss is caught before the optimizer commits it.
     """
     import optax
 
@@ -603,6 +609,8 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
         loss, grads = local(params, ids, labels)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        if grad_norm:
+            return params, opt_state, loss, optax.global_norm(grads)
         return params, opt_state, loss
 
     def init_state(params):

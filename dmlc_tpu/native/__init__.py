@@ -25,7 +25,7 @@ import numpy as np
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(os.path.dirname(_HERE), "cpp", "dmlc_native.cc")
 _SO = os.path.join(_HERE, "libdmlc_native.so")
-_ABI = 4
+_ABI = 5
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -98,6 +98,8 @@ def _load():
             c.c_void_p, c.c_long, c.c_void_p, c.c_long, c.c_long,
             c.c_void_p, c.c_void_p, c.c_long, c.c_long, c.c_int,
             c.c_void_p, c.POINTER(c.c_long), c.POINTER(c.c_int)]
+        lib.dmlc_crc32c.restype = c.c_uint32
+        lib.dmlc_crc32c.argtypes = [c.c_void_p, c.c_long, c.c_uint32]
         _lib = lib
         return _lib
 
@@ -334,3 +336,14 @@ def recordio_find_last(data, magic: int) -> Optional[int]:
         return None
     _, ptr, n = _as_carray(data)
     return int(lib.dmlc_recordio_find_last(ptr, n, magic))
+
+
+def crc32c(data, value: int = 0) -> Optional[int]:
+    """CRC-32C (Castagnoli) of ``data`` chained from ``value``, or None
+    when the native library is unavailable (io.integrity falls back to
+    its Python table)."""
+    lib = _load()
+    if lib is None:
+        return None
+    _, ptr, n = _as_carray(data)
+    return int(lib.dmlc_crc32c(ptr, n, value & 0xFFFFFFFF))

@@ -20,6 +20,12 @@ other subsystem shares:
                  or :func:`maybe_corrupt` (corrupt a storage response).
                  Used by tests and the CI chaos stage
                  (``scripts/chaos_smoke.py``); free when unset.
+  * ``selfheal`` — :class:`SelfHealGuard`: the self-healing training
+                 loop's policy engine — non-finite loss/grad and
+                 EWMA-spike detection with a skip → rollback-and-replay
+                 → abort escalation ladder, wired to the integrity
+                 layer's quarantine skip-list (io.integrity) and the
+                 PR 3 postmortem dump.
 
 Typical use::
 
@@ -45,11 +51,17 @@ from .retry import (  # noqa: F401
     RetryPolicy,
     default_retryable,
 )
+from .selfheal import (  # noqa: F401
+    SelfHealAbort,
+    SelfHealGuard,
+)
 
 __all__ = [
     "FaultInjected",
     "FaultInjector",
     "RetryPolicy",
+    "SelfHealAbort",
+    "SelfHealGuard",
     "TRANSIENT_HTTP",
     "default_retryable",
     "fault_point",

@@ -349,6 +349,14 @@ class InferenceEngine:
             self._finish(req, error=f"prefill failed: {e!r}")
             return
         if not req.generated:
+            if not np.isfinite(logits).all():
+                # same guard at the prefill sample point: the first
+                # token must not come from a non-finite row either
+                telemetry.inc("serving", "nonfinite_failures")
+                self._finish(req, error="non-finite logits during "
+                             "prefill (numeric corruption); retry the "
+                             "request")
+                return
             next_id = int(np.argmax(logits))
             req.generated.append(next_id)
             telemetry.inc("serving", "tokens_generated")
@@ -414,9 +422,26 @@ class InferenceEngine:
         telemetry.step_end(tokens=float(b), flops=flops)
         telemetry.inc("serving", "decode_steps")
         telemetry.observe("serving", "decode_batch", b)
+        # per-sequence numeric health: a non-finite logit row (NaN/Inf
+        # from a poisoned cache page or an overflowed activation) would
+        # serve garbage silently.  Checking only the sampled position is
+        # sufficient — argmax lands on the first NaN (NaN propagates
+        # through maximum) and an all--inf row argmaxes to -inf — and
+        # keeps the guard O(1) per row instead of O(vocab) on the decode
+        # hot path.  Fail exactly that request with a clear error; the
+        # rest of the batch (and the engine) keep serving.
         for i, req in enumerate(active):
-            self.cache.append(req.id, k_new[:, i], v_new[:, i])
             next_id = int(np.argmax(logits[i]))
+            if not np.isfinite(logits[i, next_id]):
+                telemetry.inc("serving", "nonfinite_failures")
+                logger.error("request %d produced non-finite logits at "
+                             "decode position %d", req.id,
+                             int(lengths[i]))
+                self._finish(req, error="non-finite logits during "
+                             "decode (numeric corruption); retry the "
+                             "request")
+                continue
+            self.cache.append(req.id, k_new[:, i], v_new[:, i])
             req.generated.append(next_id)
             telemetry.inc("serving", "tokens_generated")
             if req.is_finished_by(next_id):

@@ -72,7 +72,7 @@ class _RankState:
     __slots__ = ("recent", "steps", "ewma_fast", "ewma_slow",
                  "goodput_ewma", "goodput_peak", "feed_frac_ewma",
                  "last", "last_seq", "anchor", "consec", "active",
-                 "active_since")
+                 "active_since", "remediation")
 
     def __init__(self):
         self.recent: deque = deque(maxlen=_RECENT)
@@ -88,6 +88,7 @@ class _RankState:
         self.consec: Dict[str, int] = {k: 0 for k in ANOMALY_KINDS}
         self.active: set = set()
         self.active_since: Dict[str, float] = {}
+        self.remediation: Optional[Dict] = None  # shipped selfheal doc
 
 
 def _ewma(prev: Optional[float], x: float, alpha: float) -> float:
@@ -124,7 +125,12 @@ class Watchdog:
         payloads are dropped (the aggregator already warned)."""
         try:
             doc = json.loads(payload)
-            trace = doc.get("trace") if isinstance(doc, dict) else None
+            if not isinstance(doc, dict):
+                return
+            sh = doc.get("selfheal")
+            if isinstance(sh, dict):
+                self.ingest_remediation(rank, sh)
+            trace = doc.get("trace")
             if not isinstance(trace, dict):
                 return
             steps = trace.get("steps")
@@ -132,6 +138,26 @@ class Watchdog:
                 self.ingest(rank, steps, anchor=trace.get("anchor"))
         except Exception:  # noqa: BLE001 - accept loop must survive
             pass
+
+    def ingest_remediation(self, rank: int, doc: Dict) -> None:
+        """Record a worker's shipped self-heal status (a small scalar
+        doc: last_action/reason/step/skips/rollbacks) so /anomalies and
+        ``dmlc top`` show what the cluster DID about a flag, not just
+        that one fired."""
+        if rank < 0 or not isinstance(doc, dict):
+            return
+        clean = {}
+        for k in ("last_action", "reason", "step", "skips", "rollbacks",
+                  "consecutive", "t"):
+            v = doc.get(k)
+            if isinstance(v, (int, float)) or (isinstance(v, str)
+                                               and len(v) <= 256):
+                clean[k] = v
+        if not clean:
+            return
+        with self._lock:
+            st = self._ranks.setdefault(rank, _RankState())
+            st.remediation = clean
 
     def ingest(self, rank: int, records: List[Dict],
                anchor: Optional[float] = None) -> None:
@@ -154,6 +180,7 @@ class Watchdog:
                     fresh.consec = st.consec
                     fresh.active = st.active
                     fresh.active_since = st.active_since
+                    fresh.remediation = st.remediation
                     st = self._ranks[rank] = fresh
                 st.anchor = anchor
         for rec in records:
@@ -299,6 +326,7 @@ class Watchdog:
                     "goodput_tokens_per_s": st.goodput_ewma,
                     "mfu": last.get("mfu"),
                     "flags": sorted(st.active),
+                    "remediation": st.remediation,
                 }
                 for kind in sorted(st.active):
                     active.append({"rank": r, "kind": kind,

@@ -519,6 +519,15 @@ class HeartbeatSender:
 
     def send_once(self) -> None:
         doc = exporters.export_json(include_buckets=True)
+        # self-heal remediation status (resilience.selfheal): a few
+        # scalar fields riding every beat once a guard has acted, so
+        # the tracker watchdog can show WHAT the worker did about a
+        # flagged step (the /anomalies `remediation` field)
+        from ..resilience import selfheal
+
+        sh = selfheal.status()
+        if sh:
+            doc["selfheal"] = sh
         if self.ship_trace:
             doc["trace"] = self._trace_doc()
             payload = self._capped_payload(doc)

@@ -77,6 +77,14 @@ METRIC_NAMES = frozenset({
     "dmlc_io_reads",
     "dmlc_io_write_bytes",
     "dmlc_io_writes",
+    # data integrity (io.integrity: CRC32C framing, quarantine,
+    # verified reads, checkpoint digests, epoch-cache footer)
+    "dmlc_integrity_corrupt_records",
+    "dmlc_integrity_quarantined_spans",
+    "dmlc_integrity_skiplist_drops",
+    "dmlc_integrity_read_verify_failures",
+    "dmlc_integrity_checksum_failures",
+    "dmlc_io_cache_integrity_failures",
     # model / moe
     "dmlc_moe_overflow_checks",
     "dmlc_moe_overflow_fraction_sum",
@@ -96,6 +104,12 @@ METRIC_NAMES = frozenset({
     "dmlc_recordio_bytes",
     "dmlc_recordio_partition_scan_secs",
     "dmlc_recordio_records",
+    # self-healing training loop (resilience.selfheal)
+    "dmlc_selfheal_skips",
+    "dmlc_selfheal_rollbacks",
+    "dmlc_selfheal_aborts",
+    "dmlc_selfheal_nonfinite_steps",
+    "dmlc_selfheal_spike_steps",
     # resilience
     "dmlc_resilience_faults_injected",
     "dmlc_resilience_hosts_blacklisted",
@@ -121,6 +135,7 @@ METRIC_NAMES = frozenset({
     "dmlc_serving_kv_blocks_in_use",
     "dmlc_serving_kv_blocks_total",
     "dmlc_serving_latency_secs",
+    "dmlc_serving_nonfinite_failures",
     "dmlc_serving_preemptions",
     "dmlc_serving_prefill_secs",
     "dmlc_serving_prefill_tokens",
@@ -172,6 +187,8 @@ NON_METRIC_TOKENS = frozenset({
     "dmlc_tracker",       # reference repo path tracker/dmlc_tracker/…
     "dmlc_anomaly",       # prose prefix for the dmlc_anomaly_* family
     "dmlc_elastic",       # prose prefix for the dmlc_elastic_* family
+    "dmlc_integrity",     # prose prefix for the dmlc_integrity_* family
+    "dmlc_selfheal",      # prose prefix for the dmlc_selfheal_* family
     "dmlc_serving",       # prose prefix for the dmlc_serving_* family
     "dmlc_serve",         # bin/dmlc-serve launcher name in prose
     "dmlc_recordio_spans",  # native ABI symbol (dmlc_native.cc)

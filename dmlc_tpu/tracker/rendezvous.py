@@ -637,6 +637,12 @@ class RabitTracker:
                     if doc is None:
                         continue
                     self.telemetry.update(w.rank, doc)
+                    sh = doc.get("selfheal")
+                    if isinstance(sh, dict):
+                        # self-heal remediation status: /anomalies (and
+                        # dmlc top) show what the worker DID about a
+                        # flagged step, not just that one fired
+                        self.watchdog.ingest_remediation(w.rank, sh)
                     trace = doc.get("trace")
                     if isinstance(trace, dict):
                         self.flight.ingest(w.rank, trace, host=w.host)

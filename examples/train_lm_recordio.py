@@ -23,6 +23,15 @@ collective interrupted by a preempted peer raises WorldResized; the
 loop re-enters rendezvous (possibly under a new rank), repartitions the
 feed in place, restores params+optimizer state from the last COMMITTED
 checkpoint onto the mesh, and keeps training without a process restart.
+
+Self-healing (resilience.selfheal): every step's loss and gradient
+norm pass through a SelfHealGuard — a non-finite or EWMA-spiking step
+is SKIPPED (jax arrays are immutable, so reverting to the pre-step
+(params, opt_state) references is free); DMLC_SELFHEAL_MAX_SKIPS
+consecutive skips trigger a ROLLBACK-AND-REPLAY to the last committed
+checkpoint (the WorldResized recovery path's restore/resync machinery,
+reused) with integrity-quarantined spans skip-listed out of the replay;
+exhausted rollbacks ABORT with a postmortem naming the suspect spans.
 """
 
 import os
@@ -100,28 +109,24 @@ class _ElasticTrainer:
 
     def allreduce_grads(self, grads, loss: float):
         """Average gradients (and the loss) over the elastic world via
-        the host collective; raises WorldResized on membership change."""
+        the host collective; raises WorldResized on membership change.
+        Also returns the global grad norm (computed on the AVERAGED
+        gradients, so every rank reaches the same self-heal verdict)."""
         leaves, treedef, flat = self._flatten(grads)
         flat = np.concatenate([flat.astype(np.float32),
                                np.asarray([loss], np.float32)])
         total = self.client.allreduce_sum(flat)
         total /= float(self.client.world_size)
+        gnorm = float(np.sqrt(np.sum(np.square(total[:-1]),
+                                     dtype=np.float64)))
         return (self._unflatten(leaves, treedef, total[:-1]),
-                float(total[-1]))
+                float(total[-1]), gnorm)
 
-    def resync(self, feed, params, opt_state, done: int):
-        """WorldResized recovery: re-enter rendezvous, repartition the
-        feed, then make rank 0's state authoritative everywhere.
-
-        Rank 0 restores the last COMMITTED checkpoint when one exists
-        (its own memory otherwise — early preemptions before the first
-        save) and broadcasts (params, opt_state, step) to the new
-        world: the interrupted step's allreduce may have completed on
-        some ranks and not others, so replicas are one step apart
-        until this broadcast realigns them.  May itself raise
-        WorldResized (another resize mid-recovery); callers loop."""
-        self.client.resize()
-        feed.resize(self.world)
+    def _broadcast_state(self, params, opt_state, done: int):
+        """Make rank 0's (params, opt_state, step) authoritative
+        everywhere — the shared tail of resync and rollback.  Rank 0
+        restores the last COMMITTED checkpoint when one exists (its own
+        memory otherwise) and broadcasts to the world."""
         if self.client.rank == 0:
             step, restored = self.manager.restore_latest(
                 {"params": params, "opt": opt_state}, mesh=self.mesh)
@@ -134,10 +139,37 @@ class _ElasticTrainer:
         flat = self.client.broadcast(
             np.concatenate([flat, [float(done)]]), root=0)
         params, opt_state = self._unflatten(leaves, treedef, flat[:-1])
-        done = int(flat[-1])
+        return params, opt_state, int(flat[-1])
+
+    def resync(self, feed, params, opt_state, done: int):
+        """WorldResized recovery: re-enter rendezvous, repartition the
+        feed, then make rank 0's state authoritative everywhere.
+
+        The interrupted step's allreduce may have completed on some
+        ranks and not others, so replicas are one step apart until the
+        broadcast realigns them.  May itself raise WorldResized
+        (another resize mid-recovery); callers loop."""
+        self.client.resize()
+        feed.resize(self.world)
+        params, opt_state, done = self._broadcast_state(
+            params, opt_state, done)
         print(f"resized into rank {self.client.rank}/"
               f"{self.client.world_size} (gen {self.client.gen}); "
               f"resynced at step {done}", flush=True)
+        return params, opt_state, done
+
+    def rollback(self, feed, params, opt_state, done: int):
+        """Self-heal rollback-and-replay: same restore/broadcast
+        machinery as resync, but membership is unchanged — only the
+        state rolls back (and the data stream restarts; quarantined
+        spans are skip-listed out by the readers).  The guard's verdict
+        is deterministic on the allreduced loss, so every rank calls
+        this on the same step without coordination."""
+        feed.close()  # abandon the in-flight epoch before re-iterating
+        params, opt_state, done = self._broadcast_state(
+            params, opt_state, done)
+        print(f"selfheal: rolled back to committed step {done} "
+              f"(rank {self.client.rank})", flush=True)
         return params, opt_state, done
 
     def close(self):
@@ -193,9 +225,11 @@ def main():
         # ledger=False: this loop drives the step ledger ITSELF so the
         # batch fetch lands inside the step window — feed.wait is then
         # billed to the step's feed-wait share (make_train_step's
-        # built-in ledger would only see the compute half)
+        # built-in ledger would only see the compute half).
+        # grad_norm=True: the self-heal guard checks the global grad
+        # norm each step, catching NaNs before the loss shows them
         step, init_state = make_train_step(
-            mesh, cfg, optimizer=optimizer, ledger=False)
+            mesh, cfg, optimizer=optimizer, ledger=False, grad_norm=True)
         opt_state = init_state(params)
     else:
         # elastic mode shards nothing across processes at the XLA layer
@@ -203,15 +237,39 @@ def main():
         # full replica and the host collective averages gradients
         opt_state = optimizer.init(params)
 
-    manager = start_at = None
+    def _restore_with_stream(mgr, tmpl, mesh, with_stream=True):
+        """restore_latest including the persisted stream position (the
+        count of quality batches consumed when the checkpoint
+        committed); pre-PR checkpoints lack the leaf and restore with
+        position unknown.  ``with_stream=False`` skips the probe —
+        elastic checkpoints never carry the leaf, and probing would
+        fully restore every shard before the miss is detected (2x
+        checkpoint read I/O on every elastic resume)."""
+        from dmlc_tpu.checkpoint import MissingLeaf
+
+        if not with_stream:
+            step, restored = mgr.restore_latest(dict(tmpl), mesh=mesh)
+            return step, restored, None
+        try:
+            step, restored = mgr.restore_latest(
+                dict(tmpl, stream=np.zeros(1, np.int64)), mesh=mesh)
+        except MissingLeaf:
+            step, restored = mgr.restore_latest(dict(tmpl), mesh=mesh)
+            return step, restored, None
+        if step is None:
+            return None, None, None
+        return step, restored, int(np.asarray(restored["stream"])[0])
+
+    manager = start_at = stream_resume = None
     if ckpt_dir:
         from dmlc_tpu.checkpoint import CheckpointManager
 
         manager = CheckpointManager(ckpt_dir, max_to_keep=2)
         # faithful resume: params AND optimizer moments/step count travel
         # together (restoring params alone would reset AdamW's state)
-        start_at, restored = manager.restore_latest(
-            {"params": params, "opt": opt_state}, mesh=mesh)
+        start_at, restored, stream_resume = _restore_with_stream(
+            manager, {"params": params, "opt": opt_state}, mesh,
+            with_stream=not elastic)
         if start_at is not None:
             params, opt_state = restored["params"], restored["opt"]
             print(f"resumed from step {start_at}", flush=True)
@@ -244,19 +302,111 @@ def main():
     # non-elastic: done counts NEW steps this process trains; saves are
     # numbered base+done so a resumed run never re-commits old numbers
     base = start_at or 0
-    # data fast-forward: this feed is deterministic, so replaying
-    # start_at batches puts the stream exactly where the saved run was
+    # data fast-forward: this feed is deterministic, so replaying the
+    # checkpoint's persisted stream position puts the stream exactly
+    # where the saved run was — including batches a self-heal skip
+    # consumed without training (step count alone under-counts those).
+    # Pre-PR checkpoints have no position; start_at approximates it.
     # (a demo-grade skip — it pays full pipeline + transfer cost per
     # discarded batch; production resumes would skip at the host side)
-    skip = start_at or 0
+    skip = (start_at or 0) if stream_resume is None else stream_resume
     if elastic and start_at:
         # elastic restores are repartition points, not replays: done is
         # the ABSOLUTE step (base stays 0) and the stream restarts
         done = start_at
         skip = 0
+    from dmlc_tpu.resilience import SelfHealGuard
+
+    # without a checkpoint dir there is nothing to roll back to, so
+    # the escalation ladder caps at skip -> abort
+    guard = SelfHealGuard(**({} if manager is not None
+                             else {"max_rollbacks": 0}))
+
+    # rollback target when poison strikes before the first commit:
+    # "replaying from step 0" must really mean the pre-training state
+    # (jax arrays are immutable, so these references are a free undo) —
+    # returning the already-trained params with done=0 would re-train
+    # the consumed batches on top of them and desync step count from
+    # optimizer state.  Dropped after the first commit (and never
+    # captured in elastic mode, whose rollback restores via the
+    # trainer) so it doesn't pin a second params+opt copy all run
+    genesis = ((params, opt_state, done, skip)
+               if trainer is None and manager is not None else None)
+
     feed_iter = iter(feed)
     loss = float("nan")
     need_resync = False
+    # done-value at the current stream's batch 0: the deterministic
+    # feed means "replay to step A" = fast-forward (A - stream_base)
+    # quality batches from a fresh stream.  Non-elastic streams always
+    # start at step 0; an elastic stream restarts at each resync (the
+    # partitioning changed), so its base is the resync step
+    stream_base = done if elastic else 0
+    # exact stream position: quality batches consumed from the current
+    # partitioning's deterministic sequence (self-heal skips consume a
+    # batch WITHOUT advancing `done`, so the step count alone
+    # under-counts the position).  `stream_gen` names the partitioning
+    # (bumped at each elastic resync); `ckpt_consumed` snapshots the
+    # position at every commit so a rollback replays the exact count
+    consumed = 0
+    stream_gen = 0
+    ckpt_consumed = {}  # absolute committed step -> (stream_gen, consumed)
+
+    def rollback_and_replay(params, opt_state, done, base, stream_base):
+        """Self-heal rollback: restore the last committed checkpoint
+        and set up the deterministic replay — the feed restarts and
+        fast-forwards back to the restored step (a rollback, unlike a
+        resize, changes no membership, so the per-rank stream is
+        reproducible).  The replay count is the position snapshotted at
+        commit (falling back to the step arithmetic for checkpoints
+        from before this process / partitioning).  Quarantined spans
+        are skip-listed out of the replay by the readers, which is
+        exactly how the job routes around poisoned bytes."""
+        if trainer is not None:
+            params, opt_state, done = trainer.rollback(
+                feed, params, opt_state, done)
+            snap = ckpt_consumed.get(done)
+            if snap is not None and snap[0] == stream_gen:
+                print(f"selfheal: replaying {snap[1]} batches",
+                      flush=True)
+                return params, opt_state, done, snap[1], base, stream_base
+            if done >= stream_base:
+                print(f"selfheal: replaying {done - stream_base} batches",
+                      flush=True)
+                return (params, opt_state, done, done - stream_base,
+                        base, stream_base)
+            # restored state predates this stream (an older committed
+            # step survived a resize): restart the stream at it
+            return params, opt_state, done, 0, base, done
+        restored_step, restored, stream_pos = _restore_with_stream(
+            manager, {"params": params, "opt": opt_state}, mesh,
+            with_stream=trainer is None)
+        feed.close()  # abandon the in-flight epoch
+        if restored_step is None:
+            # poisoned before the first save: the genesis state replays
+            if genesis is None:
+                raise RuntimeError(
+                    "selfheal: no committed checkpoint and no genesis "
+                    "state to roll back to")
+            g_params, g_opt, g_done, g_skip = genesis
+            print("selfheal: no committed checkpoint; rolling back to "
+                  "the genesis state", flush=True)
+            return g_params, g_opt, g_done, g_skip, base, 0
+        params, opt_state = restored["params"], restored["opt"]
+        if restored_step < base:
+            base = restored_step
+        snap = ckpt_consumed.get(restored_step)
+        if snap is not None and snap[0] == stream_gen:
+            replay = snap[1]
+        elif stream_pos is not None:
+            replay = stream_pos
+        else:
+            replay = restored_step  # pre-position checkpoint
+        print(f"selfheal: rolled back to committed step {restored_step};"
+              f" replaying {replay} batches", flush=True)
+        return (params, opt_state, restored_step - base, replay,
+                base, 0)
+
     while done < steps:
         # the step ledger opens BEFORE the batch pull so the feed's
         # consumer wait (feed.wait span) is billed to this step's
@@ -269,6 +419,13 @@ def main():
                     params, opt_state, done = trainer.resync(
                         feed, params, opt_state, done)
                     feed_iter = iter(feed)
+                    stream_base = done  # repartitioned: fresh stream
+                    stream_gen += 1    # old positions are incomparable
+                    consumed = 0
+                    # a resize landing mid-rollback-replay voids the
+                    # replay plan with it: a leftover skip would drop
+                    # never-trained batches from the fresh stream
+                    skip = 0
                     need_resync = False
                 trainer.client.check_resized()
             batch = next(feed_iter, None)
@@ -281,9 +438,13 @@ def main():
             # `skip` — step count stays equal to trained-batch count
             if np.any(np.asarray(batch["length"]) == 0):
                 continue
+            consumed += 1
             if skip > 0:
                 skip -= 1
                 continue
+            # the pre-step references are the free undo for a skipped
+            # (poisoned) step: jax arrays are immutable
+            prev_params, prev_opt = params, opt_state
             with metrics.annotate("train_step"):
                 data = jnp.asarray(batch["data"])
                 toks = jax.lax.bitcast_convert_type(
@@ -291,14 +452,28 @@ def main():
                 ).reshape(-1, SEQ + 1)
                 ids, labels = toks[:, :-1], toks[:, 1:]
                 if trainer is None:
-                    params, opt_state, loss = step(params, opt_state, ids,
-                                                   labels)
+                    params, opt_state, loss, gnorm = step(
+                        params, opt_state, ids, labels)
                 else:
                     local_loss, grads = loss_and_grad(params, ids, labels)
-                    grads, loss = trainer.allreduce_grads(
+                    grads, loss, gnorm = trainer.allreduce_grads(
                         grads, float(local_loss))
                     params, opt_state = apply_update(params, opt_state,
                                                      grads)
+            action = guard.observe(float(loss), grad_norm=float(gnorm),
+                                   step=done + 1)
+            if action == "skip":
+                params, opt_state = prev_params, prev_opt
+                continue
+            if action == "rollback":
+                (params, opt_state, done, skip, base,
+                 stream_base) = rollback_and_replay(
+                    prev_params, prev_opt, done, base, stream_base)
+                feed_iter = iter(feed)
+                consumed = 0  # fresh stream: the replay re-counts
+                continue
+            if action == "abort":
+                guard.raise_abort(done + 1)
         except WorldResized:
             # recovery happens at the top of the next iteration (the
             # resync broadcast can itself hit another resize, and it
@@ -309,13 +484,24 @@ def main():
         done += 1
         if done % 10 == 0 or done == 1:
             print(f"step {done}: loss {float(loss):.4f}", flush=True)
-        if manager is not None and done % 20 == 0 \
-                and (trainer is None or trainer.client.rank == 0):
-            manager.save(base + done, {"params": params, "opt": opt_state})
+        if manager is not None and done % 20 == 0:
+            # every rank snapshots the stream position at the commit
+            # boundary (a later rollback replays exactly this count);
+            # non-elastic checkpoints persist it for exact resume
+            ckpt_consumed[base + done] = (stream_gen, consumed)
+            if trainer is None or trainer.client.rank == 0:
+                tree = {"params": params, "opt": opt_state}
+                if trainer is None:
+                    tree["stream"] = np.asarray([consumed], np.int64)
+                manager.save(base + done, tree)
+                genesis = None  # a committed checkpoint outranks it
     if manager is not None and done % 20 != 0 \
             and (trainer is None or trainer.client.rank == 0):
         # periodic save already hit on multiples of 20
-        manager.save(base + done, {"params": params, "opt": opt_state})
+        tree = {"params": params, "opt": opt_state}
+        if trainer is None:
+            tree["stream"] = np.asarray([consumed], np.int64)
+        manager.save(base + done, tree)
     if trainer is not None:
         trainer.close()
     snap = metrics.snapshot()

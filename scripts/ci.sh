@@ -46,6 +46,18 @@
 #      restore; no process restart), POST /resize + a fresh worker
 #      grows it back to 3, and the per-step loss trajectory matches an
 #      uninterrupted oracle; dmlc_elastic_* asserted on /metrics
+#  11. integrity smoke: end-to-end data integrity + self-healing —
+#      pre-PR RecordIO bytes stay identical and the CRC32C variant
+#      round-trips; then the real LM example trains over HTTP with
+#      storage.response=corrupt armed (caught by double-read
+#      verification) and three injected non-finite steps (two skips,
+#      one rollback to the committed checkpoint, deterministic
+#      replay), finishing with a loss trajectory equal to an
+#      uninjected oracle; dmlc_integrity_* / dmlc_selfheal_* families
+#      and the /anomalies remediation field asserted on a
+#      strict-Prometheus /metrics, and the quarantine/skip-list,
+#      epoch-cache footer, and corrupt-checkpoint-fallback paths all
+#      exercised onto the metric surface
 #
 # Usage: scripts/ci.sh [pytest-args...]
 set -u
@@ -169,5 +181,9 @@ echo "== stage 10: elastic smoke (kill -> shrink -> grow -> parity) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/elastic_smoke.py \
     || { echo "FAIL: elastic smoke"; exit 1; }
 
+echo "== stage 11: integrity smoke (checksums, quarantine, self-heal) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/integrity_smoke.py \
+    || { echo "FAIL: integrity smoke"; exit 1; }
+
 echo "== CI OK (native=$NATIVE_OK tsan=$TSAN_OK asan=$ASAN_OK" \
-     "telemetry=1 chaos=1 perf=1 serving=1 elastic=1) =="
+     "telemetry=1 chaos=1 perf=1 serving=1 elastic=1 integrity=1) =="

@@ -5,13 +5,15 @@ Polls a running tracker's ``/anomalies`` + ``/healthz`` endpoints
 (telemetry.heartbeat.TelemetryHTTPServer; enable with
 ``DMLC_TRACKER_METRICS_PORT``) and renders one line per rank:
 
-    RANK  STEP ms  EWMA ms  GOODPUT tok/s  MFU%%  FEED%%  HB AGE  FLAGS
+    RANK  STEP ms  EWMA ms  GOODPUT tok/s  MFU%%  FEED%%  HB AGE  FLAGS  REMED
 
 ``STEP``/``EWMA`` come from each rank's shipped step-ledger records,
 ``FEED%%`` is the watchdog's feed-wait-fraction EWMA, ``FLAGS`` are the
 watchdog's active anomaly verdicts (straggler / regression /
-feed_stall / goodput_collapse), and ``HB AGE`` is heartbeat staleness
-from /healthz (dead ranks render as ``DEAD``).
+feed_stall / goodput_collapse), ``REMED`` is the rank's latest
+self-heal remediation (``skip@<step>``, ``rollback@<step>`` — what the
+worker DID about a poisoned step), and ``HB AGE`` is heartbeat
+staleness from /healthz (dead ranks render as ``DEAD``).
 
 Runs full-screen (curses) when stdout is a TTY; ``--plain`` prints one
 table per refresh instead (pipe-friendly, and what the CI smoke
@@ -31,8 +33,25 @@ import urllib.request
 __all__ = ["fetch", "render_table", "main"]
 
 COLUMNS = ("RANK", "STEP ms", "EWMA ms", "GOODPUT", "MFU%", "FEED%",
-           "HB AGE", "FLAGS")
-_FMT = "{:>5} {:>9} {:>9} {:>11} {:>6} {:>6} {:>7}  {}"
+           "HB AGE", "FLAGS", "REMED")
+_FMT = "{:>5} {:>9} {:>9} {:>11} {:>6} {:>6} {:>7}  {:<12} {}"
+
+
+def _remed(st: dict) -> str:
+    """One-token remediation summary: skip@<step> / rollback@<step>
+    (+xN when repeated)."""
+    r = st.get("remediation")
+    if not isinstance(r, dict) or not r.get("last_action"):
+        return "-"
+    out = str(r["last_action"])
+    step = r.get("step")
+    if isinstance(step, (int, float)):
+        out += f"@{int(step)}"
+    n = r.get("rollbacks") if r.get("last_action") == "rollback" \
+        else r.get("skips")
+    if isinstance(n, (int, float)) and n > 1:
+        out += f" x{int(n)}"
+    return out
 
 
 def fetch(base_url: str, timeout: float = 5.0) -> dict:
@@ -92,7 +111,8 @@ def render_table(doc: dict, base_url: str = "") -> str:
             _num(feed * 100 if isinstance(feed, (int, float)) else None,
                  "{:.0f}"),
             _num(age, "{:.1f}s"),
-            flags or "-"))
+            flags or "-",
+            _remed(st)))
     verdicts = (an.get("recent_verdicts") or [])[-3:]
     for v in verdicts:
         lines.append(f"  ! rank {v.get('rank')} {v.get('kind')}: "

@@ -1,0 +1,400 @@
+#!/usr/bin/env python
+"""End-to-end data-integrity + self-healing smoke (ci.sh stage 11).
+
+Three phases:
+
+  A. wire format — the unchecksummed writer still produces bytes
+     IDENTICAL to the reference layout (pre-PR files remain bit-exact),
+     and the CRC32C record variant round-trips through the stream
+     reader and the chunk reader, escape protocol included.
+
+  B. the self-healing training loop, end to end — the real
+     ``examples/train_lm_recordio.py`` spine (elastic mode, world=1,
+     checksummed shard served over HTTP so storage faults apply):
+
+       * oracle run: no faults, 30 steps, loss trajectory recorded;
+       * faulted run: ``storage.response=corrupt`` armed (caught by
+         double-read verification — the corrupted response is healed,
+         never parsed) AND three consecutive non-finite steps injected
+         at step 21 (``selfheal.loss@step:21=corrupt::3``) — two are
+         SKIPPED, the third triggers ROLLBACK to the step-20 committed
+         checkpoint and a deterministic replay.  The run must complete
+         with NO human intervention and its loss trajectory must match
+         the oracle (the replay retrains the same batches in the same
+         order), with the skip/rollback/read-verify counters and the
+         remediation field visible on the tracker's /metrics and
+         /anomalies (strict-Prometheus-validated);
+       * drift run: a transient skip BEFORE the commit plus a later
+         rollback — the replay must fast-forward the snapshotted
+         stream position (skips consume batches the step count never
+         sees), not the step arithmetic.
+
+  C. corruption-path counters on the metric surface — a flipped
+     checksummed record is quarantined (ChunkReader), its span is
+     dropped again on a clean replay (skip-list), a corrupted epoch
+     cache is detected and rebuilt, and a flipped checkpoint shard
+     makes restore_latest fall back one committed step; the harness
+     ships one heartbeat so every ``dmlc_integrity_*`` family lands on
+     /metrics with a real nonzero value (and every asserted name is in
+     the checked-in telemetry/metric_names.py registry).
+
+Exit 0 on success, 1 with a diagnostic on any failure.
+"""
+
+import json
+import os
+import re
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from http.server import SimpleHTTPRequestHandler, ThreadingHTTPServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "examples"))
+
+STEPS = 30
+NAN_STEP = 21   # after the step-20 checkpoint commits
+
+
+def fail(msg: str) -> None:
+    print(f"integrity smoke FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def validate_prometheus(body: str) -> int:
+    from dmlc_tpu.telemetry.exporters import validate_exposition_text
+
+    try:
+        return validate_exposition_text(body)
+    except ValueError as e:
+        fail(f"exposition violation: {e}")
+
+
+def _metric(body: str, name: str, rank: str = "all") -> float:
+    m = re.search(rf'^{name}{{rank="{rank}"}} ([0-9.eE+-]+)$', body,
+                  re.MULTILINE)
+    return float(m.group(1)) if m else 0.0
+
+
+# ---------------------------------------------------------------------------
+# phase A: wire format
+# ---------------------------------------------------------------------------
+
+def phase_wire_format() -> None:
+    from dmlc_tpu.io.recordio import (KMAGIC, RecordIOChunkReader,
+                                      RecordIOReader, RecordIOWriter,
+                                      encode_lrec)
+    from dmlc_tpu.io.stream import MemoryBytesStream
+
+    # 1. pre-PR byte identity: the unchecksummed writer's output is the
+    # reference layout, hand-assembled here
+    s = MemoryBytesStream()
+    RecordIOWriter(s, checksum=False).write_record(b"hello")
+    want = (struct.pack("<I", KMAGIC) + struct.pack("<I", encode_lrec(0, 5))
+            + b"hello" + b"\x00" * 3)
+    if s.getvalue() != want:
+        fail(f"unchecksummed write not byte-identical: "
+             f"{s.getvalue().hex()} != {want.hex()}")
+
+    # 2. checksummed round-trip, escape protocol included
+    magic = struct.pack("<I", KMAGIC)
+    recs = [b"", b"plain", magic * 4, magic + b"xy" + magic, b"z" * 101]
+    s = MemoryBytesStream()
+    w = RecordIOWriter(s, checksum=True)
+    for r in recs:
+        w.write_record(r)
+    if w.except_counter == 0:
+        fail("escape protocol never triggered in the checksummed fixture")
+    data = s.getvalue()
+    got = list(RecordIOReader(MemoryBytesStream(data)))
+    if got != recs:
+        fail("checksummed stream-reader round-trip mismatch")
+    got = [bytes(r) for r in RecordIOChunkReader(data)]
+    if got != recs:
+        fail("checksummed chunk-reader round-trip mismatch")
+    print("integrity smoke: wire format OK (pre-PR bytes identical, "
+          "CRC32C variant round-trips)", flush=True)
+
+
+# ---------------------------------------------------------------------------
+# phase B: self-healing training loop end to end
+# ---------------------------------------------------------------------------
+
+def _serve_http(directory: str):
+    class H(SimpleHTTPRequestHandler):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, directory=directory, **kw)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd
+
+
+def _loss_lines(out: str) -> dict:
+    losses = {}
+    for m in re.finditer(r"^step (\d+): loss ([0-9.eE+-]+)$", out,
+                         re.MULTILINE):
+        losses[int(m.group(1))] = float(m.group(2))
+    m = re.search(r"^final loss ([0-9.eE+-]+);", out, re.MULTILINE)
+    if m:
+        losses["final"] = float(m.group(1))
+    return losses
+
+
+def _train_run(tmp: str, uri: str, tag: str, extra_env: dict):
+    from dmlc_tpu.tracker import RabitTracker
+
+    tracker = RabitTracker("127.0.0.1", 1, metrics_port=0, elastic=True)
+    tracker.start(1)
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        DMLC_TRACKER_URI="127.0.0.1",
+        DMLC_TRACKER_PORT=str(tracker.port),
+        DMLC_TASK_ID="0",
+        DMLC_ELASTIC="1",
+        DMLC_RECORDIO_CHECKSUM="1",
+        **extra_env,
+    )
+    if "DMLC_FAULT_SPEC" not in extra_env:
+        env.pop("DMLC_FAULT_SPEC", None)  # an inherited spec would skew
+    p = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "examples", "train_lm_recordio.py"),
+         uri, str(STEPS), os.path.join(tmp, f"ck_{tag}")],
+        env=env, capture_output=True, text=True, timeout=600)
+    port = tracker.metrics_port
+    metrics = anomalies = None
+    try:
+        metrics = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10).read().decode()
+        anomalies = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/anomalies", timeout=10).read())
+    except OSError as e:
+        fail(f"{tag}: tracker scrape failed: {e}")
+    if p.returncode != 0:
+        fail(f"{tag} run exited {p.returncode}\nstdout:\n"
+             f"{p.stdout[-3000:]}\nstderr:\n{p.stderr[-3000:]}")
+    tracker.join(timeout=30)
+    tracker.close()
+    return _loss_lines(p.stdout), p.stdout, metrics, anomalies
+
+
+def phase_selfheal_training(tmp: str) -> None:
+    os.environ["DMLC_RECORDIO_CHECKSUM"] = "1"
+    import train_lm_recordio as example
+
+    data = os.path.join(tmp, "d.rec")
+    example.make_data(data, n_records=768)
+    httpd = _serve_http(tmp)
+    uri = f"http://127.0.0.1:{httpd.server_address[1]}/d.rec"
+
+    oracle, _, _, _ = _train_run(tmp, uri, "oracle", {})
+    if "final" not in oracle:
+        fail(f"oracle run produced no final loss: {oracle}")
+    print(f"integrity smoke: oracle run OK (final loss "
+          f"{oracle['final']:.4f})", flush=True)
+
+    spec = (f"storage.response=corrupt::1;"
+            f"selfheal.loss@step:{NAN_STEP}=corrupt::3")
+    healed, out, metrics, anomalies = _train_run(
+        tmp, uri, "faulted",
+        {"DMLC_FAULT_SPEC": spec,
+         "DMLC_INTEGRITY_VERIFY_READS": "1",
+         "DMLC_INTEGRITY_POLICY": "quarantine",
+         "DMLC_SELFHEAL_MAX_SKIPS": "2"})
+    httpd.shutdown()
+
+    if "rolled back to committed step 20" not in out:
+        fail(f"faulted run never rolled back to the step-20 checkpoint:"
+             f"\n{out[-3000:]}")
+    for k in sorted(oracle, key=str):
+        if k not in healed:
+            fail(f"faulted run missing loss at step {k}: {healed}")
+        ref, got = oracle[k], healed[k]
+        if abs(got - ref) > 1e-4 * max(1.0, abs(ref)):
+            fail(f"loss diverged from oracle at step {k}: {got} vs "
+                 f"{ref} (the replay must retrain the same batches)")
+    print(f"integrity smoke: faulted run healed itself — loss matches "
+          f"oracle at steps "
+          f"{sorted(k for k in oracle if k != 'final')} "
+          f"+ final ({healed['final']:.4f})", flush=True)
+
+    validate_prometheus(metrics)
+    for name, want in (("dmlc_selfheal_skips", 2),
+                       ("dmlc_selfheal_rollbacks", 1),
+                       ("dmlc_selfheal_nonfinite_steps", 3),
+                       ("dmlc_integrity_read_verify_failures", 1)):
+        got = _metric(metrics, name)
+        if got < want:
+            fail(f"/metrics {name} = {got} (< {want});\n{metrics[:3000]}")
+        print(f"integrity smoke: {name} = {got:g} OK", flush=True)
+    remed = (anomalies.get("ranks") or {}).get("0", {}).get("remediation")
+    if not isinstance(remed, dict) or remed.get("rollbacks", 0) < 1:
+        fail(f"/anomalies remediation missing/empty for rank 0: {remed}")
+    print(f"integrity smoke: /anomalies remediation = "
+          f"{remed.get('last_action')}@{remed.get('step')} "
+          f"(rollbacks={remed.get('rollbacks')}) OK", flush=True)
+
+    # exact-position replay: a TRANSIENT skip before the step-20 commit
+    # consumes a batch without advancing the step count, so the commit
+    # sits 21 batches into the stream, not 20.  The later rollback must
+    # replay the SNAPSHOTTED position (21) — the step arithmetic (20)
+    # would double-train the 21st batch and silently fork the
+    # trajectory.  (No oracle compare here: the transiently skipped
+    # batch is dropped for good, legitimately changing the losses.)
+    httpd2 = _serve_http(tmp)
+    uri2 = f"http://127.0.0.1:{httpd2.server_address[1]}/d.rec"
+    spec2 = (f"selfheal.loss@step:15=corrupt::1;"
+             f"selfheal.loss@step:{NAN_STEP + 4}=corrupt::3")
+    drift, out2, _, _ = _train_run(
+        tmp, uri2, "driftfix",
+        {"DMLC_FAULT_SPEC": spec2,
+         "DMLC_INTEGRITY_POLICY": "quarantine",
+         "DMLC_SELFHEAL_MAX_SKIPS": "2"})
+    httpd2.shutdown()
+    if "rolled back to committed step 20" not in out2:
+        fail(f"drift run never rolled back to the step-20 checkpoint:"
+             f"\n{out2[-3000:]}")
+    if "replaying 21 batches" not in out2:
+        fail(f"rollback after a transient skip must replay the "
+             f"snapshotted stream position (21 batches), not the step "
+             f"count:\n{out2[-3000:]}")
+    if "final" not in drift:
+        fail(f"drift run produced no final loss: {drift}")
+    print("integrity smoke: transient-skip rollback replays the exact "
+          "stream position (21 batches past a step-20 commit) OK",
+          flush=True)
+
+
+# ---------------------------------------------------------------------------
+# phase C: corruption-path counters on /metrics
+# ---------------------------------------------------------------------------
+
+def phase_counter_surface(tmp: str) -> None:
+    import numpy as np
+
+    from dmlc_tpu import telemetry
+    from dmlc_tpu.checkpoint import CheckpointManager
+    from dmlc_tpu.io import input_split, integrity
+    from dmlc_tpu.io.recordio import RecordIOChunkReader, RecordIOWriter
+    from dmlc_tpu.io.stream import MemoryBytesStream, Stream
+    from dmlc_tpu.telemetry import HeartbeatSender
+    from dmlc_tpu.telemetry.metric_names import METRIC_NAMES
+    from dmlc_tpu.tracker import RabitTracker
+    from dmlc_tpu.tracker.client import TrackerClient
+
+    os.environ["DMLC_INTEGRITY_POLICY"] = "quarantine"
+    integrity.reset_quarantine()
+
+    # corrupt record -> quarantined span (ChunkReader)
+    recs = [bytes([i]) * 16 for i in range(8)]
+    s = MemoryBytesStream()
+    w = RecordIOWriter(s, checksum=True)
+    for r in recs:
+        w.write_record(r)
+    clean = s.getvalue()
+    bad = bytearray(clean)
+    bad[12 + 2 * (12 + 16) + 5] ^= 0x10  # record 2's payload
+    got = [bytes(r) for r in RecordIOChunkReader(
+        bytes(bad), source="smoke.rec", base_offset=0)]
+    if got != recs[:2] + recs[3:]:
+        fail("ChunkReader did not quarantine exactly the corrupt record")
+    # clean replay of the same source -> skip-list drops it again
+    got = [bytes(r) for r in RecordIOChunkReader(
+        clean, source="smoke.rec", base_offset=0)]
+    if got != recs[:2] + recs[3:]:
+        fail("skip-list did not drop the quarantined span on replay")
+
+    # corrupted epoch cache -> detected, counted, rebuilt from source
+    rec_path = os.path.join(tmp, "cache_src.rec")
+    with Stream.create(rec_path, "w") as strm:
+        wr = RecordIOWriter(strm, checksum=True)
+        for r in recs:
+            wr.write_record(r)
+    cache = os.path.join(tmp, "epoch.cache")
+    sp = input_split.create(f"{rec_path}#{cache}", 0, 1, "recordio")
+    n1 = sum(1 for _ in sp)
+    sp.close()
+    raw = bytearray(open(cache, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(cache, "wb").write(bytes(raw))
+    sp = input_split.create(f"{rec_path}#{cache}", 0, 1, "recordio")
+    n2 = sum(1 for _ in sp)
+    sp.close()
+    if n1 != n2:
+        fail(f"cache rebuild served {n2} records (first pass {n1})")
+
+    # flipped checkpoint shard -> restore falls back one committed step
+    mgr = CheckpointManager(os.path.join(tmp, "ck_c"), max_to_keep=3)
+    mgr.save(1, {"w": np.arange(8, dtype=np.float32)})
+    mgr.save(2, {"w": np.arange(8, dtype=np.float32) * 2})
+    shard = os.path.join(tmp, "ck_c", "step_00000002", "w.0-8")
+    raw = bytearray(open(shard, "rb").read())
+    raw[0] ^= 0x01
+    open(shard, "wb").write(bytes(raw))
+    step, restored = mgr.restore_latest(
+        {"w": np.zeros(8, np.float32)})
+    if step != 1 or not np.array_equal(
+            restored["w"], np.arange(8, dtype=np.float32)):
+        fail(f"restore_latest did not fall back to step 1 (got {step})")
+
+    del os.environ["DMLC_INTEGRITY_POLICY"]
+
+    # ship the counters and assert the /metrics surface
+    tracker = RabitTracker("127.0.0.1", 1, metrics_port=0)
+    tracker.start(1)
+    os.environ.update(DMLC_TRACKER_URI="127.0.0.1",
+                      DMLC_TRACKER_PORT=str(tracker.port),
+                      DMLC_TASK_ID="smoke-integrity")
+    client = TrackerClient().start()
+    hb = HeartbeatSender(client, interval=60.0, auto_start=False)
+    hb.send_once()
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{tracker.metrics_port}/metrics",
+        timeout=10).read().decode()
+    n = validate_prometheus(body)
+    client.shutdown()
+    tracker.join(timeout=30)
+    tracker.close()
+
+    families = ("dmlc_integrity_corrupt_records",
+                "dmlc_integrity_quarantined_spans",
+                "dmlc_integrity_skiplist_drops",
+                "dmlc_integrity_checksum_failures",
+                "dmlc_io_cache_integrity_failures")
+    for name in families:
+        if name not in METRIC_NAMES:
+            fail(f"{name} not registered in telemetry/metric_names.py")
+        got = _metric(body, name, rank="0")
+        if got < 1:
+            fail(f"/metrics {name} = {got} (< 1);\n{body[:3000]}")
+        print(f"integrity smoke: {name} = {got:g} OK", flush=True)
+    print(f"integrity smoke: /metrics strict exposition OK "
+          f"({n} samples)", flush=True)
+    telemetry.reset()
+
+
+def main() -> None:
+    from dmlc_tpu import telemetry
+
+    telemetry.reset()
+    with tempfile.TemporaryDirectory() as tmp:
+        phase_wire_format()
+        phase_selfheal_training(tmp)
+        phase_counter_surface(tmp)
+    print("integrity smoke OK")
+
+
+if __name__ == "__main__":
+    t0 = time.time()
+    main()
+    print(f"integrity smoke: total {time.time() - t0:.1f}s")

@@ -145,10 +145,10 @@ def test_checkpoint_manager_retention(tmp_path, mesh):
 
 
 def test_restore_missing_leaf_raises(tmp_path):
-    from dmlc_tpu.base import DMLCError
+    from dmlc_tpu.checkpoint import MissingLeaf
 
     save_pytree(str(tmp_path / "c"), {"a": np.ones(2)})
-    with pytest.raises(DMLCError, match="missing leaf"):
+    with pytest.raises(MissingLeaf, match="missing leaf"):
         restore_pytree(str(tmp_path / "c"),
                        {"a": np.ones(2), "zz": np.ones(2)})
 
@@ -307,3 +307,89 @@ def test_retention_counts_committed_only(tmp_path):
     assert "step_00000001" not in names
     assert {"step_00000002", "step_00000003",
             "step_00000005"} <= set(names)
+
+
+# ---------------------------------------------------------------------------
+# shard digests (CRC32C in the manifest) + corrupt-shard fallback
+# ---------------------------------------------------------------------------
+
+def _flip_byte(path, at=0):
+    raw = bytearray(open(path, "rb").read())
+    raw[at] ^= 0x01
+    open(path, "wb").write(bytes(raw))
+
+
+def test_manifest_records_shard_digests(tmp_path):
+    import json
+
+    save_pytree(str(tmp_path / "ck"), {"w": np.arange(16, dtype=np.float32)})
+    man = json.load(open(tmp_path / "ck" / "manifest.json"))
+    from dmlc_tpu.io.integrity import crc32c
+
+    crcs = man["leaves"]["w"]["crc32c"]
+    assert crcs == {"0-16": crc32c(
+        np.arange(16, dtype=np.float32).tobytes())}
+
+
+def test_flipped_shard_fails_restore_loudly(tmp_path):
+    from dmlc_tpu.base import DMLCError
+
+    save_pytree(str(tmp_path / "ck"), {"w": np.arange(16, dtype=np.float32)})
+    _flip_byte(tmp_path / "ck" / "w.0-16")
+    with pytest.raises(DMLCError, match="CRC32C"):
+        restore_pytree(str(tmp_path / "ck"),
+                       {"w": np.zeros(16, np.float32)})
+
+
+def test_restore_latest_falls_back_past_flipped_shard(tmp_path):
+    """A corrupt newest checkpoint costs one checkpoint interval, not
+    the job: restore_latest falls back to the previous committed step."""
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=3)
+    mgr.save(1, {"w": np.arange(16, dtype=np.float32)})
+    mgr.save(2, {"w": np.arange(16, dtype=np.float32) * 2})
+    _flip_byte(tmp_path / "step_00000002" / "w.0-16")
+    step, restored = mgr.restore_latest({"w": np.zeros(16, np.float32)})
+    assert step == 1
+    np.testing.assert_array_equal(restored["w"],
+                                  np.arange(16, dtype=np.float32))
+
+
+def test_restore_latest_falls_back_past_corrupt_manifest(tmp_path):
+    """Manifest rot is CorruptCheckpoint too: the fallback covers the
+    digest root of trust itself, not just the shards it digests."""
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=3)
+    mgr.save(1, {"w": np.arange(16, dtype=np.float32)})
+    mgr.save(2, {"w": np.arange(16, dtype=np.float32) * 2})
+    man = tmp_path / "step_00000002" / "manifest.json"
+    for rotted in ('{"format": 1', '{"format": 1}', "[]"):
+        man.write_text(rotted)  # torn JSON / lost leaves / wrong shape
+        step, restored = mgr.restore_latest({"w": np.zeros(16, np.float32)})
+        assert step == 1
+        np.testing.assert_array_equal(restored["w"],
+                                      np.arange(16, dtype=np.float32))
+
+
+def test_all_checkpoints_corrupt_raises(tmp_path):
+    from dmlc_tpu.base import DMLCError
+
+    mgr = CheckpointManager(str(tmp_path), max_to_keep=3)
+    mgr.save(1, {"w": np.arange(8, dtype=np.float32)})
+    _flip_byte(tmp_path / "step_00000001" / "w.0-8")
+    with pytest.raises(DMLCError, match="no committed checkpoint"):
+        mgr.restore_latest({"w": np.zeros(8, np.float32)})
+
+
+def test_pre_digest_manifest_restores_unverified(tmp_path):
+    """Old checkpoints (no crc32c field) keep restoring — the digest is
+    an additive manifest field, not a format break."""
+    import json
+
+    save_pytree(str(tmp_path / "ck"), {"w": np.arange(8, dtype=np.float32)})
+    mpath = tmp_path / "ck" / "manifest.json"
+    man = json.load(open(mpath))
+    for leaf in man["leaves"].values():
+        leaf.pop("crc32c", None)
+    open(mpath, "w").write(json.dumps(man))
+    out = restore_pytree(str(tmp_path / "ck"),
+                         {"w": np.zeros(8, np.float32)})
+    np.testing.assert_array_equal(out["w"], np.arange(8, dtype=np.float32))

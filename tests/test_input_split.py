@@ -570,3 +570,77 @@ def test_partition_spans_deterministic_across_instances(tmp_path):
         assert a.partition_spans(n) == b.partition_spans(n)
     a.close()
     b.close()
+
+
+# ---------------------------------------------------------------------------
+# epoch-cache CRC32C footer (io.cached_input_split)
+# ---------------------------------------------------------------------------
+
+def _write_rec_file(path, recs):
+    from dmlc_tpu.io.stream import Stream
+
+    with Stream.create(path, "w") as s:
+        w = RecordIOWriter(s, checksum=True)
+        for r in recs:
+            w.write_record(r)
+
+
+def test_cache_crc_footer_roundtrip(tmp_path):
+    recs = [bytes([i]) * 32 for i in range(20)]
+    rec = str(tmp_path / "src.rec")
+    cache = str(tmp_path / "epoch.cache")
+    _write_rec_file(rec, recs)
+    sp = isplit.create(f"{rec}#{cache}", 0, 1, "recordio")
+    first = [bytes(r) for r in sp]
+    sp.before_first()  # switch to replay
+    second = [bytes(r) for r in sp]
+    sp.close()
+    assert first == recs and second == recs
+    assert open(cache, "rb").read(8) == b"dmlcCC01"
+
+
+def test_corrupted_cache_detected_and_rebuilt(tmp_path):
+    """A rotted cache is counted and discarded; the epoch re-parses from
+    the source instead of failing (or serving the rot)."""
+    from dmlc_tpu import telemetry
+
+    recs = [bytes([i]) * 32 for i in range(20)]
+    rec = str(tmp_path / "src.rec")
+    cache = str(tmp_path / "epoch.cache")
+    _write_rec_file(rec, recs)
+    sp = isplit.create(f"{rec}#{cache}", 0, 1, "recordio")
+    assert len([bytes(r) for r in sp]) == 20
+    sp.close()
+    raw = bytearray(open(cache, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(cache, "wb").write(bytes(raw))
+    before = telemetry.counters_snapshot().get(
+        "io_cache", {}).get("integrity_failures", 0)
+    sp = isplit.create(f"{rec}#{cache}", 0, 1, "recordio")
+    got = [bytes(r) for r in sp]
+    sp.close()
+    assert got == recs
+    after = telemetry.counters_snapshot().get(
+        "io_cache", {}).get("integrity_failures", 0)
+    assert after > before
+    # the rebuilt cache is valid again
+    sp = isplit.create(f"{rec}#{cache}", 0, 1, "recordio")
+    assert [bytes(r) for r in sp] == recs
+    sp.close()
+
+
+def test_legacy_cache_without_footer_still_replays(tmp_path):
+    """Pre-footer caches (u64 size + bytes, no header) replay unchanged."""
+    import struct as _struct
+
+    recs = [bytes([i]) * 16 for i in range(8)]
+    rec = str(tmp_path / "src.rec")
+    cache = str(tmp_path / "legacy.cache")
+    _write_rec_file(rec, recs)
+    chunk = open(rec, "rb").read()
+    with open(cache, "wb") as f:
+        f.write(_struct.pack("<Q", len(chunk)))
+        f.write(chunk)
+    sp = isplit.create(f"{rec}#{cache}", 0, 1, "recordio")
+    assert [bytes(r) for r in sp] == recs
+    sp.close()

@@ -1,6 +1,7 @@
 """RecordIO round trips, incl. the adversarial magic-collision generator
 (mirrors reference test/recordio_test.cc:6-60 — the de-facto fuzzer for the
-escape protocol)."""
+escape protocol), plus the CRC32C record variant and its corruption
+paths under all three DMLC_INTEGRITY_POLICY values."""
 
 import random
 import struct
@@ -9,6 +10,7 @@ import numpy as np
 import pytest
 
 from dmlc_tpu.base import DMLCError
+from dmlc_tpu.io import integrity
 from dmlc_tpu.io.recordio import (
     KMAGIC,
     RecordIOChunkReader,
@@ -21,6 +23,15 @@ from dmlc_tpu.io.recordio import (
 from dmlc_tpu.io.stream import MemoryBytesStream
 
 MAGIC_BYTES = struct.pack("<I", KMAGIC)
+
+POLICIES = ("raise", "skip", "quarantine")
+
+
+@pytest.fixture(autouse=True)
+def _clean_integrity_state():
+    integrity.reset_quarantine()
+    yield
+    integrity.reset_quarantine()
 
 
 def make_adversarial_records(n, seed=0):
@@ -151,3 +162,319 @@ def test_many_zero_length_records(tmp_path):
     assert recs[-1] == b"tail"
     assert all(r == b"" for r in recs[:-1])
     split.close()
+
+
+# ---------------------------------------------------------------------------
+# CRC32C record variant + corruption paths (DMLC_INTEGRITY_POLICY)
+# ---------------------------------------------------------------------------
+
+def write_all_checksummed(recs):
+    strm = MemoryBytesStream()
+    writer = RecordIOWriter(strm, checksum=True)
+    for r in recs:
+        writer.write_record(r)
+    return strm.getvalue(), writer
+
+
+def _payload_offset(data: bytes, record: int) -> int:
+    """Byte offset of record ``record``'s first payload byte in a
+    checksummed file (walks the 12-byte headers)."""
+    pos = 0
+    k = 0
+    while pos < len(data):
+        magic, lrec = struct.unpack_from("<II", data, pos)
+        assert magic == KMAGIC
+        ln = decode_length(lrec)
+        if k == record and decode_flag(lrec) >= 4:
+            return pos + 12
+        pos += 12 + (((ln + 3) >> 2) << 2)
+        k += 1
+    raise AssertionError(f"record {record} not found")
+
+
+def test_checksummed_roundtrip_adversarial():
+    recs = make_adversarial_records(300, seed=11)
+    data, writer = write_all_checksummed(recs)
+    assert writer.except_counter > 0
+    assert list(RecordIOReader(MemoryBytesStream(data))) == recs
+    assert [bytes(r) for r in RecordIOChunkReader(data)] == recs
+
+
+def test_checksummed_partitions_cover_all_records():
+    recs = make_adversarial_records(120, seed=12)
+    data, _ = write_all_checksummed(recs)
+    for num_parts in (1, 2, 5):
+        got = []
+        for part in range(num_parts):
+            got.extend(bytes(r)
+                       for r in RecordIOChunkReader(data, part, num_parts))
+        assert got == recs
+
+
+def test_unchecksummed_bytes_identical_to_reference_layout():
+    """Pre-PR files stay bit-exact: checksum=False must produce the
+    reference wire bytes, header by header."""
+    s = MemoryBytesStream()
+    RecordIOWriter(s, checksum=False).write_record(b"hello")
+    want = MAGIC_BYTES + struct.pack("<I", encode_lrec(0, 5)) \
+        + b"hello\x00\x00\x00"
+    assert s.getvalue() == want
+
+
+def test_old_reader_shape_rejects_checksummed_cflags():
+    """The versioned cflag is what makes new files LOUD on old readers:
+    cflags 4-7 were 'invalid RecordIO' before this variant existed."""
+    data, _ = write_all_checksummed([b"x" * 9])
+    lrec = struct.unpack_from("<I", data, 4)[0]
+    assert decode_flag(lrec) == 4  # checksummed complete
+
+
+@pytest.mark.parametrize("policy", POLICIES)
+def test_fault_spec_flip_through_stream_reader(policy, monkeypatch):
+    """A DMLC_FAULT_SPEC storage.response bit-flip lands on record 0's
+    header; the stream reader resyncs (or raises) per policy."""
+    from dmlc_tpu.resilience import install_injector, reset_injector
+
+    recs = [b"alpha" * 3, b"beta" * 4, b"gamma" * 5]
+    data, _ = write_all_checksummed(recs)
+    inj = install_injector("storage.response=corrupt")
+    try:
+        bad = inj.corrupt("storage.response", data)
+    finally:
+        reset_injector()
+    assert bad != data
+    monkeypatch.setenv("DMLC_INTEGRITY_POLICY", policy)
+    if policy == "raise":
+        with pytest.raises(DMLCError):
+            list(RecordIOReader(MemoryBytesStream(bad)))
+        return
+    got = list(RecordIOReader(MemoryBytesStream(bad), source="s.rec"))
+    assert got == recs[1:]
+    spans = integrity.quarantined_spans("s.rec")
+    if policy == "quarantine":
+        assert spans, "no span quarantined"
+        # replay over CLEAN bytes drops the quarantined record again
+        got = list(RecordIOReader(MemoryBytesStream(data), source="s.rec"))
+        assert got == recs[1:]
+    else:
+        assert not spans
+
+
+@pytest.mark.parametrize("policy", POLICIES)
+def test_fault_spec_flip_through_chunk_reader(policy, monkeypatch):
+    """The same injected flip aimed at a mid-file payload; ChunkReader
+    verifies the CRC and skips/raises per policy."""
+    from dmlc_tpu.resilience import install_injector, reset_injector
+
+    recs = [bytes([65 + i]) * 20 for i in range(5)]
+    data, _ = write_all_checksummed(recs)
+    off = _payload_offset(data, 2)
+    inj = install_injector("storage.response=corrupt")
+    try:
+        bad = data[:off] + inj.corrupt("storage.response", data[off:])
+    finally:
+        reset_injector()
+    monkeypatch.setenv("DMLC_INTEGRITY_POLICY", policy)
+    if policy == "raise":
+        with pytest.raises(DMLCError):
+            list(RecordIOChunkReader(bad))
+        return
+    got = [bytes(r) for r in RecordIOChunkReader(bad, source="c.rec")]
+    assert got == recs[:2] + recs[3:]
+    assert bool(integrity.quarantined_spans("c.rec")) == \
+        (policy == "quarantine")
+
+
+@pytest.mark.parametrize("policy", POLICIES)
+def test_fault_spec_flip_through_packed_feed(policy, monkeypatch, tmp_path):
+    """Bit-flipped bytes on disk driven through the packed device feed:
+    the span scan verifies CRCs and the batch stream skips (or the
+    epoch fails) per policy."""
+    from dmlc_tpu.feed import recordio_packed_feed
+    from dmlc_tpu.io.stream import Stream
+    from dmlc_tpu.parallel import build_mesh
+    from dmlc_tpu.resilience import install_injector, reset_injector
+
+    recs = [bytes([i] * 24) for i in range(32)]
+    path = str(tmp_path / "p.rec")
+    with Stream.create(path, "w") as s:
+        w = RecordIOWriter(s, checksum=True)
+        for r in recs:
+            w.write_record(r)
+    raw = open(path, "rb").read()
+    off = _payload_offset(raw, 7)
+    inj = install_injector("storage.response=corrupt")
+    try:
+        bad = raw[:off] + inj.corrupt("storage.response", raw[off:])
+    finally:
+        reset_injector()
+    open(path, "wb").write(bad)
+    monkeypatch.setenv("DMLC_INTEGRITY_POLICY", policy)
+    mesh = build_mesh(1, dp=1, sp=1, tp=1, pp=1, ep=1)
+
+    def read_all():
+        feed = recordio_packed_feed(path, mesh, buf_bytes=512)
+        got = []
+        for b in feed:
+            d = np.asarray(b["data"])
+            offs = np.asarray(b["offsets"])
+            cnt = int(np.asarray(b["count"])[0])
+            got.extend(d[offs[i]:offs[i + 1]].tobytes()
+                       for i in range(cnt))
+        return got
+
+    if policy == "raise":
+        with pytest.raises(DMLCError):
+            read_all()
+        return
+    assert read_all() == recs[:7] + recs[8:]
+    if policy == "quarantine":
+        assert integrity.quarantined_spans(path)
+        # the skip-list survives the epoch: a clean rewrite of the same
+        # path still skips the poisoned span (rollback-and-replay path)
+        open(path, "wb").write(raw)
+        assert read_all() == recs[:7] + recs[8:]
+
+
+@pytest.mark.parametrize("policy", POLICIES)
+def test_torn_tail_word_through_packed_feed(policy, monkeypatch, tmp_path):
+    """A writer killed exactly one word into the next header leaves an
+    aligned stray magic word at EOF (sizes that misalign by 1-3 bytes
+    are rejected at split admission).  The feed span scan must follow
+    the policy — loud under 'raise', counted and dropped otherwise —
+    not silently serve the file as clean."""
+    from dmlc_tpu import telemetry
+    from dmlc_tpu.feed import recordio_packed_feed
+    from dmlc_tpu.io.stream import Stream
+    from dmlc_tpu.parallel import build_mesh
+
+    recs = [bytes([i] * 24) for i in range(8)]
+    path = str(tmp_path / "t.rec")
+    with Stream.create(path, "w") as s:
+        w = RecordIOWriter(s, checksum=True)
+        for r in recs:
+            w.write_record(r)
+    with open(path, "ab") as f:
+        f.write(MAGIC_BYTES)
+    monkeypatch.setenv("DMLC_INTEGRITY_POLICY", policy)
+    mesh = build_mesh(1, dp=1, sp=1, tp=1, pp=1, ep=1)
+
+    def read_all():
+        feed = recordio_packed_feed(path, mesh, buf_bytes=512)
+        got = []
+        for b in feed:
+            d = np.asarray(b["data"])
+            offs = np.asarray(b["offsets"])
+            cnt = int(np.asarray(b["count"])[0])
+            got.extend(d[offs[i]:offs[i + 1]].tobytes()
+                       for i in range(cnt))
+        return got
+
+    def corrupt_count():
+        return telemetry.counters_snapshot().get("integrity", {}).get(
+            "corrupt_records", 0)
+
+    if policy == "raise":
+        with pytest.raises(DMLCError, match="torn tail"):
+            read_all()
+        return
+    before = corrupt_count()
+    assert read_all() == recs
+    assert corrupt_count() == before + 1
+    if policy == "quarantine":
+        assert integrity.quarantined_spans(path)
+
+
+@pytest.mark.parametrize("policy", ("skip", "quarantine"))
+def test_torn_tail_resync(policy, monkeypatch):
+    """A file truncated mid-record: the tail is dropped and counted,
+    never parsed as data."""
+    recs = [b"first" * 10, b"second" * 10]
+    data, _ = write_all_checksummed(recs)
+    torn = data[: len(data) - 7]
+    monkeypatch.setenv("DMLC_INTEGRITY_POLICY", policy)
+    got = list(RecordIOReader(MemoryBytesStream(torn)))
+    assert got == recs[:1]
+    got = [bytes(r) for r in RecordIOChunkReader(torn)]
+    assert got == recs[:1]
+
+
+def test_torn_tail_raises_by_default():
+    recs = [b"first" * 10, b"second" * 10]
+    data, _ = write_all_checksummed(recs)
+    with pytest.raises(DMLCError):
+        list(RecordIOReader(MemoryBytesStream(data[:-7])))
+
+
+def test_sub_word_torn_tail_raises_by_default():
+    """A writer killed 1-3 bytes into the next header leaves a sub-word
+    tail after a cleanly-parsing record; the word-aligned scans cannot
+    reach those bytes, but policy=raise must still report them."""
+    recs = [b"first" * 10, b"second" * 10]
+    data, _ = write_all_checksummed(recs)
+    torn = data + MAGIC_BYTES[:2]
+    with pytest.raises(DMLCError, match="sub-word"):
+        list(RecordIOChunkReader(torn))
+    with pytest.raises(DMLCError):
+        list(RecordIOReader(MemoryBytesStream(torn)))
+
+
+@pytest.mark.parametrize("policy", ("skip", "quarantine"))
+def test_sub_word_torn_tail_counted_once(policy, monkeypatch):
+    """Under skip/quarantine the stray tail is dropped but counted, and
+    exactly one part of a partitioned chunk (the tail owner) reports."""
+    from dmlc_tpu import telemetry
+
+    recs = [b"first" * 10, b"second" * 10]
+    data, _ = write_all_checksummed(recs)
+    torn = data + MAGIC_BYTES[:2]
+    monkeypatch.setenv("DMLC_INTEGRITY_POLICY", policy)
+
+    def corrupt_count():
+        return telemetry.counters_snapshot().get("integrity", {}).get(
+            "corrupt_records", 0)
+
+    before = corrupt_count()
+    got = [bytes(r) for r in RecordIOChunkReader(torn)]
+    assert got == recs
+    assert corrupt_count() == before + 1
+    before = corrupt_count()
+    got = [bytes(r)
+           for part in range(3)
+           for r in RecordIOChunkReader(torn, part, 3)]
+    assert got == recs
+    assert corrupt_count() == before + 1
+
+
+@pytest.mark.parametrize("policy", ("skip", "quarantine"))
+def test_corrupted_magic_resync(policy, monkeypatch):
+    """A flipped magic word mid-file: the reader resyncs to the next
+    record head and serves everything after it."""
+    recs = [bytes([66 + i]) * 17 for i in range(4)]
+    data, _ = write_all_checksummed(recs)
+    head = _payload_offset(data, 2) - 12
+    bad = bytearray(data)
+    bad[head] ^= 0xFF
+    monkeypatch.setenv("DMLC_INTEGRITY_POLICY", policy)
+    got = list(RecordIOReader(MemoryBytesStream(bytes(bad))))
+    assert got == recs[:2] + recs[3:]
+    got = [bytes(r) for r in RecordIOChunkReader(bytes(bad))]
+    assert got == recs[:2] + recs[3:]
+
+
+def test_corruption_metrics_counted(monkeypatch):
+    from dmlc_tpu import telemetry
+
+    recs = [b"m" * 40, b"n" * 40]
+    data, _ = write_all_checksummed(recs)
+    off = _payload_offset(data, 1)
+    bad = bytearray(data)
+    bad[off] ^= 0x04
+    monkeypatch.setenv("DMLC_INTEGRITY_POLICY", "quarantine")
+    before = telemetry.counters_snapshot().get("integrity", {})
+    list(RecordIOReader(MemoryBytesStream(bytes(bad)), source="q.rec"))
+    after = telemetry.counters_snapshot().get("integrity", {})
+    assert after.get("corrupt_records", 0) > before.get(
+        "corrupt_records", 0)
+    assert after.get("quarantined_spans", 0) > before.get(
+        "quarantined_spans", 0)

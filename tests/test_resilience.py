@@ -626,3 +626,76 @@ def test_threads_dont_leak_from_failure_detector():
     assert not any(t.name == "tracker-failure-detector" and t.is_alive()
                    and not tracker._monitor_stop.is_set()
                    for t in threading.enumerate())
+
+
+# ---------------------------------------------------------------------------
+# verified remote range reads (DMLC_INTEGRITY_VERIFY_READS)
+# ---------------------------------------------------------------------------
+
+def test_verified_read_catches_and_heals_injected_corruption(monkeypatch):
+    """With verification on, one corrupted storage response is caught by
+    the double-read compare and the CLEAN bytes are served."""
+    from dmlc_tpu import telemetry
+    from dmlc_tpu.io.http_filesys import HttpReadStream
+
+    payload = bytes(range(256)) * 4
+
+    class S(HttpReadStream):
+        def __init__(self):
+            super().__init__("http://x", size=len(payload))
+
+        def _fill(self, start, size):
+            return payload[start:start + size]
+
+    monkeypatch.setenv("DMLC_INTEGRITY_VERIFY_READS", "1")
+    install_injector("storage.response=corrupt::1")
+    try:
+        before = telemetry.counters_snapshot().get("integrity", {}).get(
+            "read_verify_failures", 0)
+        out = S().read(len(payload))
+        after = telemetry.counters_snapshot().get("integrity", {}).get(
+            "read_verify_failures", 0)
+    finally:
+        reset_injector()
+    assert out == payload, "corrupted response was served, not healed"
+    assert after == before + 1
+
+
+def test_verified_read_persistent_corruption_raises(monkeypatch):
+    """A source that never returns the same bytes twice is rotten; the
+    verified read gives up loudly after its retry budget."""
+    import os as _os
+
+    from dmlc_tpu.base import DMLCError
+    from dmlc_tpu.io.http_filesys import HttpReadStream
+
+    class S(HttpReadStream):
+        def __init__(self):
+            super().__init__("http://x", size=64)
+
+        def _fill(self, start, size):
+            return _os.urandom(min(size, 64 - start))
+
+    monkeypatch.setenv("DMLC_INTEGRITY_VERIFY_READS", "1")
+    monkeypatch.setenv("DMLC_INTEGRITY_READ_RETRIES", "3")
+    with pytest.raises(DMLCError, match="double-read"):
+        S().read(64)
+
+
+def test_verification_off_by_default_single_fetch(monkeypatch):
+    """The default path must not pay the second fetch."""
+    from dmlc_tpu.io.http_filesys import HttpReadStream
+
+    monkeypatch.delenv("DMLC_INTEGRITY_VERIFY_READS", raising=False)
+    calls = []
+
+    class S(HttpReadStream):
+        def __init__(self):
+            super().__init__("http://x", size=64)
+
+        def _fill(self, start, size):
+            calls.append((start, size))
+            return b"A" * min(size, 64 - start)
+
+    assert S().read(64) == b"A" * 64
+    assert len(calls) == 1

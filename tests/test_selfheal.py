@@ -1,0 +1,148 @@
+"""Self-heal guard: classification, the skip/rollback/abort escalation
+ladder, the chaos injection hook, and the shipped status doc."""
+
+import math
+import os
+
+import pytest
+
+from dmlc_tpu.resilience import install_injector, reset_injector
+from dmlc_tpu.resilience.selfheal import (
+    ABORT,
+    OK,
+    ROLLBACK,
+    SKIP,
+    SelfHealAbort,
+    SelfHealGuard,
+    reset_selfheal,
+    status,
+)
+
+NAN = float("nan")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    reset_selfheal()
+    reset_injector()
+    yield
+    reset_selfheal()
+    reset_injector()
+
+
+def test_healthy_steps_are_ok_and_update_ewma():
+    g = SelfHealGuard(max_skips=2)
+    for i in range(5):
+        assert g.observe(1.0 - 0.1 * i, grad_norm=0.5, step=i) == OK
+    assert g.finite_steps == 5
+    assert g.ewma is not None and 0.5 < g.ewma < 1.0
+
+
+def test_nonfinite_loss_escalation_ladder():
+    """skip x max_skips, then rollback; rollbacks exhausted -> abort."""
+    g = SelfHealGuard(max_skips=2, max_rollbacks=1)
+    g.observe(1.0, step=0)
+    assert g.observe(NAN, step=1) == SKIP
+    assert g.observe(NAN, step=1) == SKIP
+    assert g.observe(NAN, step=1) == ROLLBACK     # 3rd consecutive
+    assert g.rollbacks == 1 and g.consecutive_bad == 0
+    # still poisoned after the rollback: ladder repeats, then aborts
+    assert g.observe(NAN, step=1) == SKIP
+    assert g.observe(NAN, step=1) == SKIP
+    assert g.observe(NAN, step=1) == ABORT
+    with pytest.raises(SelfHealAbort):
+        g.raise_abort(1)
+
+
+def test_recovery_resets_consecutive_count():
+    g = SelfHealGuard(max_skips=2)
+    g.observe(1.0, step=0)
+    assert g.observe(NAN, step=1) == SKIP
+    assert g.observe(1.0, step=1) == OK          # healed
+    assert g.consecutive_bad == 0
+    assert g.observe(NAN, step=2) == SKIP        # a fresh episode skips
+    assert g.observe(NAN, step=2) == SKIP
+
+
+def test_nonfinite_grad_norm_detected_before_loss():
+    g = SelfHealGuard(max_skips=3)
+    assert g.observe(0.7, grad_norm=float("inf"), step=1) == SKIP
+
+
+def test_ewma_spike_gate_after_warmup():
+    g = SelfHealGuard(max_skips=3, spike_factor=10.0, warmup=4)
+    for i in range(6):
+        assert g.observe(1.0, step=i) == OK
+    assert g.observe(1.5, step=6) == OK           # ordinary wobble
+    assert g.observe(50.0, step=7) == SKIP        # 50x the EWMA
+    # a spike is not folded into the baseline
+    assert g.ewma < 2.0
+
+
+def test_spike_gate_disabled_below_factor_one():
+    g = SelfHealGuard(max_skips=3, spike_factor=0.0, warmup=0)
+    for i in range(5):
+        g.observe(1.0, step=i)
+    assert g.observe(1e9, step=9) == OK
+
+
+def test_fault_spec_injection_hook_targets_exact_step():
+    install_injector("selfheal.loss@step:7=corrupt::2")
+    g = SelfHealGuard(max_skips=5)
+    assert g.observe(1.0, step=6) == OK
+    assert g.observe(1.0, step=7) == SKIP   # injected
+    assert g.observe(1.0, step=7) == SKIP   # budget 2
+    assert g.observe(1.0, step=7) == OK     # exhausted
+    assert math.isfinite(g.ewma)
+
+
+def test_status_doc_ships_last_action():
+    g = SelfHealGuard(max_skips=1, max_rollbacks=1)
+    g.observe(1.0, step=3)
+    g.observe(NAN, step=4)
+    doc = status()
+    assert doc["last_action"] == SKIP
+    assert doc["step"] == 4 and doc["skips"] == 1
+    g.observe(NAN, step=4)
+    assert status()["last_action"] == ROLLBACK
+
+
+def test_abort_writes_postmortem_naming_suspect_spans(tmp_path,
+                                                     monkeypatch):
+    import json
+
+    from dmlc_tpu.io import integrity
+
+    monkeypatch.setenv("DMLC_POSTMORTEM_DIR", str(tmp_path))
+    integrity.reset_quarantine()
+    integrity.record_quarantine("poison.rec", 128, 192)
+    try:
+        g = SelfHealGuard(max_skips=0, max_rollbacks=0)
+        g.observe(1.0, step=0)
+        assert g.observe(NAN, step=1) == ABORT
+        dumps = [f for f in os.listdir(tmp_path)
+                 if f.startswith("postmortem")]
+        assert dumps, "abort wrote no postmortem"
+        doc = json.load(open(tmp_path / dumps[0]))
+        assert "selfheal abort" in doc["reason"]
+        assert "poison.rec[128:192]" in doc["reason"]
+    finally:
+        integrity.reset_quarantine()
+
+
+def test_selfheal_counters():
+    from dmlc_tpu import telemetry
+
+    before = telemetry.counters_snapshot().get("selfheal", {})
+    g = SelfHealGuard(max_skips=1, max_rollbacks=1)
+    g.observe(1.0, step=0)
+    g.observe(NAN, step=1)   # skip
+    g.observe(NAN, step=1)   # rollback
+    after = telemetry.counters_snapshot().get("selfheal", {})
+
+    def delta(name):
+        return after.get(name, 0) - before.get(name, 0)
+
+    assert delta("skips") == 1
+    assert delta("rollbacks") == 1
+    assert delta("nonfinite_steps") == 2

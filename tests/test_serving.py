@@ -561,3 +561,44 @@ def test_drain_deadline_fails_leftovers():
     finally:
         srv.close()
         eng.close()
+
+
+def test_engine_fails_only_nonfinite_logit_request():
+    """A non-finite logit row fails exactly that request with a clear
+    error; the other request in the same decode batch (and the engine)
+    keep serving."""
+    from dmlc_tpu import telemetry
+
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=32, block_size=4,
+                          max_active=3, queue_depth=8)
+    r1 = eng.submit([1, 2, 3], max_new_tokens=6)
+    r2 = eng.submit([4, 5, 6], max_new_tokens=3)
+    eng.step()  # prefill r1
+    eng.step()  # prefill r2 (+ decode r1)
+    real = eng._decode
+    fired = []
+
+    def poisoned(p, ids, positions, k, v, lengths, c):
+        lg, kn, vn = real(p, ids, positions, k, v, lengths, c)
+        lg = np.asarray(lg).copy()
+        if not fired:
+            lg[0, :] = np.nan  # r1's row (activation order)
+            fired.append(True)
+        return lg, kn, vn
+
+    eng._decode = poisoned
+    before = telemetry.counters_snapshot().get("serving", {}).get(
+        "nonfinite_failures", 0)
+    for _ in range(20):
+        if r1.wait(0) and r2.wait(0):
+            break
+        eng.step()
+    assert r1.error is not None and "non-finite" in r1.error
+    assert r2.error is None and r2.n_generated == 3
+    after = telemetry.counters_snapshot().get("serving", {}).get(
+        "nonfinite_failures", 0)
+    assert after == before + 1
+    st = eng.stats()
+    assert st["kv"]["blocks_in_use"] == 0  # failed request freed its blocks
+    eng.close()
