@@ -1007,6 +1007,345 @@ int dmlc_comm_allgather(DmlcComm* c, const void* in, long nbytes, void* out) {
 }
 
 // ---------------------------------------------------------------------
+// Standalone same-host shm collective group (see dmlc_collective.h):
+// the intra-host leg of the hierarchical allreduce.  No tracker
+// rendezvous — the caller passes an agreed name + dense intra-group
+// rank — but the chunked counter discipline is the same generation
+// machinery as the DmlcComm shm transport above: per-rank pub/done/cons
+// sequence counters, two slots per rank (double buffering), op
+// announce/agree on chunk 0.  Two differences: the segment carries a
+// small header (authoritative chunk size, attach barrier, abort flag),
+// and there are no result slots — reduce_scatter folds straight into
+// the caller's private buffer, so the segment is world x 2 x chunk.
+// ---------------------------------------------------------------------
+
+struct DmlcShmColl {
+  struct Hdr {
+    alignas(64) std::atomic<long> chunk_ready;  // 0 until rank 0 sizes it
+    alignas(64) std::atomic<int> attached;
+    alignas(64) std::atomic<int> aborted;
+  };
+
+  int rank = -1;
+  int world = 0;
+  char* base = nullptr;
+  size_t bytes = 0;
+  long chunk = 0;
+  long seq = 0;  // group chunk sequence, lockstep on every rank
+  std::string error;
+
+  Hdr* hdr() const { return reinterpret_cast<Hdr*>(base); }
+  ShmCtrl* ctrl(int r) const {
+    return reinterpret_cast<ShmCtrl*>(base + sizeof(Hdr)) + r;
+  }
+  char* slot(int r, int s) const {
+    char* data = base + sizeof(Hdr) + sizeof(ShmCtrl) * world;
+    return data + (static_cast<size_t>(r) * 2 + s) * chunk;
+  }
+  static size_t seg_size(int world, long chunk) {
+    return sizeof(Hdr) + sizeof(ShmCtrl) * world +
+           static_cast<size_t>(world) * 2 * chunk;
+  }
+};
+
+namespace {
+
+bool grp_wait(DmlcShmColl* g, ShmField f, long target) {
+  static const double limit =
+      static_cast<double>(env_long("DMLC_COLL_SHM_TIMEOUT_S", 300));
+  const double deadline = now_seconds() + limit;
+  for (int r = 0; r < g->world; ++r) {
+    ShmCtrl* ct = g->ctrl(r);
+    std::atomic<long>& a = f == SHM_PUB ? ct->pub
+                           : f == SHM_DONE ? ct->done
+                                           : ct->cons;
+    int spins = 0;
+    int yields = 0;
+    while (a.load(std::memory_order_acquire) < target) {
+      // the abort flag is the shm analog of the TCP links being torn:
+      // a peer bailing on the collective (elastic resize, teardown)
+      // wakes everyone promptly instead of costing the full timeout
+      if (g->hdr()->aborted.load(std::memory_order_acquire)) {
+        g->error = "shm group aborted by a peer (resize/teardown)";
+        return false;
+      }
+      if (spins <= 256) ++spins;
+      if (spins > 256) {
+        if (++yields <= 64) {
+          sched_yield();
+        } else {
+          usleep(50);
+        }
+        if ((yields & 63) == 0 && now_seconds() > deadline) {
+          g->error = "shm group timed out waiting on rank " +
+                     std::to_string(r) + " (peer died mid-collective?)";
+          return false;
+        }
+        if (yields > (1 << 20)) yields = 65;
+      }
+    }
+  }
+  return true;
+}
+
+void grp_announce(DmlcShmColl* g, long s, long desc) {
+  g->ctrl(g->rank)->op_start[s & 1].store(s, std::memory_order_relaxed);
+  g->ctrl(g->rank)->op_desc[s & 1].store(desc, std::memory_order_relaxed);
+}
+
+bool grp_agree(DmlcShmColl* g, long s, long desc) {
+  for (int r = 0; r < g->world; ++r) {
+    if (g->ctrl(r)->op_start[s & 1].load(std::memory_order_relaxed) != s ||
+        g->ctrl(r)->op_desc[s & 1].load(std::memory_order_relaxed) != desc) {
+      g->error = "shm group mismatch: rank " + std::to_string(r) +
+                 " is running a different op/size — check that every "
+                 "group member issues identical collectives";
+      return false;
+    }
+  }
+  return true;
+}
+
+bool grp_enter(DmlcShmColl* g) {
+  if (g->base == nullptr) {
+    g->error = "shm group not mapped";
+    return false;
+  }
+  if (g->hdr()->aborted.load(std::memory_order_acquire)) {
+    g->error = "shm group aborted";
+    return false;
+  }
+  return true;
+}
+
+DmlcShmColl* grp_fail(DmlcShmColl* g, const std::string& why) {
+  g_init_error = why;
+  if (g->base != nullptr) munmap(g->base, g->bytes);
+  delete g;
+  return nullptr;
+}
+
+}  // namespace
+
+DmlcShmColl* dmlc_shm_coll_create(const char* name, int rank, int world,
+                                  long chunk_kb) {
+  auto* g = new DmlcShmColl();
+  g->rank = rank;
+  g->world = world;
+  if (name == nullptr || name[0] == '\0' || world <= 0 || rank < 0 ||
+      rank >= world)
+    return grp_fail(g, "bad shm group name/rank/world");
+  std::string nm = name[0] == '/' ? name : std::string("/") + name;
+  const double deadline =
+      now_seconds() +
+      static_cast<double>(env_long("DMLC_COLL_SHM_JOIN_TIMEOUT_S", 60));
+  if (rank == 0) {
+    long chunk = chunk_kb > 0 ? ((chunk_kb << 10) & ~7L) : shm_chunk_bytes();
+    chunk = std::max(4096L, chunk);
+    // fit the segment into the /dev/shm actually available (2 slots per
+    // rank): cap at half the free space, floor 64 KB — same policy as
+    // the DmlcComm transport, so Docker's default 64 MB /dev/shm never
+    // silently fails the group
+    struct statvfs vfs;
+    if (statvfs("/dev/shm", &vfs) == 0) {
+      const long avail = static_cast<long>(vfs.f_bavail) *
+                         static_cast<long>(vfs.f_frsize);
+      const long cap = (avail / 2 / (static_cast<long>(world) * 2)) & ~7L;
+      chunk = std::max(64L << 10, std::min(chunk, cap));
+    }
+    shm_unlink(nm.c_str());  // clear stale litter from a crashed run
+    int fd = shm_open(nm.c_str(), O_CREAT | O_EXCL | O_RDWR, 0600);
+    const size_t size = DmlcShmColl::seg_size(world, chunk);
+    if (fd < 0 || ftruncate(fd, static_cast<off_t>(size)) != 0) {
+      if (fd >= 0) ::close(fd);
+      shm_unlink(nm.c_str());
+      return grp_fail(g, "cannot create shm group segment " + nm);
+    }
+    void* base =
+        mmap(nullptr, size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    ::close(fd);
+    if (base == MAP_FAILED) {
+      shm_unlink(nm.c_str());
+      return grp_fail(g, "cannot map shm group segment " + nm);
+    }
+    g->base = static_cast<char*>(base);
+    g->bytes = size;
+    g->chunk = chunk;
+    // ftruncate zero-fill = counters start at 0; publishing the chunk
+    // is the "segment ready" signal attachers spin on
+    g->hdr()->chunk_ready.store(chunk, std::memory_order_release);
+  } else {
+    int fd = -1;
+    struct stat st {};
+    while (true) {
+      fd = shm_open(nm.c_str(), O_RDWR, 0600);
+      if (fd >= 0 && fstat(fd, &st) == 0 &&
+          st.st_size > static_cast<off_t>(sizeof(DmlcShmColl::Hdr)))
+        break;
+      if (fd >= 0) ::close(fd);
+      fd = -1;
+      if (now_seconds() > deadline)
+        return grp_fail(g, "timed out waiting for rank 0 to create " + nm);
+      usleep(2000);
+    }
+    void* base = mmap(nullptr, static_cast<size_t>(st.st_size),
+                      PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    ::close(fd);
+    if (base == MAP_FAILED)
+      return grp_fail(g, "cannot map shm group segment " + nm);
+    g->base = static_cast<char*>(base);
+    g->bytes = static_cast<size_t>(st.st_size);
+    while ((g->chunk = g->hdr()->chunk_ready.load(
+                std::memory_order_acquire)) == 0) {
+      if (now_seconds() > deadline)
+        return grp_fail(g, "timed out waiting for shm group sizing");
+      usleep(1000);
+    }
+    if (g->bytes != DmlcShmColl::seg_size(world, g->chunk))
+      return grp_fail(g, "shm group segment size mismatch (divergent "
+                         "world across members?)");
+  }
+  // attach barrier: nobody proceeds (and rank 0 does not unlink) until
+  // the whole group has mapped, so the name can be dropped immediately
+  // after — a crashed job never litters /dev/shm
+  g->hdr()->attached.fetch_add(1, std::memory_order_acq_rel);
+  while (g->hdr()->attached.load(std::memory_order_acquire) < world) {
+    if (now_seconds() > deadline) {
+      if (rank == 0) shm_unlink(nm.c_str());
+      return grp_fail(g, "shm group attach barrier timed out (" +
+                             std::to_string(g->hdr()->attached.load()) +
+                             "/" + std::to_string(world) + " attached)");
+    }
+    usleep(1000);
+  }
+  if (rank == 0) shm_unlink(nm.c_str());
+  return g;
+}
+
+int dmlc_shm_coll_reduce_scatter(DmlcShmColl* g, void* data, long count,
+                                 int dtype, int op) {
+  if (op < 0 || op > 2 || dtype < 0 || dtype > 3 || count < 0) return -2;
+  if (g->world <= 1 || count == 0) return 0;
+  if (!grp_enter(g)) return -1;
+  const long esize = (dtype == DMLC_F32 || dtype == DMLC_I32) ? 4 : 8;
+  const int w = g->world, me = g->rank;
+  char* p = static_cast<char*>(data);
+  const long nbytes = count * esize;
+  const long desc = shm_desc(4, (op << 8) | dtype, nbytes);
+  for (long off = 0; off < nbytes; off += g->chunk) {
+    const long n = std::min(g->chunk, nbytes - off);
+    const long s = g->seq++;
+    const int slot = static_cast<int>(s & 1);
+    if (!grp_wait(g, SHM_CONS, s - 1)) return -1;
+    if (off == 0) grp_announce(g, s, desc);
+    memcpy(g->slot(me, slot), p + off, n);
+    g->ctrl(me)->pub.store(s + 1, std::memory_order_release);
+    if (!grp_wait(g, SHM_PUB, s + 1)) return -1;
+    if (off == 0 && !grp_agree(g, s, desc)) return -1;
+    // fold my 1/w slice of this chunk across every rank's published
+    // input, straight into the private buffer (fold order is rank
+    // 0..w-1 for every slice, so results are bit-deterministic and
+    // reduce_scatter+allgather is bit-identical to the allreduce)
+    const long elems = n / esize;
+    const long lo = elems * me / w, cnt = elems * (me + 1) / w - lo;
+    if (cnt > 0) {
+      std::vector<const void*> srcs(w);
+      for (int r = 0; r < w; ++r) srcs[r] = g->slot(r, slot) + lo * esize;
+      fold_multi_bytes(p + off + lo * esize, srcs.data(), w, cnt, dtype, op);
+    }
+    g->ctrl(me)->done.store(s + 1, std::memory_order_release);
+    // cons declares "done READING every peer's seq-s slot" — true only
+    // after the fold above completes
+    g->ctrl(me)->cons.store(s + 1, std::memory_order_release);
+  }
+  return 0;
+}
+
+int dmlc_shm_coll_allgather(DmlcShmColl* g, void* data, long count,
+                            int dtype) {
+  if (dtype < 0 || dtype > 3 || count < 0) return -2;
+  if (g->world <= 1 || count == 0) return 0;
+  if (!grp_enter(g)) return -1;
+  const long esize = (dtype == DMLC_F32 || dtype == DMLC_I32) ? 4 : 8;
+  const int w = g->world, me = g->rank;
+  char* p = static_cast<char*>(data);
+  const long nbytes = count * esize;
+  const long desc = shm_desc(5, dtype, nbytes);
+  for (long off = 0; off < nbytes; off += g->chunk) {
+    const long n = std::min(g->chunk, nbytes - off);
+    const long s = g->seq++;
+    const int slot = static_cast<int>(s & 1);
+    if (!grp_wait(g, SHM_CONS, s - 1)) return -1;
+    if (off == 0) grp_announce(g, s, desc);
+    const long elems = n / esize;
+    const long lo = elems * me / w, cnt = elems * (me + 1) / w - lo;
+    if (cnt > 0)
+      memcpy(g->slot(me, slot) + lo * esize, p + off + lo * esize,
+             cnt * esize);
+    g->ctrl(me)->pub.store(s + 1, std::memory_order_release);
+    if (!grp_wait(g, SHM_PUB, s + 1)) return -1;
+    if (off == 0 && !grp_agree(g, s, desc)) return -1;
+    for (int r = 0; r < w; ++r) {
+      if (r == me) continue;
+      const long rlo = elems * r / w, rcnt = elems * (r + 1) / w - rlo;
+      if (rcnt > 0)
+        memcpy(p + off + rlo * esize, g->slot(r, slot) + rlo * esize,
+               rcnt * esize);
+    }
+    g->ctrl(me)->done.store(s + 1, std::memory_order_release);
+    g->ctrl(me)->cons.store(s + 1, std::memory_order_release);
+  }
+  return 0;
+}
+
+int dmlc_shm_coll_broadcast(DmlcShmColl* g, void* data, long nbytes,
+                            int root) {
+  if (root < 0 || root >= g->world || nbytes < 0) return -2;
+  if (g->world <= 1 || nbytes == 0) return 0;
+  if (!grp_enter(g)) return -1;
+  const int me = g->rank;
+  char* p = static_cast<char*>(data);
+  const long desc = shm_desc(6, root, nbytes);
+  for (long off = 0; off < nbytes; off += g->chunk) {
+    const long n = std::min(g->chunk, nbytes - off);
+    const long s = g->seq++;
+    const int slot = static_cast<int>(s & 1);
+    if (!grp_wait(g, SHM_CONS, s - 1)) return -1;
+    if (off == 0) grp_announce(g, s, desc);
+    if (me == root) memcpy(g->slot(me, slot), p + off, n);
+    g->ctrl(me)->pub.store(s + 1, std::memory_order_release);
+    g->ctrl(me)->done.store(s + 1, std::memory_order_release);
+    if (!grp_wait(g, SHM_PUB, s + 1)) return -1;
+    if (off == 0 && !grp_agree(g, s, desc)) return -1;
+    if (me != root) memcpy(p + off, g->slot(root, slot), n);
+    g->ctrl(me)->cons.store(s + 1, std::memory_order_release);
+  }
+  return 0;
+}
+
+int dmlc_shm_coll_allreduce(DmlcShmColl* g, void* data, long count,
+                            int dtype, int op) {
+  const int rc = dmlc_shm_coll_reduce_scatter(g, data, count, dtype, op);
+  if (rc != 0) return rc;
+  return dmlc_shm_coll_allgather(g, data, count, dtype);
+}
+
+void dmlc_shm_coll_abort(DmlcShmColl* g) {
+  if (g != nullptr && g->base != nullptr)
+    g->hdr()->aborted.store(1, std::memory_order_release);
+}
+
+void dmlc_shm_coll_destroy(DmlcShmColl* g) {
+  if (g == nullptr) return;
+  if (g->base != nullptr) munmap(g->base, g->bytes);
+  delete g;
+}
+
+const char* dmlc_shm_coll_last_error(const DmlcShmColl* g) {
+  return g == nullptr ? g_init_error.c_str() : g->error.c_str();
+}
+
+// ---------------------------------------------------------------------
 // Parameter-server KV data plane (see dmlc_collective.h).  Wire format
 // (all native-endian, matching the rabit framing):
 //   registration (node -> scheduler): magic, role:int32, port:int32

@@ -78,6 +78,65 @@ void dmlc_comm_shutdown(DmlcComm* c);
 const char* dmlc_comm_last_error(const DmlcComm* c);
 
 /* ------------------------------------------------------------------ *
+ * Standalone same-host shared-memory collective group (the intra-host
+ * leg of the hierarchical allreduce: tracker/client.py groups ranks by
+ * host from the tracker's job map, reduce-scatters inside each host
+ * through this group, runs the chunked TCP ring across host LEADERS
+ * only, then broadcasts back — so one rank per host drives the
+ * network).  Unlike DmlcComm this object does no tracker rendezvous:
+ * the caller already owns rank assignment and passes an agreed segment
+ * name plus a dense [0, world) intra-group rank.
+ *
+ * Creation is collective: rank 0 creates + sizes the segment (its
+ * chunk_kb — <= 0 means DMLC_COLL_SHM_CHUNK_KB, capped to the free
+ * /dev/shm space — is authoritative and published in the header);
+ * other ranks attach by name.  Everyone blocks until the whole group
+ * has mapped, then rank 0 unlinks the name so a crashed job leaves no
+ * /dev/shm litter.  NULL on failure (dmlc_shm_coll_last_error(NULL)).
+ * ------------------------------------------------------------------ */
+typedef struct DmlcShmColl DmlcShmColl;
+
+DmlcShmColl* dmlc_shm_coll_create(const char* name, int rank, int world,
+                                  long chunk_kb);
+
+/* In-place chunked reduce-scatter over `count` elements of `dtype`:
+ * within each internal chunk of n elements, this rank's slice
+ * [n*rank/world, n*(rank+1)/world) is replaced by the `op`-fold of
+ * every rank's values (fold order rank 0..world-1, so results are
+ * bit-deterministic); bytes outside the slice are left untouched.
+ * Returns 0, -2 on bad dtype/op, -1 on timeout/abort. */
+int dmlc_shm_coll_reduce_scatter(DmlcShmColl* g, void* data, long count,
+                                 int dtype, int op);
+
+/* The gather half of the pair: each rank publishes its per-chunk slice
+ * (the region reduce_scatter filled) and receives every other rank's,
+ * so reduce_scatter followed by allgather leaves the full reduction in
+ * `data` on every rank — bit-identical to dmlc_comm_allreduce's shm
+ * path. */
+int dmlc_shm_coll_allgather(DmlcShmColl* g, void* data, long count,
+                            int dtype);
+
+/* Chunked broadcast of `nbytes` from `root`'s buffer (in place). */
+int dmlc_shm_coll_broadcast(DmlcShmColl* g, void* data, long nbytes,
+                            int root);
+
+/* Convenience: reduce_scatter + allgather. */
+int dmlc_shm_coll_allreduce(DmlcShmColl* g, void* data, long count,
+                            int dtype, int op);
+
+/* Poison the group: every rank currently (or subsequently) blocked in
+ * a collective returns -1 promptly instead of spinning to the timeout.
+ * The elastic cascade for shm peers — a rank tearing down its TCP
+ * links on WorldResized aborts the group so same-host peers wake too. */
+void dmlc_shm_coll_abort(DmlcShmColl* g);
+
+void dmlc_shm_coll_destroy(DmlcShmColl* g);
+
+/* Last error on this group ("" if none); NULL queries the thread-local
+ * reason a dmlc_shm_coll_create call returned NULL. */
+const char* dmlc_shm_coll_last_error(const DmlcShmColl* g);
+
+/* ------------------------------------------------------------------ *
  * Parameter-server KV data plane (the worker/server/scheduler role
  * model of the reference's PS path, tracker/dmlc_tracker/tracker.py:
  * 336-386 env contract).  Under `dmlc-submit --num-servers N` every

@@ -24,6 +24,7 @@ standard static-shape trade).  Both combine with one psum over (ep, tp).
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -347,12 +348,21 @@ def _stage_fn(stage_params, x, positions, axes: ShardAxes,
     return out
 
 
-def forward_local(params, ids, labels, cfg: TransformerConfig, axes: ShardAxes):
+def forward_local(params, ids, labels, cfg: TransformerConfig, axes: ShardAxes,
+                  reduce_loss: bool = True):
     """Per-device loss.  ids/labels: [B_local, T_local] (dp × sp shards).
 
     Inside shard_map, `params` are the local shards; with ShardAxes()
     this is the unsharded oracle.  Returns scalar mean loss (f32),
     fully reduced over (dp, sp) when those axes are present.
+
+    ``reduce_loss=False`` returns the LOCAL mean loss instead: the
+    overlap train step differentiates that and issues the (dp, sp)
+    gradient reduction itself as bucketed psums
+    (parallel.overlap.bucketed_psum_mean) so XLA can hide the
+    collectives under remaining backward compute — the pmean here
+    would transpose into one fused gradient reduction at the very end
+    of backward, fully exposed.
     """
     b, t_local = ids.shape
     sp_rank = lax.axis_index(axes.sp) if axes.sp is not None else 0
@@ -384,7 +394,7 @@ def forward_local(params, ids, labels, cfg: TransformerConfig, axes: ShardAxes):
     loss = softmax_xent(logits, labels, axes)  # [B, T_local]
     loss = jnp.mean(loss)
     reduce_axes = tuple(a for a in (axes.dp, axes.sp) if a is not None)
-    if reduce_axes:
+    if reduce_axes and reduce_loss:
         loss = lax.pmean(loss, reduce_axes)
     return loss
 
@@ -568,12 +578,25 @@ def forward_decode(params, ids, positions, k_cache, v_cache, lengths,
 
 
 def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
-                    ledger: bool = True, grad_norm: bool = False):
+                    ledger: bool = True, grad_norm: bool = False,
+                    overlap: Optional[str] = None):
     """Build a jitted SPMD train step over ``mesh``.
 
     Returns (train_step, init_state) where
       train_step(params, opt_state, ids, labels) -> (params, opt_state, loss)
     ids/labels are global [B, T] arrays sharded P(dp, sp).
+
+    ``overlap="device"`` swaps the fused (dp, sp) gradient reduction
+    the loss-pmean transpose produces — one big psum at the very end of
+    backward, fully exposed — for one ``lax.psum`` per reverse-
+    topological gradient bucket (``DMLC_COLL_BUCKET_MB``,
+    parallel.overlap.bucketed_psum_mean), issued as soon as backward
+    can produce the bucket: XLA's latency-hiding scheduler then starts
+    the first buckets' ICI/DCN traffic while earlier layers are still
+    differentiating and the optimizer update runs.  Numerically the
+    same psum-then-divide in the same cross-replica order, so the loss
+    trajectory is unchanged.  Default (None, or ``DMLC_COLL_OVERLAP=0``
+    with "auto") keeps the classic fused path.
 
     With ``ledger`` (default) every call drives the process step ledger
     (telemetry.steps): the model declares its per-token train FLOPs
@@ -591,19 +614,52 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
     """
     import optax
 
+    if overlap == "auto":
+        import os
+
+        overlap = "device" if os.environ.get(
+            "DMLC_COLL_OVERLAP", "0").strip() not in ("0", "", "false") \
+            else None
+    if overlap not in (None, "device"):
+        raise ValueError(f"unknown overlap mode {overlap!r} "
+                         "(expected None, 'device' or 'auto')")
     if optimizer is None:
         optimizer = optax.adamw(1e-3)
     specs = param_specs()
     data_spec = P(AXIS_DP, AXIS_SP)
 
-    local = jax.shard_map(
-        lambda p, i, l: jax.value_and_grad(
-            lambda pp_: forward_local(pp_, i, l, cfg, SHARDED_AXES)
-        )(p),
-        mesh=mesh,
-        in_specs=(specs, data_spec, data_spec),
-        out_specs=(P(), specs),
-    )
+    if overlap == "device":
+        from ..parallel.overlap import bucketed_psum_mean
+
+        data_axes = tuple(a for a in (SHARDED_AXES.dp, SHARDED_AXES.sp)
+                          if a is not None)
+
+        def _local_overlap(p, i, l):
+            loss, grads = jax.value_and_grad(
+                lambda pp_: forward_local(pp_, i, l, cfg, SHARDED_AXES,
+                                          reduce_loss=False)
+            )(p)
+            # the explicit bucketed psums replace the loss-pmean
+            # transpose's single fused end-of-backward reduction
+            grads = bucketed_psum_mean(grads, data_axes)
+            loss = lax.pmean(loss, data_axes)
+            return loss, grads
+
+        local = jax.shard_map(
+            _local_overlap,
+            mesh=mesh,
+            in_specs=(specs, data_spec, data_spec),
+            out_specs=(P(), specs),
+        )
+    else:
+        local = jax.shard_map(
+            lambda p, i, l: jax.value_and_grad(
+                lambda pp_: forward_local(pp_, i, l, cfg, SHARDED_AXES)
+            )(p),
+            mesh=mesh,
+            in_specs=(specs, data_spec, data_spec),
+            out_specs=(P(), specs),
+        )
 
     def train_step(params, opt_state, ids, labels):
         loss, grads = local(params, ids, labels)

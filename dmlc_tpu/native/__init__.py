@@ -32,22 +32,40 @@ _lib_lock = threading.Lock()
 _tried = False
 
 
-def _build() -> Optional[str]:
-    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-        return _SO
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC, "-o", _SO,
-           "-pthread"]
+def compile_so(src: str, so: str, extra_flags, fallback_note: str
+               ) -> Optional[str]:
+    """Shared compile-and-cache for the native libraries (this package's
+    parser core and shm_collective's collective binding).  Compiles to a
+    private per-pid temp file and ``os.replace``s it into place, so
+    concurrent same-host processes — the hier collective's designed
+    deployment is N ranks per host, all racing the first build — each
+    dlopen a COMPLETE library (old or new), never a half-written one."""
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
+        return so
+    tmp = f"{so}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", src, "-o",
+           tmp] + list(extra_flags)
     try:
         r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired):
-        return None
-    if r.returncode != 0:
-        from ..logging import warning
+        r = None
+    if r is None or r.returncode != 0:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        if r is not None:
+            from ..logging import warning
 
-        warning(f"native build failed, using Python fallbacks: "
-                f"{r.stderr[:500]}")
+            warning(f"{os.path.basename(src)} build failed, "
+                    f"{fallback_note}: {r.stderr[:500]}")
         return None
-    return _SO
+    os.replace(tmp, so)
+    return so
+
+
+def _build() -> Optional[str]:
+    return compile_so(_SRC, _SO, ["-pthread"], "using Python fallbacks")
 
 
 def _load():

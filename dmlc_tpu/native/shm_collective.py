@@ -1,0 +1,194 @@
+"""ctypes binding for the same-host shm collective group
+(cpp/dmlc_collective.cc: ``dmlc_shm_coll_*``) — the intra-host leg of
+the hierarchical host allreduce in tracker/client.py.
+
+The shared library is compiled on demand with g++ (one-time, cached
+next to this package, same pattern as the dmlc_native bindings); the
+hier algorithm degrades to the flat ring when the build or the segment
+mapping fails, so nothing here is load-bearing for correctness.  Set
+``DMLC_TPU_DISABLE_NATIVE=1`` to force that fallback.
+
+Calls release the GIL for their duration (plain ctypes), so a
+reduce-scatter on the background collective thread genuinely overlaps
+Python-side work on the training thread.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(os.path.dirname(_HERE), "cpp", "dmlc_collective.cc")
+_SO = os.path.join(_HERE, "libdmlc_collective.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_tried = False
+
+#: numpy dtype -> dmlc_collective.h dtype code (DMLC_F32..DMLC_I64)
+DTYPE_CODES = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3,
+}
+
+#: op name -> dmlc_collective.h op code (DMLC_SUM/MAX/MIN)
+OP_CODES = {"sum": 0, "max": 1, "min": 2}
+
+
+def _build() -> Optional[str]:
+    from . import compile_so
+
+    # -lrt: shm_open lives in librt on glibc < 2.34 (a no-op stub after)
+    return compile_so(_SRC, _SO, ["-lrt"],
+                      "hier allreduce will fall back to the flat ring")
+
+
+def _load():
+    global _lib, _tried
+    with _lib_lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("DMLC_TPU_DISABLE_NATIVE"):
+            return None
+        so = _build()
+        if so is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        c = ctypes
+        lib.dmlc_shm_coll_create.restype = c.c_void_p
+        lib.dmlc_shm_coll_create.argtypes = [c.c_char_p, c.c_int, c.c_int,
+                                             c.c_long]
+        lib.dmlc_shm_coll_reduce_scatter.restype = c.c_int
+        lib.dmlc_shm_coll_reduce_scatter.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_long, c.c_int, c.c_int]
+        lib.dmlc_shm_coll_allgather.restype = c.c_int
+        lib.dmlc_shm_coll_allgather.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_long, c.c_int]
+        lib.dmlc_shm_coll_broadcast.restype = c.c_int
+        lib.dmlc_shm_coll_broadcast.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_long, c.c_int]
+        lib.dmlc_shm_coll_allreduce.restype = c.c_int
+        lib.dmlc_shm_coll_allreduce.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_long, c.c_int, c.c_int]
+        lib.dmlc_shm_coll_abort.restype = None
+        lib.dmlc_shm_coll_abort.argtypes = [c.c_void_p]
+        lib.dmlc_shm_coll_destroy.restype = None
+        lib.dmlc_shm_coll_destroy.argtypes = [c.c_void_p]
+        lib.dmlc_shm_coll_last_error.restype = c.c_char_p
+        lib.dmlc_shm_coll_last_error.argtypes = [c.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def supports_dtype(dtype) -> bool:
+    return np.dtype(dtype) in DTYPE_CODES
+
+
+class ShmGroupError(RuntimeError):
+    """A shm group collective failed (timeout, abort, divergent gang)."""
+
+
+class ShmCollective:
+    """One process's handle on a same-host shm collective group.
+
+    ``name`` must be agreed by every member out of band (the hier path
+    derives it from tracker port + world generation + group leader);
+    ``rank`` is the dense intra-group rank, with rank 0 creating the
+    segment.  Construction is collective — it blocks until the whole
+    group attached (``DMLC_COLL_SHM_JOIN_TIMEOUT_S``) and raises
+    :class:`ShmGroupError` on failure, after which the caller falls
+    back to TCP paths.
+    """
+
+    def __init__(self, name: str, rank: int, world: int,
+                 chunk_kb: int = 0):
+        self._lib = _load()
+        self._handle = None
+        if self._lib is None:
+            raise ShmGroupError("native collective library unavailable")
+        self.rank, self.world = rank, world
+        h = self._lib.dmlc_shm_coll_create(
+            name.encode(), int(rank), int(world), int(chunk_kb))
+        if not h:
+            err = self._lib.dmlc_shm_coll_last_error(None)
+            raise ShmGroupError(
+                f"shm group create failed: {err.decode(errors='replace')}")
+        self._handle = h
+
+    def _check(self, rc: int, what: str) -> None:
+        if rc == 0:
+            return
+        err = self._lib.dmlc_shm_coll_last_error(self._handle)
+        raise ShmGroupError(
+            f"shm {what} failed (rc {rc}): {err.decode(errors='replace')}")
+
+    @staticmethod
+    def _codes(arr: np.ndarray, op: Optional[str]):
+        dt = DTYPE_CODES.get(arr.dtype)
+        if dt is None:
+            raise ShmGroupError(f"unsupported dtype {arr.dtype}")
+        if op is None:
+            return dt, None
+        return dt, OP_CODES[op]
+
+    def reduce_scatter(self, arr: np.ndarray, op: str = "sum") -> None:
+        """In-place: this rank's per-chunk slice becomes the fold of
+        every member's values; the rest of ``arr`` is untouched."""
+        assert arr.flags.c_contiguous and arr.ndim == 1
+        dt, opc = self._codes(arr, op)
+        self._check(self._lib.dmlc_shm_coll_reduce_scatter(
+            self._handle, arr.ctypes.data, arr.size, dt, opc),
+            "reduce_scatter")
+
+    def allgather(self, arr: np.ndarray) -> None:
+        """In-place gather of the per-chunk slices reduce_scatter left
+        resident — RS followed by AG is a full allreduce."""
+        assert arr.flags.c_contiguous and arr.ndim == 1
+        dt, _ = self._codes(arr, None)
+        self._check(self._lib.dmlc_shm_coll_allgather(
+            self._handle, arr.ctypes.data, arr.size, dt), "allgather")
+
+    def broadcast(self, arr: np.ndarray, root: int = 0) -> None:
+        assert arr.flags.c_contiguous
+        self._check(self._lib.dmlc_shm_coll_broadcast(
+            self._handle, arr.ctypes.data, arr.nbytes, int(root)),
+            "broadcast")
+
+    def allreduce(self, arr: np.ndarray, op: str = "sum") -> None:
+        assert arr.flags.c_contiguous and arr.ndim == 1
+        dt, opc = self._codes(arr, op)
+        self._check(self._lib.dmlc_shm_coll_allreduce(
+            self._handle, arr.ctypes.data, arr.size, dt, opc), "allreduce")
+
+    def abort(self) -> None:
+        """Poison the group: members blocked in a collective wake with
+        an error instead of spinning to the timeout (the shm half of
+        the elastic WorldResized cascade)."""
+        if self._handle is not None:
+            self._lib.dmlc_shm_coll_abort(self._handle)
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.dmlc_shm_coll_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # best-effort unmap
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass

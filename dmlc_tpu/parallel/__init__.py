@@ -31,6 +31,11 @@ from .collectives import (  # noqa: F401
     ppermute_ring,
     reduce_scatter,
 )
+from .overlap import (  # noqa: F401
+    CollectiveFuture,
+    GradientBucketer,
+    bucketed_psum_mean,
+)
 from .ring_attention import ring_attention, ring_attention_reference  # noqa: F401
 from .ulysses import ulysses_attention  # noqa: F401
 from .pipeline import pipeline_spmd  # noqa: F401

@@ -1,0 +1,371 @@
+"""Overlap-by-design gradient reduction: bucketed allreduce that hides
+under backward instead of sitting serially after it.
+
+Two halves, one idea — slice the gradient payload into fixed-size
+buckets (``DMLC_COLL_BUCKET_MB``) filled in *reverse-topological*
+order (backward produces the last layers' gradients first, so the
+first buckets are ready while earlier layers are still
+differentiating) and reduce each bucket as soon as it fills:
+
+* **Host path** — :class:`GradientBucketer` packs leaves and hands
+  full buckets to a single background collective thread (the tracker
+  host collective: tree/ring/hier per ``DMLC_COLL_ALGO``).  Bucket k's
+  allreduce overlaps bucket k+1's device→host transfer and packing on
+  the training thread; :meth:`GradientBucketer.reduce_tree` joins all
+  buckets before ``optimizer.update``.  The per-bucket collective
+  spans run on the worker thread, which is exactly how the step ledger
+  (telemetry.steps) tells *overlapped* collective time from *exposed*:
+  same-thread collective spans count against the step, other-thread
+  spans count as hidden.
+* **Device path** — :func:`bucketed_psum_mean` for use inside
+  ``jax.shard_map``: one ``lax.psum`` per bucket instead of one fused
+  gradient reduction, so XLA's scheduler can interleave the collectives
+  with the remaining backward/optimizer compute
+  (``models.make_train_step(overlap="device")`` wires it).
+
+Elastic safety: exceptions raised on the collective thread (including
+:class:`~dmlc_tpu.tracker.client.WorldResized` from a mid-bucket world
+shrink) are transported through :class:`CollectiveFuture` and re-raised
+at the join on the training thread; the caller's gradients are only
+overwritten after *every* bucket succeeded, so a failed step leaves no
+bucket half-reduced — the inputs are untouched and the bucketer is
+immediately reusable after ``TrackerClient.resize()``.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "CollectiveFuture",
+    "GradientBucketer",
+    "bucket_bytes",
+    "bucketed_psum_mean",
+    "reverse_topological",
+]
+
+
+def bucket_bytes() -> int:
+    """Gradient bucket size (``DMLC_COLL_BUCKET_MB``, default 4 MB —
+    large enough that each bucket clears the ring/hier cutover
+    (DMLC_COLL_RING_MIN_BYTES, 1 MB), small enough that several buckets
+    are in flight per step)."""
+    mb = float(os.environ.get("DMLC_COLL_BUCKET_MB", "4"))
+    return max(1, int(mb * (1 << 20)))
+
+
+def reverse_topological(n: int) -> List[int]:
+    """Leaf visit order that fills buckets with the gradients backward
+    produces FIRST: flatten order follows the forward graph, so its
+    reverse approximates backward completion order (unembed/late blocks
+    before the embedding)."""
+    return list(range(n))[::-1]
+
+
+class CollectiveFuture:
+    """Result-or-exception transport from the background collective
+    thread to the training thread.  ``result()`` re-raises whatever the
+    collective raised — the defined path for ``WorldResized`` (and any
+    other error) off the worker thread."""
+
+    __slots__ = ("_ev", "_res", "_exc")
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._res = None
+        self._exc: Optional[BaseException] = None
+
+    def set_result(self, res) -> None:
+        self._res = res
+        self._ev.set()
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._ev.set()
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def exception(self, timeout: Optional[float] = None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("collective future not done")
+        return self._exc
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("collective future not done")
+        if self._exc is not None:
+            raise self._exc
+        return self._res
+
+
+class _CollectiveThread:
+    """One daemon worker draining a FIFO of collective thunks.
+
+    A single thread by design: the host collective's peer links are a
+    serial byte stream, so concurrent ops would interleave frames.
+    FIFO order also keeps the gang uniform — every rank's bucketer
+    issues buckets in the same (deterministic) order."""
+
+    def __init__(self, name: str = "dmlc-coll-overlap"):
+        self._q: "queue.Queue" = queue.Queue()
+        self._name = name
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def submit(self, fn: Callable[[], object]) -> CollectiveFuture:
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name=self._name, daemon=True)
+                self._thread.start()
+        fut = CollectiveFuture()
+        self._q.put((fn, fut))
+        return fut
+
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fn, fut = item
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: BLE001 - transported
+                fut.set_exception(e)
+
+    def close(self) -> None:
+        with self._lock:
+            th, self._thread = self._thread, None
+        if th is not None and th.is_alive():
+            self._q.put(None)
+            th.join(timeout=5)
+
+
+class GradientBucketer:
+    """Flatten gradients into fixed-size buckets and allreduce each on
+    a background thread while later gradients are still being packed
+    (host path of the overlap design; see the module docstring).
+
+    ``allreduce`` is any callable mapping a flat contiguous 1-D ndarray
+    to its reduced counterpart — in production
+    ``lambda a: client.allreduce_sum(a, out=a)``: the bucketer owns
+    every bucket buffer it hands over, so reducing IN PLACE is safe and
+    keeps the steady-state exchange allocation-free.  All leaves are
+    accumulated in ``dtype`` (float32 by default, matching the sync
+    path's wire dtype).
+
+    The reduction is *bit-identical* to reducing the concatenated flat
+    payload in one call whenever the underlying collective folds ranks
+    in a bucket-size-independent order (the tree, shm and hier paths
+    fold rank 0..w-1 elementwise; the ring's slice ownership makes the
+    fp *order* bucket-dependent, so exact equality there holds for
+    order-insensitive values — max/min always, sums of integers
+    exactly representable in the dtype).
+
+    Thread contract: one ``reduce_*`` call at a time; while a reduction
+    is in flight every collective on the shared client must go through
+    this bucketer (the worker owns the peer links until the join
+    returns).
+    """
+
+    def __init__(self, allreduce: Callable[[np.ndarray], np.ndarray],
+                 bucket_bytes_: Optional[int] = None, dtype=np.float32):
+        self._allreduce = allreduce
+        self._dtype = np.dtype(dtype)
+        nbytes = bucket_bytes_ or bucket_bytes()
+        self._bucket_elems = max(1, nbytes // self._dtype.itemsize)
+        self._worker = _CollectiveThread()
+        self._failed: Optional[BaseException] = None
+        self._timings: List[Tuple[int, float]] = []
+        self._tlock = threading.Lock()
+
+    @property
+    def bucket_elems(self) -> int:
+        return self._bucket_elems
+
+    def last_timings(self) -> List[Tuple[int, float]]:
+        """(bytes, seconds) per bucket of the most recent reduction —
+        the per-bucket overlap timing block the collective bench
+        records."""
+        with self._tlock:
+            return list(self._timings)
+
+    def _submit(self, buf: np.ndarray) -> CollectiveFuture:
+        from .. import telemetry
+
+        def run():
+            t0 = time.perf_counter()
+            # the bucket span makes the worker's time visible to the
+            # step ledger's overlapped-collective accounting even when
+            # the callable emits no span of its own; the ledger merges
+            # intervals, so the nested collective.allreduce span the
+            # tracker client opens inside does not double-bill
+            with telemetry.span("collective.bucket", stage="collective",
+                                args={"bytes": int(buf.nbytes)}):
+                out = self._allreduce(buf)
+            dt = time.perf_counter() - t0
+            with self._tlock:
+                self._timings.append((int(buf.nbytes), dt))
+            telemetry.inc("collective", "overlap_buckets")
+            telemetry.observe_duration("collective", "overlap_bucket",
+                                       dt)
+            return out
+
+        def guarded():
+            try:
+                return run()
+            except BaseException as e:  # noqa: BLE001 - flag + transport
+                self._failed = self._failed or e
+                raise
+
+        return self._worker.submit(guarded)
+
+    def reduce_leaves(self, leaves: Sequence) -> List[np.ndarray]:
+        """Reduce ``leaves`` (array-likes; device arrays are converted
+        at pack time, so transfers overlap earlier buckets' collectives)
+        in the order GIVEN; returns reduced ndarrays in the same order
+        (dtype = the bucketer's accumulation dtype).
+
+        All-or-nothing: if any bucket's collective raises, the
+        exception is re-raised here after the worker drained, nothing
+        is returned, and the input leaves are untouched."""
+        from .. import telemetry
+
+        self._failed = None
+        with self._tlock:
+            self._timings = []
+        shapes = []
+        futures: List[CollectiveFuture] = []
+        buf = np.empty(self._bucket_elems, self._dtype)
+        fill = 0
+        for leaf in leaves:
+            if self._failed is not None:
+                break  # a bucket already failed: stop packing, join
+            a = np.asarray(leaf, dtype=self._dtype).reshape(-1)
+            shapes.append(np.shape(leaf))
+            pos = 0
+            while pos < a.size:
+                take = min(a.size - pos, self._bucket_elems - fill)
+                buf[fill:fill + take] = a[pos:pos + take]
+                fill += take
+                pos += take
+                if fill == self._bucket_elems:
+                    futures.append(self._submit(buf))
+                    buf = np.empty(self._bucket_elems, self._dtype)
+                    fill = 0
+        if fill and self._failed is None:
+            futures.append(self._submit(buf[:fill]))
+
+        # the join is the EXPOSED share of the collective: whatever did
+        # not hide under packing/transfer is paid here, on the stepping
+        # thread, under a collective-stage span the ledger classifies
+        err: Optional[BaseException] = None
+        reduced: List[np.ndarray] = []
+        with telemetry.span("collective.join", stage="collective",
+                            args={"buckets": len(futures)}):
+            for fut in futures:
+                try:
+                    reduced.append(fut.result())
+                except BaseException as e:  # noqa: BLE001 - drain all
+                    err = err or e
+        if err is not None:
+            # every future resolved (the worker is idle and reusable);
+            # no output was produced, so no gradient is half-reduced
+            raise err
+        if self._failed is not None:  # paranoia: break without a future
+            raise self._failed
+
+        out: List[np.ndarray] = []
+        cat = iter(reduced)
+        cur = next(cat, np.empty(0, self._dtype))
+        pos = 0
+        for shape in shapes:
+            n = int(np.prod(shape)) if shape else 1
+            if n == 0:
+                out.append(np.empty(shape, self._dtype))
+                continue
+            pieces = []
+            while n > 0:
+                if pos == cur.size:
+                    cur = next(cat)
+                    pos = 0
+                take = min(n, cur.size - pos)
+                pieces.append(cur[pos:pos + take])
+                pos += take
+                n -= take
+            flatleaf = pieces[0] if len(pieces) == 1 \
+                else np.concatenate(pieces)
+            out.append(np.asarray(flatleaf).reshape(shape))
+        return out
+
+    def reduce_tree(self, tree):
+        """Reduce a gradient pytree: leaves are packed reverse-
+        topologically (early-backward gradients fill the first buckets)
+        and the reduced tree comes back in the original structure."""
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        order = reverse_topological(len(leaves))
+        reduced = self.reduce_leaves([leaves[i] for i in order])
+        restored: List[Optional[np.ndarray]] = [None] * len(leaves)
+        for slot, red in zip(order, reduced):
+            restored[slot] = red
+        return jax.tree_util.tree_unflatten(treedef, restored)
+
+    def close(self) -> None:
+        self._worker.close()
+
+
+def bucketed_psum_mean(tree, axis_name: str,
+                       bucket_bytes_: Optional[int] = None):
+    """Device path: mean-allreduce a gradient pytree over ``axis_name``
+    inside ``jax.shard_map`` as one ``lax.psum`` per reverse-topological
+    bucket.  Issuing several independent collectives (instead of the
+    single fused reduction the loss-pmean transpose produces) is what
+    lets XLA's latency-hiding scheduler start the first buckets' DCN/ICI
+    traffic while later gradient math and the optimizer update are
+    still executing.  Numerically this is the same psum-then-divide the
+    pmean transpose performs, in the same cross-replica order."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree
+    cap = bucket_bytes_ or bucket_bytes()
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    cur_dtype = None
+    for i in reverse_topological(len(leaves)):
+        lf = leaves[i]
+        nb = int(lf.size) * lf.dtype.itemsize
+        if cur and (cur_bytes + nb > cap or lf.dtype != cur_dtype):
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nb
+        cur_dtype = lf.dtype
+    if cur:
+        buckets.append(cur)
+
+    world = lax.psum(1, axis_name)
+    out: List = [None] * len(leaves)
+    for idxs in buckets:
+        flat = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
+        red = lax.psum(flat, axis_name) / world
+        pos = 0
+        for i in idxs:
+            n = int(leaves[i].size)
+            out[i] = red[pos:pos + n].reshape(leaves[i].shape).astype(
+                leaves[i].dtype)
+            pos += n
+    return jax.tree_util.tree_unflatten(treedef, out)

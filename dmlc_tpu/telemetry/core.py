@@ -309,6 +309,14 @@ def span_seq() -> int:
         return _span_seq
 
 
+def now_ts() -> float:
+    """Current time in the span timebase (µs since the process span
+    epoch) — lets interval consumers clip span [ts, ts+dur] extents
+    against their own window (the step ledger's overlapped-collective
+    accounting)."""
+    return (time.perf_counter() - _T0) * 1e6
+
+
 def counter_value(stage: str, name: str, default: float = 0.0) -> float:
     """One counter's current value without copying the whole registry —
     the step ledger reads per-step deltas (bytes fed, flash FLOPs) on

@@ -51,6 +51,8 @@ METRIC_NAMES = frozenset({
     "dmlc_collective_bench_host_run_secs",
     "dmlc_collective_bench_loopback_probe_secs",
     "dmlc_collective_bench_run_secs",
+    "dmlc_collective_overlap_buckets",
+    "dmlc_collective_overlap_bucket_secs",
     # device feed
     "dmlc_feed_assemble_secs",
     "dmlc_feed_batches",
@@ -147,6 +149,7 @@ METRIC_NAMES = frozenset({
     "dmlc_serving_ttft_secs",
     # step ledger
     "dmlc_step_collective_secs",
+    "dmlc_step_collective_overlapped_secs",
     "dmlc_step_compute_secs",
     "dmlc_step_count",
     "dmlc_step_feed_wait_secs",
@@ -194,4 +197,5 @@ NON_METRIC_TOKENS = frozenset({
     "dmlc_recordio_spans",  # native ABI symbol (dmlc_native.cc)
     "dmlc_pack_spans",      # native ABI symbol
     "dmlc_comm_allreduce",  # native collective ABI symbol
+    "dmlc_shm_coll",        # native shm-group ABI symbol prefix
 })

@@ -16,6 +16,15 @@ bounded record per step:
     compute evidence).  Producer-side feed spans (parse/stage/place)
     run on other threads concurrently and deliberately do NOT count
     against the step — overlap is the point of the feed pipeline.
+  * **exposed vs overlapped collectives** — collective spans on OTHER
+    threads during the step window (the bucketed-overlap path of
+    parallel.overlap runs each bucket's allreduce on a background
+    thread) are summed separately as ``collective_overlapped_s``:
+    collective time that HID under compute/packing instead of
+    extending the step.  ``collective_s`` stays the exposed share —
+    what the stepping thread actually waited (the sync allreduce, or
+    the overlap path's end-of-step ``collective.join``) — so
+    before/after an overlap rollout is a first-class ledger metric.
   * **goodput / MFU** — each record carries tokens, bytes fed (counter
     delta of ``feed.bytes_to_device`` unless given), and model-declared
     FLOPs (``declare_flops_per_token``, models.transformer wires it),
@@ -169,6 +178,7 @@ class StepLedger:
         span = core.span("step", stage="step", args={"n": n})
         self._open = {
             "t0": time.perf_counter(),
+            "ts0": core.now_ts(),
             "cursor": core.span_seq(),
             "bytes0": core.counter_value("feed", "bytes_to_device"),
             "tid": threading.get_ident(),
@@ -192,16 +202,68 @@ class StepLedger:
 
         new_spans, _ = core.spans_since(opened["cursor"])
         tid = opened["tid"]
+        ts0, ts1 = opened["ts0"], core.now_ts()
         buckets = {"feed": 0.0, "collective": 0.0, "pipeline": 0.0}
+        ivals = []
+        own_ivals = []
         for rec in new_spans:
-            if rec.get("tid") != tid or rec.get("name") == "step":
+            if rec.get("name") == "step":
                 continue
             kind = _classify(rec)
-            if kind is not None:
+            if kind is None:
+                continue
+            if rec.get("tid") == tid:
                 buckets[kind] += rec.get("dur", 0.0) / 1e6
+                if kind != "collective":
+                    continue
+                dest = own_ivals
+            elif kind == "collective":
+                # a collective on ANOTHER thread (the overlap path's
+                # background worker) is a candidate for collective time
+                # that hid under this step's compute — clip its extent
+                # to the step window; intervals are union-merged below
+                # so nested spans (collective.bucket wrapping the
+                # client's collective.allreduce) bill each instant once
+                dest = ivals
+            else:
+                continue
+            lo = max(rec.get("ts", 0.0), ts0)
+            hi = min(rec.get("ts", 0.0) + rec.get("dur", 0.0), ts1)
+            if hi > lo:
+                dest.append((lo, hi))
+
+        def union(spans):
+            merged = []
+            for lo, hi in sorted(spans):
+                if merged and lo <= merged[-1][1]:
+                    merged[-1][1] = max(merged[-1][1], hi)
+                else:
+                    merged.append([lo, hi])
+            return merged
+
+        # overlapped = worker-thread collective time the stepping thread
+        # did NOT spend blocked in a collective of its own: an instant
+        # where both threads sit in a collective (the bucketer's join,
+        # a degenerate all-exposed overlap) is exposed, not hidden —
+        # otherwise a total loss of overlap still reports 'overlapped'
+        overlapped = 0.0
+        exposed_u = union(own_ivals)
+        for lo, hi in union(ivals):
+            cur = lo
+            for elo, ehi in exposed_u:
+                if ehi <= cur or elo >= hi:
+                    continue
+                if elo > cur:
+                    overlapped += elo - cur
+                cur = max(cur, ehi)
+                if cur >= hi:
+                    break
+            if cur < hi:
+                overlapped += hi - cur
         feed_s = min(buckets["feed"], wall)
         coll_s = min(buckets["collective"], wall - feed_s)
         compute_s = max(wall - feed_s - coll_s, 0.0)
+        overlapped_s = min(overlapped / 1e6, wall)
 
         if bytes_fed is None:
             bytes_fed = (core.counter_value("feed", "bytes_to_device")
@@ -224,6 +286,7 @@ class StepLedger:
                 wall_s=wall,
                 feed_wait_s=feed_s,
                 collective_s=coll_s,
+                collective_overlapped_s=overlapped_s,
                 compute_s=compute_s,
                 pipeline_span_s=min(buckets["pipeline"], wall),
                 bytes_fed=float(bytes_fed),
@@ -244,6 +307,9 @@ class StepLedger:
         core.observe_duration("step", "time", rec["wall_s"])
         core.observe_duration("step", "feed_wait", rec["feed_wait_s"])
         core.observe_duration("step", "collective", rec["collective_s"])
+        if rec.get("collective_overlapped_s"):
+            core.observe_duration("step", "collective_overlapped",
+                                  rec["collective_overlapped_s"])
         core.observe_duration("step", "compute", rec["compute_s"])
         if rec["goodput_tokens_per_s"] is not None:
             core.set_gauge("step", "goodput_tokens_per_s",
@@ -283,12 +349,18 @@ class StepLedger:
         def pct(q: float) -> float:
             return walls[min(int(q / 100.0 * len(walls)), len(walls) - 1)]
 
+        wall_total = max(sum(walls), 1e-9)
         out = {
             "steps": len(recs),
             "step_time_p50": pct(50),
             "step_time_p99": pct(99),
             "feed_wait_fraction": (sum(r["feed_wait_s"] for r in recs)
-                                   / max(sum(walls), 1e-9)),
+                                   / wall_total),
+            "collective_exposed_fraction": (
+                sum(r["collective_s"] for r in recs) / wall_total),
+            "collective_overlapped_fraction": (
+                sum(r.get("collective_overlapped_s", 0.0) for r in recs)
+                / wall_total),
         }
         toks = [r for r in recs if r["tokens"]]
         if toks:

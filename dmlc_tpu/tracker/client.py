@@ -57,6 +57,58 @@ class WorldResized(DMLCError):
         self.gen = gen
 
 
+def _coll_algo_env() -> str:
+    """Default allreduce algorithm (``DMLC_COLL_ALGO``):
+
+    * ``auto`` (default) — the hierarchical shm+ring path (C shm
+      collective per host + chunked ring across host leaders) from
+      DMLC_COLL_HIER_MIN_BYTES (64 KB) up when it can be set up, the
+      flat chunked ring from DMLC_COLL_RING_MIN_BYTES (1 MB) when it
+      cannot, the binomial tree below both cutovers.
+    * ``tree`` / ``ring`` / ``hier`` — pin the algorithm (``hier``
+      still degrades to ``ring`` when no shm segment can be mapped,
+      with a one-time warning, so a heterogeneous fleet never hangs).
+    """
+    algo = os.environ.get("DMLC_COLL_ALGO", "auto").strip().lower()
+    if algo not in ("auto", "tree", "ring", "hier"):
+        raise ValueError(f"DMLC_COLL_ALGO={algo!r} not in "
+                         "tree|ring|hier|auto")
+    return algo
+
+
+class _HierState:
+    """Per-generation hierarchical-collective state: host groups, this
+    rank's shm group handle, and the leader sub-ring."""
+
+    __slots__ = ("gen", "ok", "shm", "group", "local_rank", "leader",
+                 "leaders", "leader_idx", "n_groups", "warned")
+
+    def __init__(self, gen: int):
+        self.gen = gen
+        self.ok = False
+        self.shm = None           # native.shm_collective.ShmCollective
+        self.group = []           # my host group's ranks, sorted
+        self.local_rank = 0
+        self.leader = -1          # my group's leader (min rank)
+        self.leaders = []         # every group's leader, group order
+        self.leader_idx = 0
+        self.n_groups = 0
+        self.warned = False
+
+
+def _hier_min_bytes() -> int:
+    """Payload size at which ``auto`` prefers the hierarchical shm+ring
+    path (DMLC_COLL_HIER_MIN_BYTES, default 64 KB — bench_collective's
+    cutover sweep shows the shm leg already beating both tree and flat
+    ring there; below it the tree's 2·log2(n) latency wins).  Negative
+    disables hier in auto mode."""
+    try:
+        return int(os.environ.get("DMLC_COLL_HIER_MIN_BYTES",
+                                  str(64 << 10)))
+    except ValueError:
+        return 64 << 10
+
+
 def _ring_min_bytes() -> int:
     """Payload size at which allreduce cuts over from the binomial tree
     to the chunked ring (DMLC_COLL_RING_MIN_BYTES, default 1 MB; 0
@@ -138,6 +190,7 @@ class TrackerClient:
         self.gen = 0
         self.elastic = False
         self._resize_pending = False
+        self._hier: Optional[_HierState] = None
 
     # ---- tracker session helpers ---------------------------------------
     def _dial(self) -> FrameSocket:
@@ -250,11 +303,16 @@ class TrackerClient:
         self._resize_pending = False
         return self
 
-    def _dial_peer(self, host: str, port: int, peer_rank: int) -> FrameSocket:
-        """One peer link: connect + (MAGIC, rank) identification."""
+    def _dial_peer(self, host: str, port: int, peer_rank: int,
+                   handshake_timeout: Optional[float] = None) -> FrameSocket:
+        """One peer link: connect + (MAGIC, rank) identification.
+        ``handshake_timeout`` bounds the identification exchange (the
+        hier leader dance uses a short one so a peer that bailed on
+        setup cannot stall the gang); the socket reverts to the normal
+        op timeout once the link is up."""
         s = socket.create_connection((host, port),
                                      timeout=_connect_timeout())
-        s.settimeout(_op_timeout())
+        s.settimeout(handshake_timeout or _op_timeout())
         ps = FrameSocket(s)
         try:
             ps.send_int(MAGIC)
@@ -269,6 +327,8 @@ class TrackerClient:
         except BaseException:
             ps.close()
             raise
+        if handshake_timeout is not None:
+            s.settimeout(_op_timeout())
         return ps
 
     def recover(self) -> "TrackerClient":
@@ -287,6 +347,9 @@ class TrackerClient:
         for fs in self.links.values():
             fs.close()
         self.links = {}
+        # the shm half of the cascade: same-host peers blocked inside a
+        # hier shm phase see no socket die, so poison the group too
+        self._hier_teardown()
 
     def _resized(self, why: str, cause: Optional[BaseException] = None):
         from .. import telemetry
@@ -468,6 +531,7 @@ class TrackerClient:
         for ps in self.links.values():
             ps.close()
         self.links = {}
+        self._hier_teardown()
         if self._listener is not None:
             self._listener.close()
 
@@ -493,17 +557,35 @@ class TrackerClient:
         return np.frombuffer(fs.recv_all(n), dtype=like.dtype).reshape(like.shape)
 
     def allreduce(self, arr: np.ndarray, op: str = "sum",
-                  algo: Optional[str] = None) -> np.ndarray:
+                  algo: Optional[str] = None,
+                  out: Optional[np.ndarray] = None) -> np.ndarray:
         """Host-side allreduce, op ∈ {sum, max, min}.
 
         Small payloads ride the binomial tree (reduce to root, broadcast
         back — 2·log2(n) hops); payloads at or above
-        DMLC_COLL_RING_MIN_BYTES cut over to a chunked ring
-        (reduce-scatter + allgather over the tracker-brokered
-        ``ring_prev``/``ring_next`` links) whose per-rank traffic is
-        2·(n-1)/n of the payload instead of the tree's full payload per
-        level.  ``algo`` ∈ {None, "tree", "ring"} pins the choice (the
-        benchmark reports both side by side).
+        DMLC_COLL_RING_MIN_BYTES cut over to a bandwidth-optimal
+        algorithm: the hierarchical ``hier`` path (reduce-scatter inside
+        each host through the C shm collective, the chunked ring across
+        host LEADERS only, broadcast back intra-host — so only one rank
+        per host pays the network) when its setup succeeds, else the
+        flat chunked ring over the tracker-brokered
+        ``ring_prev``/``ring_next`` links.  ``algo`` ∈ {None, "tree",
+        "ring", "hier"} pins the choice (None defers to
+        ``DMLC_COLL_ALGO``, default ``auto``); the benchmark reports all
+        three side by side.
+
+        Inputs of any shape/contiguity are accepted: the payload is
+        flattened to one contiguous 1-D view up front (copying at most
+        once) and the result is reshaped back, so >1-D, 0-d and sliced
+        arrays all reduce correctly.
+
+        ``out`` (optional) is a preallocated C-contiguous result buffer
+        of the same dtype and element count — pass the INPUT itself for
+        a true in-place reduction.  This is the steady-state hot path:
+        a fresh 64 MB result allocation costs more in page faults than
+        the entire shm reduce-scatter on an oversubscribed host, and a
+        training loop reducing gradients every step should pay it never
+        rather than every step.
 
         Fully instrumented: a ``collective.allreduce`` span (op/byte/rank
         /algo tags) plus a ``barrier_enter`` event — on the tracker's
@@ -513,24 +595,73 @@ class TrackerClient:
         the reduce wave) quantifies how long everyone else paid for it."""
         from .. import telemetry
 
-        if algo not in (None, "tree", "ring"):
+        if algo not in (None, "tree", "ring", "hier"):
             raise ValueError(f"unknown allreduce algo {algo!r} "
-                             "(expected None, 'tree' or 'ring')")
-        arr = np.ascontiguousarray(arr)
+                             "(expected None, 'tree', 'ring' or 'hier')")
+        # flatten ONCE up front: a non-C-contiguous or >1-D input is
+        # copied exactly here, and every algorithm below (the ring's
+        # uint8 reinterpret, the shm path's raw pointer) sees the same
+        # flat contiguous 1-D buffer.  0-d inputs become shape (1,).
+        orig_shape = np.shape(arr)  # before ascontiguousarray: numpy 2
+        arr = np.ascontiguousarray(arr)  # promotes 0-d to (1,)
+        flat = arr.reshape(-1)
+        if out is None:
+            work = None  # lazily copied below (after the world-1 exit)
+        else:
+            if (not out.flags.c_contiguous or out.dtype != flat.dtype
+                    or out.size != flat.size):
+                raise ValueError(
+                    "allreduce out= must be C-contiguous with the "
+                    "input's dtype and element count")
+            work = out.reshape(-1)
+            if not np.shares_memory(work, flat):
+                np.copyto(work, flat)
         if self.world_size <= 1:
-            return arr.copy()
+            if work is None:
+                return flat.copy().reshape(orig_shape)
+            return work.reshape(orig_shape)
+        if work is None:
+            work = flat.copy()
         if algo is None:
             # NB: the cutover must be gang-uniform — every rank has to
             # pick the same algorithm for the same collective or the
             # byte streams desynchronize (the launcher propagates one
-            # env to all workers, so DMLC_COLL_RING_MIN_BYTES is uniform
-            # unless an operator splits it on purpose).  Selection is
-            # therefore a pure function of (env, payload size); a rank
-            # whose ring links are missing fails loudly below instead of
-            # silently diverging onto the tree.
-            min_bytes = _ring_min_bytes()
-            algo = ("ring" if min_bytes >= 0 and arr.nbytes >= min_bytes
-                    else "tree")
+            # env to all workers, so the DMLC_COLL_* knobs are uniform
+            # unless an operator splits them on purpose).  Selection is
+            # therefore a pure function of (env, payload size/dtype); a
+            # rank whose ring links are missing fails loudly below
+            # instead of silently diverging onto the tree.  The hier
+            # path's availability is itself made gang-uniform by the
+            # MIN-veto inside _hier_state().
+            algo = _coll_algo_env()
+            if algo == "auto":
+                min_bytes = _ring_min_bytes()
+                hier_min = _hier_min_bytes()
+                if (hier_min >= 0 and flat.nbytes >= hier_min
+                        and self._hier_wanted(flat.dtype)):
+                    algo = "hier"
+                elif min_bytes >= 0 and flat.nbytes >= min_bytes:
+                    algo = "ring"
+                else:
+                    algo = "tree"
+        if algo == "hier":
+            try:
+                hier_ok = self._hier_ready(flat.dtype)
+            except OSError as e:
+                # the setup's gang-wide veto is itself a tree collective;
+                # a peer preempted during it must surface as the same
+                # retryable signal as one lost mid-fold below
+                if self.elastic:
+                    self._resized(f"peer lost during hier setup: {e}",
+                                  cause=e)
+                raise
+            if not hier_ok:
+                # uniform degrade (veto'd setup / bad dtype): to the
+                # ring where bandwidth dominates, the tree below its
+                # cutover
+                min_bytes = _ring_min_bytes()
+                algo = ("ring" if min_bytes >= 0
+                        and flat.nbytes >= min_bytes else "tree")
         if algo == "ring" and (self.ring_prev not in self.links
                                or self.ring_next not in self.links):
             raise ConnectionError(
@@ -539,14 +670,18 @@ class TrackerClient:
                 "established — topology bug or partial recovery")
         self.check_resized()
         telemetry.record_event("barrier_enter", site="allreduce", op=op,
-                               rank=self.rank, bytes=int(arr.nbytes))
+                               rank=self.rank, bytes=int(flat.nbytes))
         with telemetry.span("collective.allreduce", stage="collective",
-                            args={"op": op, "bytes": int(arr.nbytes),
+                            args={"op": op, "bytes": int(flat.nbytes),
                                   "rank": self.rank, "algo": algo}):
             try:
-                if algo == "ring":
-                    return self._ring_allreduce(arr, op)
-                return self._tree_allreduce(arr, op)
+                if algo == "hier":
+                    self._hier_allreduce(work, op)
+                elif algo == "ring":
+                    self._ring_allreduce(work, op)
+                else:
+                    self._tree_allreduce(work, op)
+                return work.reshape(orig_shape)
             except OSError as e:
                 if self.elastic:
                     # peer lost mid-fold (preemption): retryable resize
@@ -555,18 +690,20 @@ class TrackerClient:
                     self._resized(f"peer lost mid-allreduce: {e}", cause=e)
                 raise
 
-    def _tree_allreduce(self, arr: np.ndarray, op: str) -> np.ndarray:
+    def _tree_allreduce(self, acc: np.ndarray, op: str) -> np.ndarray:
+        """Binomial tree, IN PLACE on ``acc`` (the caller owns the
+        buffer: ``allreduce`` hands a private copy, or the caller's own
+        array via ``out=``)."""
         from .. import telemetry
 
         fold = {"sum": np.add, "max": np.maximum, "min": np.minimum}[op]
         children = [r for r in self.tree_nbrs if r != self.parent]
-        acc = arr.astype(arr.dtype, copy=True)
         t0 = time.perf_counter()
         for c in children:
-            acc = fold(acc, self._recv_array(self.links[c], acc))
+            fold(acc, self._recv_array(self.links[c], acc), out=acc)
         if self.parent >= 0:
             self._send_array(self.links[self.parent], acc)
-            acc = self._recv_array(self.links[self.parent], acc)
+            np.copyto(acc, self._recv_array(self.links[self.parent], acc))
         # the reduce wave completes here: everything this rank spent
         # blocked on slower subtree/parent progress is barrier wait
         telemetry.observe_duration("collective", "barrier_wait",
@@ -575,15 +712,14 @@ class TrackerClient:
             self._send_array(self.links[c], acc)
         return acc
 
-    def _ring_duplex(self, send_mv: memoryview, recv_mv: memoryview):
-        """Push ``send_mv`` to ring_next while pulling ``recv_mv`` from
-        ring_prev, progressing whichever direction is ready — full-duplex
+    def _ring_duplex(self, snd: socket.socket, rcv: socket.socket,
+                     send_mv: memoryview, recv_mv: memoryview):
+        """Push ``send_mv`` to ``snd`` while pulling ``recv_mv`` from
+        ``rcv``, progressing whichever direction is ready — full-duplex
         on blocking sockets without helper threads, and deadlock-free
         when the chunk exceeds the socket buffers (every rank sends and
         receives simultaneously).  The two links are the same socket at
-        world == 2."""
-        snd = self.links[self.ring_next].sock
-        rcv = self.links[self.ring_prev].sock
+        ring size == 2."""
         # Non-blocking for the duplex, whatever the op-timeout setting:
         # with DMLC_CLIENT_OP_TIMEOUT_S=0 the sockets are fully blocking
         # and send() of a piece larger than the free socket buffer would
@@ -623,24 +759,37 @@ class TrackerClient:
             snd.settimeout(prev_timeouts[0])
             rcv.settimeout(prev_timeouts[1])
 
-    def _ring_allreduce(self, arr: np.ndarray, op: str) -> np.ndarray:
-        """Chunked ring: n-1 reduce-scatter steps (each rank ends up
-        owning the full reduction of one payload slice) followed by n-1
-        allgather steps circulating the reduced slices."""
+    def _ring_allreduce(self, out: np.ndarray, op: str) -> np.ndarray:
+        """Chunked ring over the whole world (the tracker-brokered
+        ``ring_prev``/``ring_next`` links), IN PLACE on ``out``."""
+        self._ring_pass(out, op, self.ring_prev, self.ring_next,
+                        self.world_size, self.rank)
+        return out
+
+    def _ring_pass(self, out: np.ndarray, op: str, prev_rank: int,
+                   next_rank: int, n: int, idx: int) -> None:
+        """In-place chunked ring allreduce over an arbitrary sub-ring:
+        n-1 reduce-scatter steps (each member ends up owning the full
+        reduction of one payload slice) followed by n-1 allgather steps
+        circulating the reduced slices.  The flat world ring
+        (``idx``/``n`` = rank/world) and the hier leader ring
+        (``idx``/``n`` = leader index/host count) share this code; the
+        links must already exist in ``self.links``."""
         from .. import telemetry
 
+        if n <= 1:
+            return
         fold = {"sum": np.add, "max": np.maximum, "min": np.minimum}[op]
-        n, rank = self.world_size, self.rank
+        nxt, prv = self.links[next_rank], self.links[prev_rank]
         # the ring's bulk transfers are raw (headerless) byte streams,
         # so the generation check happens ONCE up front: exchange gen
-        # ids around the ring (world == 2 collapses both directions
-        # onto one socket, which still works)
-        self.links[self.ring_next].send_int(self.gen)
-        peer_gen = self.links[self.ring_prev].recv_int()
+        # ids around the ring (a 2-member ring collapses both
+        # directions onto one socket, which still works)
+        nxt.send_int(self.gen)
+        peer_gen = prv.recv_int()
         if peer_gen != self.gen:
             self._resized(f"stale-generation ring peer (gen {peer_gen}, "
                           f"ours {self.gen})")
-        out = arr.copy()
         flat = out.view(np.uint8).reshape(-1)
         item = out.itemsize
         per = ((out.size + n - 1) // n) * item
@@ -648,28 +797,268 @@ class TrackerClient:
         scratch = np.empty(per, np.uint8)
         t0 = time.perf_counter()
         for s in range(n - 1):  # reduce-scatter
-            si, ri = (rank - s) % n, (rank - s - 1) % n
+            si, ri = (idx - s) % n, (idx - s - 1) % n
             slo, shi = bounds[si], bounds[si + 1]
             rlo, rhi = bounds[ri], bounds[ri + 1]
-            self._ring_duplex(memoryview(flat[slo:shi]),
+            self._ring_duplex(nxt.sock, prv.sock,
+                              memoryview(flat[slo:shi]),
                               memoryview(scratch[: rhi - rlo]))
             if rhi > rlo:
                 dst = flat[rlo:rhi].view(out.dtype)
                 fold(dst, scratch[: rhi - rlo].view(out.dtype), out=dst)
-        # every rank now owns the reduced slice (rank+1) % n; the
+        # every member now owns the reduced slice (idx+1) % n; the
         # reduce wave completes here (straggler wait, as in the tree)
         telemetry.observe_duration("collective", "barrier_wait",
                                    time.perf_counter() - t0)
         for s in range(n - 1):  # allgather
-            si, ri = (rank + 1 - s) % n, (rank - s) % n
+            si, ri = (idx + 1 - s) % n, (idx - s) % n
             slo, shi = bounds[si], bounds[si + 1]
             rlo, rhi = bounds[ri], bounds[ri + 1]
-            self._ring_duplex(memoryview(flat[slo:shi]),
+            self._ring_duplex(nxt.sock, prv.sock,
+                              memoryview(flat[slo:shi]),
                               memoryview(flat[rlo:rhi]))
+
+    # ---- hierarchical allreduce (shm intra-host + ring across hosts) ----
+    def _hier_wanted(self, dtype) -> bool:
+        """Cheap gang-uniform pre-checks for the auto selector: dtype
+        foldable by the shm collective and shm not env-disabled.  Library
+        availability is deliberately NOT checked here (it can differ per
+        host); _hier_state()'s MIN-veto makes the real verdict uniform."""
+        from ..base import get_env
+        from ..native import shm_collective as shmc
+
+        return shmc.supports_dtype(dtype) and get_env("DMLC_COLL_SHM", 1) != 0
+
+    def _hier_ready(self, dtype) -> bool:
+        """True when the hier path can run this payload: dtype is
+        shm-foldable and the per-generation setup (collective on first
+        use) survived the gang-wide veto."""
+        from ..native import shm_collective as shmc
+
+        if not shmc.supports_dtype(dtype):
+            return False
+        return self._hier_state().ok
+
+    def _hier_state(self) -> _HierState:
+        """The per-generation hier state, set up collectively on first
+        use.  EVERY rank must reach this from the same collective call
+        (selection is a pure function of uniform env + payload), because
+        setup ends in a MIN-allreduce veto over the tree: one rank that
+        failed to map its segment or dial a leader flips the whole gang
+        to the flat ring instead of leaving it split across algorithms."""
+        st = self._hier
+        if st is not None and st.gen == self.gen:
+            return st
+        self._hier_teardown()
+        st = self._hier_setup()
+        self._hier = st
+        return st
+
+    def _hier_teardown(self) -> None:
+        st, self._hier = self._hier, None
+        if st is not None and st.shm is not None:
+            # abort BEFORE unmap: peers blocked in an shm phase wake
+            # with an error instead of spinning out the timeout
+            st.shm.abort()
+            st.shm.close()
+            st.shm = None
+
+    def _query_hostmap(self) -> dict:
+        """Short ``hosts`` session: the tracker's rank → (host, port)
+        job map for the current generation."""
+        fs = self._session("hosts", self.rank, -1)
+        try:
+            return json.loads(fs.recv_str())
+        finally:
+            fs.close()
+
+    def _host_groups(self):
+        """(groups, hostports): ranks grouped by host (auto, from the
+        tracker's job map) or by rank blocks of ``DMLC_COLL_HIER_GROUPS``
+        (an explicit topology override, also how CI exercises the
+        leader ring on one box).  Polls the tracker until the map covers
+        the whole world — a worker still mid-brokering has no accept
+        port yet."""
+        deadline = time.monotonic() + float(
+            os.environ.get("DMLC_COLL_HIER_SETUP_TIMEOUT_S", "20"))
+        hostports: Dict[int, tuple] = {}
+        while True:
+            doc = self._query_hostmap()
+            if int(doc.get("gen", 0)) != self.gen:
+                raise ValueError("world generation changed during hier "
+                                 "setup")
+            hosts = doc.get("hosts", {})
+            if len(hosts) >= self.world_size:
+                hostports = {int(r): (h, int(p))
+                             for r, (h, p) in hosts.items()}
+                if all(r in hostports for r in range(self.world_size)):
+                    break
+            if time.monotonic() > deadline:
+                raise ValueError(
+                    f"tracker job map covers {len(hosts)}/"
+                    f"{self.world_size} ranks (workers still brokering?)")
+            time.sleep(0.2)
+        block = int(os.environ.get("DMLC_COLL_HIER_GROUPS", "0") or 0)
+        if block > 0:
+            groups = [list(range(i, min(i + block, self.world_size)))
+                      for i in range(0, self.world_size, block)]
+        else:
+            by_host: Dict[str, list] = {}
+            for r in range(self.world_size):
+                by_host.setdefault(hostports[r][0], []).append(r)
+            groups = sorted(by_host.values(), key=lambda g: g[0])
+        return groups, hostports
+
+    def _ensure_leader_links(self, need, hostports) -> None:
+        """Direct leader-to-leader links for the inter-host ring.  The
+        tracker-brokered overlay may already connect some leader pairs
+        (tree/ring neighbours) — those sockets are reused; missing pairs
+        are dialed directly with the standard (MAGIC, rank) peer
+        identification, lower rank dialing higher (a DAG, so the dial/
+        accept order can never cycle into a deadlock).  New links land
+        in ``self.links`` so teardown and the WorldResized cascade cover
+        them like any brokered link."""
+        setup_t = float(
+            os.environ.get("DMLC_COLL_HIER_SETUP_TIMEOUT_S", "20"))
+        to_accept = set()
+        for peer in sorted(need):
+            if peer == self.rank or peer in self.links:
+                continue
+            if self.rank < peer:
+                host, port = hostports[peer]
+                self.links[peer] = self._dial_peer(host, port, peer,
+                                                   handshake_timeout=setup_t)
+            else:
+                to_accept.add(peer)
+        # bound the accept wait by the SETUP timeout, not the op
+        # timeout: a remote leader that bailed on its own setup (shm
+        # veto) never dials, and a 300 s stall here would wedge the
+        # whole gang's veto allreduce behind this one rank
+        prev_timeout = self._listener.gettimeout()
+        if to_accept:
+            self._listener.settimeout(setup_t)
+        try:
+            self._accept_leader_links(to_accept)
+        finally:
+            self._listener.settimeout(prev_timeout)
+
+    def _accept_leader_links(self, to_accept) -> None:
+        while to_accept:
+            conn, _ = self._listener.accept()
+            conn.settimeout(_op_timeout())
+            ps = FrameSocket(conn)
+            try:
+                if ps.recv_int() != MAGIC:
+                    raise ConnectionError("bad magic")
+                peer = ps.recv_int()
+                if peer not in to_accept:
+                    raise ConnectionError(f"unexpected dialer rank {peer}")
+                ps.send_int(MAGIC)
+                ps.send_int(self.rank)
+            except (OSError, ConnectionError):
+                ps.close()
+                continue  # stray/torn dial: keep waiting for real peers
+            self.links[peer] = ps
+            to_accept.discard(peer)
+
+    def _hier_setup(self) -> _HierState:
+        """Collective hier setup: host grouping from the tracker job
+        map, one shm group per multi-rank host, leader-ring links —
+        ending in the gang-wide MIN veto that keeps the algorithm
+        choice uniform.  Never raises for setup-class failures (those
+        veto); link-level OSErrors during the veto itself propagate
+        like any collective error."""
+        from ..native import shm_collective as shmc
+
+        st = _HierState(self.gen)
+        ok = True
+        groups = []
+        hostports: Dict[int, tuple] = {}
+        try:
+            groups, hostports = self._host_groups()
+        except (OSError, ValueError, ConnectionError) as e:
+            logger.warning("rank %d: hier host grouping failed: %s",
+                           self.rank, e)
+            ok = False
+        if ok:
+            st.group = next(g for g in groups if self.rank in g)
+            st.leaders = [g[0] for g in groups]
+            st.n_groups = len(groups)
+            st.leader = st.group[0]
+            st.local_rank = st.group.index(self.rank)
+            st.leader_idx = st.leaders.index(st.leader)
+            if all(len(g) == 1 for g in groups):
+                ok = False  # no intra-host sharing: hier ≡ ring + overhead
+        if ok and len(st.group) > 1:
+            try:
+                chunk_kb = int(
+                    os.environ.get("DMLC_COLL_SHM_CHUNK_KB", "0") or 0)
+                st.shm = shmc.ShmCollective(
+                    f"dmlc-hier-{self.tracker_port}-{self.gen}-{st.leader}",
+                    st.local_rank, len(st.group), chunk_kb=chunk_kb)
+            except shmc.ShmGroupError as e:
+                logger.warning("rank %d: hier shm group setup failed: %s",
+                               self.rank, e)
+                ok = False
+        if ok and st.n_groups > 1 and self.rank == st.leader:
+            try:
+                prev = st.leaders[(st.leader_idx - 1) % st.n_groups]
+                nxt = st.leaders[(st.leader_idx + 1) % st.n_groups]
+                self._ensure_leader_links({prev, nxt}, hostports)
+            except (OSError, ConnectionError) as e:
+                logger.warning("rank %d: hier leader-link setup failed: "
+                               "%s", self.rank, e)
+                ok = False
+        # gang-wide veto: every rank reaches this allreduce (setup-class
+        # failures above only flip `ok`), so the verdict is uniform
+        verdict = self._tree_allreduce(
+            np.asarray([1 if ok else 0], np.int32), "min")
+        st.ok = bool(int(verdict[0]))
+        if not st.ok:
+            if st.shm is not None:
+                st.shm.close()
+                st.shm = None
+            if not st.warned:
+                st.warned = True
+                logger.info(
+                    "rank %d: hierarchical allreduce unavailable this "
+                    "generation; using the flat ring", self.rank)
+        return st
+
+    def _hier_allreduce(self, out: np.ndarray, op: str) -> np.ndarray:
+        """Hierarchy, IN PLACE on ``out``: reduce-scatter + allgather
+        inside the host over the C shm collective (= intra-host
+        allreduce, one streaming fold per member), chunked TCP ring
+        across host leaders only, then an intra-host shm broadcast of
+        the global result — network traffic is one ring's worth per
+        HOST instead of per rank."""
+        from ..native.shm_collective import ShmGroupError
+
+        st = self._hier
+        try:
+            if st.shm is not None:
+                st.shm.reduce_scatter(out, op)
+                st.shm.allgather(out)
+            if st.n_groups > 1:
+                if self.rank == st.leader:
+                    prev = st.leaders[(st.leader_idx - 1) % st.n_groups]
+                    nxt = st.leaders[(st.leader_idx + 1) % st.n_groups]
+                    self._ring_pass(out, op, prev, nxt, st.n_groups,
+                                    st.leader_idx)
+                if st.shm is not None:
+                    st.shm.broadcast(out, root=0)
+        except ShmGroupError as e:
+            if self.elastic:
+                # a same-host peer bailed (resize cascade reached the
+                # group, or it died and the wait timed out): retryable
+                self._resized(f"shm group failed mid-allreduce: {e}",
+                              cause=e)
+            raise ConnectionError(str(e)) from e
         return out
 
-    def allreduce_sum(self, arr: np.ndarray) -> np.ndarray:
-        return self.allreduce(arr, "sum")
+    def allreduce_sum(self, arr: np.ndarray,
+                      out: Optional[np.ndarray] = None) -> np.ndarray:
+        return self.allreduce(arr, "sum", out=out)
 
     def broadcast(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
         """Tree broadcast from root (root's value wins everywhere).

@@ -584,6 +584,17 @@ class RabitTracker:
         return json.dumps({"gen": self.gen, "world": self._world,
                            "elastic": self.elastic, "dead": n_dead})
 
+    def _hosts_doc(self) -> str:
+        """Rank → (host, accept-port) snapshot of every fully-brokered
+        worker.  Served by the accept-loop thread, which is the only
+        mutator of ``_entries``, so no locking.  Clients poll until the
+        map covers the whole world (a worker mid-brokering has no port
+        yet and is omitted)."""
+        hosts = {str(r): [e.host, e.port]
+                 for r, e in self._entries.items() if e.port is not None}
+        return json.dumps({"gen": self.gen, "world": self._world,
+                           "hosts": hosts})
+
     def _accept_loop(self, n_workers: int) -> None:
         self._world = n_workers
         self._registry = AcceptRegistry()
@@ -607,6 +618,12 @@ class RabitTracker:
                     # elastic status probe: resize()'s settle-wait polls
                     # this until the membership change lands
                     w.sock.send_str(self._gen_doc())
+                    continue
+                if w.cmd == "hosts":
+                    # job-map probe: rank -> (host, accept port) of the
+                    # current generation — the hier collective's auto
+                    # host-grouping and leader-ring dialing read this
+                    w.sock.send_str(self._hosts_doc())
                     continue
                 if w.cmd == "metrics":
                     # telemetry heartbeat: latest snapshot for this rank

@@ -6,10 +6,15 @@ Run under the launcher:
 
 With ``bench <bytes> <reps>`` arguments it becomes the host-collective
 microbench: every rank allreduces the same f64 payload through the
-binomial tree and the chunked ring (tracker/client.py), and rank 0
-prints one JSON line per algorithm in the test_collective.c convention
+binomial tree, the chunked ring, and the hierarchical shm+ring path
+(tracker/client.py) at a small/medium/full size sweep (the cutover
+evidence for DMLC_COLL_RING_MIN_BYTES), then runs the bucketed-overlap
+pass (parallel.overlap.GradientBucketer) under a step-ledger window so
+the exposed-vs-overlapped collective split is measured by the same
+machinery production uses.  Rank 0 prints one JSON line per
+measurement in the test_collective.c convention
 (busbw = 2·(n-1)/n · algbw) — scripts/bench_collective.py runs it to
-report tree-vs-ring side by side.
+report the algorithms side by side.
 """
 
 import json
@@ -24,25 +29,97 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 from dmlc_tpu.tracker.client import TrackerClient  # noqa: E402
 
 
+def _emit(client, payload):
+    if client.rank == 0:
+        print(json.dumps(payload), flush=True)
+
+
 def bench(client, nbytes, reps):
-    count = nbytes // 8
-    arr = np.full(count, 1.0, np.float64)
-    for algo in ("tree", "ring"):
-        out = client.allreduce(arr, "sum", algo=algo)  # warmup + sync
-        t0 = time.perf_counter()
-        for _ in range(reps):
-            out = client.allreduce(arr, "sum", algo=algo)
-        dt = time.perf_counter() - t0
-        assert abs(out[0] - client.world_size) < 1e-9, out[0]
-        if client.rank == 0:
-            algbw = nbytes * reps / dt / 1e6
-            busbw = algbw * 2 * (client.world_size - 1) / client.world_size
-            print(json.dumps({
-                "op": f"host_allreduce_{algo}", "bytes": nbytes,
+    w = client.world_size
+    # full payload + the cutover sweep: 64 KB sits under the 1 MB ring
+    # cutover (tree territory), 1 MB right at it, `nbytes` far above
+    sizes = sorted({1 << 16, 1 << 20, nbytes})
+    for algo in ("tree", "ring", "hier"):
+        for sz in sizes:
+            arr = np.full(sz // 8, 1.0, np.float64)
+            # out=arr: the steady-state in-place path — a fresh 64 MB
+            # result allocation per op costs more in page faults than
+            # the shm fold itself on an oversubscribed host, and no
+            # production loop pays it either.  Values grow w× per rep.
+            client.allreduce(arr, "sum", algo=algo, out=arr)  # warmup
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                client.allreduce(arr, "sum", algo=algo, out=arr)
+            dt = time.perf_counter() - t0
+            want = float(w) ** (reps + 1)
+            assert abs(arr[0] - want) < 1e-9 * want, (arr[0], want)
+            algbw = sz * reps / dt / 1e6
+            _emit(client, {
+                "op": f"host_allreduce_{algo}", "bytes": sz,
                 "algbw_MBps": round(algbw, 1),
-                "busbw_MBps": round(busbw, 1),
-                "world": client.world_size,
-            }), flush=True)
+                "busbw_MBps": round(algbw * 2 * (w - 1) / w, 1),
+                "world": w,
+            })
+
+
+def bench_overlap(client, nbytes, reps):
+    """Bucketed-overlap pass: the same payload as 16 'gradient leaves'
+    through a GradientBucketer (background collective thread, default
+    DMLC_COLL_ALGO routing) inside a step-ledger window, against a
+    synchronous single-allreduce step — the ledger's exposed vs
+    overlapped collective split is the before/after."""
+    from dmlc_tpu import telemetry
+    from dmlc_tpu.parallel.overlap import GradientBucketer
+
+    w = client.world_size
+    n_leaves = 16
+    leaves = [np.full(nbytes // n_leaves // 8, 1.0, np.float64)
+              for _ in range(n_leaves)]
+    flat = np.concatenate(leaves)
+
+    # --- before: the serial step (allreduce fully exposed) ---
+    client.allreduce_sum(flat, out=flat)  # warmup (hier setup)
+    telemetry.step_begin()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        client.allreduce_sum(flat, out=flat)
+    sync_wall = time.perf_counter() - t0
+    rec_sync = telemetry.step_end()
+    want = float(w) ** (reps + 1)
+    assert abs(flat[0] - want) < 1e-9 * want, (flat[0], want)
+
+    # --- after: bucketed overlap (collectives hide under packing);
+    # in-place on the bucket buffers the bucketer owns ---
+    bucketer = GradientBucketer(lambda a: client.allreduce_sum(a, out=a),
+                                dtype=np.float64)
+    bucketer.reduce_leaves(leaves)  # warmup
+    telemetry.step_begin()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        red = bucketer.reduce_leaves(leaves)
+    ov_wall = time.perf_counter() - t0
+    rec_ov = telemetry.step_end()
+    for r in red:
+        assert abs(r[0] - w) < 1e-9, r[0]
+    timings = bucketer.last_timings()
+    bucketer.close()
+    _emit(client, {
+        "op": "host_allreduce_overlap", "bytes": nbytes, "world": w,
+        "reps": reps, "n_leaves": n_leaves,
+        "sync_wall_s": round(sync_wall, 4),
+        "overlap_wall_s": round(ov_wall, 4),
+        "sync_exposed_s": round(rec_sync["collective_s"], 4),
+        "overlap_exposed_s": round(rec_ov["collective_s"], 4),
+        "overlap_overlapped_s":
+            round(rec_ov["collective_overlapped_s"], 4),
+        "exposed_fraction_sync":
+            round(rec_sync["collective_s"] / rec_sync["wall_s"], 3),
+        "exposed_fraction_overlap":
+            round(rec_ov["collective_s"] / rec_ov["wall_s"], 3),
+        # last rep's per-bucket (bytes, seconds) — the bucket-granular
+        # view of where collective time went
+        "bucket_timings": [[b, round(s, 5)] for b, s in timings],
+    })
 
 
 def main():
@@ -52,6 +129,7 @@ def main():
         nbytes = int(sys.argv[2]) if len(sys.argv) > 2 else 64 << 20
         reps = int(sys.argv[3]) if len(sys.argv) > 3 else 3
         bench(client, nbytes, reps)
+        bench_overlap(client, nbytes, reps)
     else:
         out = client.allreduce_sum(np.full(4, float(client.rank + 1)))
         expected = client.world_size * (client.world_size + 1) / 2

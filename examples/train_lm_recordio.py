@@ -74,6 +74,8 @@ class _ElasticTrainer:
     gradient averaging, and the WorldResized recovery protocol."""
 
     def __init__(self, manager, mesh):
+        from dmlc_tpu.base import get_env
+        from dmlc_tpu.parallel.overlap import GradientBucketer
         from dmlc_tpu.telemetry import HeartbeatSender
         from dmlc_tpu.tracker.client import TrackerClient
 
@@ -81,6 +83,16 @@ class _ElasticTrainer:
         self.hb = HeartbeatSender(self.client, interval=1.0)
         self.manager = manager
         self.mesh = mesh
+        # overlapped gradient reduction (DMLC_COLL_OVERLAP=0 opts out):
+        # buckets allreduce on a background thread while later leaves
+        # are still being fetched off-device and packed; a WorldResized
+        # raised on that thread transports through the bucket futures
+        # and re-raises at the join, inside the existing recovery path
+        # in-place (out=a) on the bucket buffers the bucketer owns: the
+        # steady-state gradient exchange allocates nothing per bucket
+        self.bucketer = (
+            GradientBucketer(lambda a: self.client.allreduce_sum(a, out=a))
+            if get_env("DMLC_COLL_OVERLAP", True) else None)
 
     @property
     def world(self):
@@ -112,6 +124,8 @@ class _ElasticTrainer:
         the host collective; raises WorldResized on membership change.
         Also returns the global grad norm (computed on the AVERAGED
         gradients, so every rank reaches the same self-heal verdict)."""
+        if self.bucketer is not None:
+            return self._allreduce_grads_overlapped(grads, loss)
         leaves, treedef, flat = self._flatten(grads)
         flat = np.concatenate([flat.astype(np.float32),
                                np.asarray([loss], np.float32)])
@@ -121,6 +135,26 @@ class _ElasticTrainer:
                                      dtype=np.float64)))
         return (self._unflatten(leaves, treedef, total[:-1]),
                 float(total[-1]), gnorm)
+
+    def _allreduce_grads_overlapped(self, grads, loss: float):
+        """Bucketed-overlapped version of ``allreduce_grads``: leaves
+        are packed reverse-topologically into DMLC_COLL_BUCKET_MB
+        buckets, each bucket's allreduce runs on the bucketer's
+        background thread while later leaves are still converted and
+        packed, and the join re-raises any collective-thread exception
+        (incl. WorldResized) here.  All-or-nothing: on failure the
+        input gradients are untouched."""
+        import jax
+
+        w = float(self.client.world_size)
+        red_loss, red = self.bucketer.reduce_tree(
+            (np.asarray([loss], np.float32), grads))
+        gnorm = float(np.sqrt(sum(
+            float(np.sum(np.square(np.asarray(r, np.float64) / w)))
+            for r in jax.tree_util.tree_leaves(red))))
+        avg = jax.tree_util.tree_map(
+            lambda r, g: (r / w).astype(np.asarray(g).dtype), red, grads)
+        return avg, float(red_loss[0]) / w, gnorm
 
     def _broadcast_state(self, params, opt_state, done: int):
         """Make rank 0's (params, opt_state, step) authoritative
@@ -173,6 +207,8 @@ class _ElasticTrainer:
         return params, opt_state, done
 
     def close(self):
+        if self.bucketer is not None:
+            self.bucketer.close()
         self.hb.close()
         self.client.shutdown()
 
@@ -514,8 +550,11 @@ def main():
         mfu = led.get("mfu")
         print(f"ledger: step p50 {led['step_time_p50'] * 1e3:.1f} ms, "
               f"p99 {led['step_time_p99'] * 1e3:.1f} ms, feed-wait "
-              f"{led['feed_wait_fraction'] * 100:.0f}%, goodput "
-              f"{led.get('goodput_tokens_per_s', 0):,.0f} tok/s"
+              f"{led['feed_wait_fraction'] * 100:.0f}%, collective "
+              f"exposed {led['collective_exposed_fraction'] * 100:.0f}%"
+              f" / overlapped "
+              f"{led['collective_overlapped_fraction'] * 100:.0f}%, "
+              f"goodput {led.get('goodput_tokens_per_s', 0):,.0f} tok/s"
               + (f", MFU {mfu * 100:.1f}%" if mfu is not None else ""))
 
 

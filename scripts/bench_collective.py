@@ -83,10 +83,11 @@ def _loopback_once(nbytes):
 
 
 def host_collective_bench(world, nbytes=64 << 20, reps=2):
-    """Python host-collective allreduce (tracker/client.py) at ``nbytes``
-    through BOTH algorithms — binomial tree vs the chunked ring over the
-    tracker-brokered ring links — under the real local launcher.  Rank 0
-    prints one JSON line per algorithm (examples/allreduce_worker.py)."""
+    """Python host-collective allreduce (tracker/client.py) at a
+    64KB/1MB/``nbytes`` sweep through all three algorithms — binomial
+    tree, chunked ring, hierarchical shm+ring — plus the bucketed-
+    overlap pass, under the real local launcher.  Rank 0 prints one
+    JSON line per measurement (examples/allreduce_worker.py)."""
     r = subprocess.run(
         [sys.executable, os.path.join(REPO, "bin", "dmlc-submit"),
          "--cluster", "local", "--num-workers", str(world), "--",
@@ -128,10 +129,30 @@ def main():
         line_rate = loopback_line_rate()
     big = next((x for x in results
                 if x["op"] == "allreduce" and x["bytes"] == 64 << 20), None)
-    h_tree = next((x for x in host_results
-                   if x["op"] == "host_allreduce_tree"), None)
-    h_ring = next((x for x in host_results
-                   if x["op"] == "host_allreduce_ring"), None)
+
+    def host_at(algo, nbytes=64 << 20):
+        return next((x for x in host_results
+                     if x["op"] == f"host_allreduce_{algo}"
+                     and x.get("bytes") == nbytes), None)
+
+    h_tree = host_at("tree")
+    h_ring = host_at("ring")
+    h_hier = host_at("hier")
+    h_overlap = next((x for x in host_results
+                      if x["op"] == "host_allreduce_overlap"), None)
+    # cutover evidence: fastest algorithm per swept size — the basis
+    # for the DMLC_COLL_RING_MIN_BYTES / DMLC_COLL_ALGO=auto defaults
+    cutover = {}
+    for sz in sorted({x["bytes"] for x in host_results
+                      if x["op"].startswith("host_allreduce_")
+                      and "busbw_MBps" in x}):
+        at = {a: host_at(a, sz) for a in ("tree", "ring", "hier")}
+        cutover[str(sz)] = {
+            a: (at[a]["busbw_MBps"] if at[a] else None) for a in at}
+        present = {a: v for a, v in at.items() if v}
+        if present:
+            cutover[str(sz)]["best"] = max(
+                present, key=lambda a: present[a]["busbw_MBps"])
     out = {
         "world": world,
         # busbw/loopback ratios are NOT comparable across hosts with
@@ -160,6 +181,23 @@ def main():
         "host_allreduce_64MB_ring_vs_tree":
             round(h_ring["busbw_MBps"] / h_tree["busbw_MBps"], 3)
             if h_ring and h_tree else None,
+        # hierarchical shm+ring: intra-host reduce-scatter/allgather
+        # through the C shm collective, TCP ring across host leaders
+        # only (all ranks share one host here, so this is the pure shm
+        # leg — the busbw the flat ring leaves on the table)
+        "host_allreduce_64MB_busbw_hier_MBps":
+            h_hier["busbw_MBps"] if h_hier else None,
+        "host_allreduce_64MB_hier_vs_ring":
+            round(h_hier["busbw_MBps"] / h_ring["busbw_MBps"], 3)
+            if h_hier and h_ring else None,
+        # per-size fastest algorithm (the cutover-retuning evidence for
+        # these shipped auto-mode thresholds)
+        "host_allreduce_cutover_sweep": cutover,
+        "coll_auto_defaults": {"DMLC_COLL_HIER_MIN_BYTES": 64 << 10,
+                               "DMLC_COLL_RING_MIN_BYTES": 1 << 20},
+        # bucketed-overlap pass: the step ledger's exposed-vs-overlapped
+        # split for a serial vs a bucketed step, + per-bucket timings
+        "host_allreduce_overlap_64MB": h_overlap,
         # harness-phase wall-time attribution (build vs run vs probe)
         "telemetry": telemetry.export_json(),
     }

@@ -7,11 +7,17 @@ waiting for the next full bench refresh:
      actually shipped to the device through recordio_packed_feed must
      stay >= 0.90 (the packed layout's whole point is not paying for
      padding; a tail-batch or offsets-table regression shows up here).
-  2. Host collective: the chunked ring allreduce must beat the binomial
-     tree on bus bandwidth at a bandwidth-dominated payload, under the
-     real local launcher (tracker-brokered ring links).
+  2. Host collective: at 64 MB under the real local launcher, the
+     chunked ring allreduce must beat the binomial tree on bus
+     bandwidth, and the hierarchical shm+ring path must beat the flat
+     ring (its whole point: the shm leg moves intra-host bytes at
+     memory speed, only host leaders pay the network).
+  3. Overlap: the bucketed-overlap step (parallel.overlap) must report
+     a NONZERO overlapped collective share through the step ledger —
+     collective time demonstrably hid under the stepping thread's work
+     instead of extending the step.
 
-Runs in ~1 min on 2 cores.  Usage: python scripts/perf_smoke.py
+Runs in ~2 min on 2 cores.  Usage: python scripts/perf_smoke.py
 """
 
 import os
@@ -61,16 +67,43 @@ def feed_smoke(tmp):
 
 def collective_smoke():
     from bench_collective import host_collective_bench
+    from dmlc_tpu.native import shm_collective
 
-    results = host_collective_bench(world=4, nbytes=16 << 20, reps=2)
-    by_op = {r["op"]: r for r in results}
-    tree = by_op["host_allreduce_tree"]["busbw_MBps"]
-    ring = by_op["host_allreduce_ring"]["busbw_MBps"]
-    print(f"perf_smoke: host allreduce 16MB busbw ring={ring} "
-          f"tree={tree} MB/s")
+    # without the native shm library the 'hier' measurement silently
+    # degrades to the flat ring and the >= assertion below would be a
+    # ring-vs-ring coin flip — fail loudly on the precondition instead
+    assert shm_collective.available(), (
+        "native shm collective unavailable (no g++? "
+        "DMLC_TPU_DISABLE_NATIVE set?) — the hier perf gate cannot run")
+
+    nbytes = 64 << 20
+    results = host_collective_bench(world=4, nbytes=nbytes, reps=1)
+
+    def at(algo, sz=nbytes):
+        return next(r for r in results
+                    if r["op"] == f"host_allreduce_{algo}"
+                    and r.get("bytes") == sz)
+
+    tree = at("tree")["busbw_MBps"]
+    ring = at("ring")["busbw_MBps"]
+    hier = at("hier")["busbw_MBps"]
+    print(f"perf_smoke: host allreduce 64MB busbw hier={hier} "
+          f"ring={ring} tree={tree} MB/s")
     assert ring >= tree, (
         f"ring allreduce ({ring} MB/s) lost to tree ({tree} MB/s) at a "
         "bandwidth-dominated size")
+    assert hier >= ring, (
+        f"hier allreduce ({hier} MB/s) lost to the flat ring "
+        f"({ring} MB/s) at 64 MB — the shm leg regressed")
+
+    ov = next(r for r in results if r["op"] == "host_allreduce_overlap")
+    print(f"perf_smoke: overlap step exposed "
+          f"{ov['exposed_fraction_overlap']:.2f} vs sync "
+          f"{ov['exposed_fraction_sync']:.2f}, overlapped "
+          f"{ov['overlap_overlapped_s']:.3f}s")
+    assert ov["overlap_overlapped_s"] > 0, (
+        "step ledger saw no overlapped collective time in the "
+        "bucketed-overlap step")
 
 
 def main():

@@ -141,3 +141,125 @@ def test_c_driver_collectives_under_local_launcher(driver, world, shm):
     # every rank logged through the tracker print relay
     for rank in range(world):
         assert f"rank {rank}/{world}: collective ABI OK" in r.stderr, r.stderr
+
+
+# ---------------------------------------------------------------------------
+# Standalone shm collective group (dmlc_shm_coll_*): the intra-host leg
+# of the hierarchical allreduce, driven through the ctypes binding
+# across REAL processes sharing one segment
+# ---------------------------------------------------------------------------
+
+def _shm_group_child(name, rank, world, q):
+    import numpy as np
+
+    from dmlc_tpu.native.shm_collective import ShmCollective
+
+    try:
+        g = ShmCollective(name, rank, world)
+        out = {}
+        for dtype in (np.float32, np.float64, np.int32, np.int64):
+            arr = (np.arange(1000).astype(dtype) % 97) * (rank + 1)
+            g.reduce_scatter(arr, "sum")
+            g.allgather(arr)
+            out[f"sum_{np.dtype(dtype).name}"] = arr
+        arr = np.arange(1000, dtype=np.float32) + rank
+        g.allreduce(arr, "max")
+        out["max"] = arr
+        arr = np.arange(1000, dtype=np.float32) + rank
+        g.allreduce(arr, "min")
+        out["min"] = arr
+        b = (np.full(257, rank, np.float64) if rank != 1
+             else np.arange(257, dtype=np.float64))
+        g.broadcast(b, root=1)
+        out["bcast"] = b
+        g.close()
+        q.put((rank, out))
+    except BaseException as e:  # noqa: BLE001 - surfaced by the parent
+        q.put((rank, e))
+
+
+@pytest.mark.parametrize("world", [2, 3, 5])
+def test_shm_group_collectives_across_processes(world):
+    import multiprocessing as mp
+
+    import numpy as np
+
+    from dmlc_tpu.native import shm_collective as shmc
+
+    if not shmc.available():
+        pytest.skip("native collective library unavailable")
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    name = f"dmlc-test-grp-{os.getpid()}-{world}"
+    procs = [ctx.Process(target=_shm_group_child,
+                         args=(name, r, world, q)) for r in range(world)]
+    for p in procs:
+        p.start()
+    results = {}
+    for _ in range(world):
+        rank, out = q.get(timeout=90)
+        assert not isinstance(out, BaseException), (rank, out)
+        results[rank] = out
+    for p in procs:
+        p.join(30)
+    scale = world * (world + 1) // 2
+    for rank, out in results.items():
+        for dtype in ("float32", "float64", "int32", "int64"):
+            want = ((np.arange(1000) % 97) * scale).astype(dtype)
+            np.testing.assert_array_equal(out[f"sum_{dtype}"], want,
+                                          err_msg=f"{rank} {dtype}")
+        np.testing.assert_array_equal(
+            out["max"], np.arange(1000, dtype=np.float32) + world - 1)
+        np.testing.assert_array_equal(
+            out["min"], np.arange(1000, dtype=np.float32))
+        np.testing.assert_array_equal(
+            out["bcast"], np.arange(257, dtype=np.float64))
+
+
+def _shm_abort_child(name, rank, q):
+    import numpy as np
+
+    from dmlc_tpu.native.shm_collective import ShmCollective, ShmGroupError
+
+    try:
+        g = ShmCollective(name, rank, 2)
+        if rank == 1:
+            # never participate: poison the group instead, then vanish
+            g.abort()
+            g.close()
+            q.put((rank, "aborted"))
+            return
+        try:
+            g.allreduce(np.ones(64, np.float32), "sum")
+            q.put((rank, "unexpected success"))
+        except ShmGroupError:
+            q.put((rank, "woke"))
+        g.close()
+    except BaseException as e:  # noqa: BLE001
+        q.put((rank, e))
+
+
+def test_shm_group_abort_wakes_blocked_peer():
+    """abort() is the shm analog of tearing TCP links: a peer blocked
+    in a collective must error out promptly instead of spinning to the
+    full DMLC_COLL_SHM_TIMEOUT_S."""
+    import multiprocessing as mp
+    import time
+
+    from dmlc_tpu.native import shm_collective as shmc
+
+    if not shmc.available():
+        pytest.skip("native collective library unavailable")
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    name = f"dmlc-test-abort-{os.getpid()}"
+    procs = [ctx.Process(target=_shm_abort_child, args=(name, r, q))
+             for r in range(2)]
+    t0 = time.monotonic()
+    for p in procs:
+        p.start()
+    results = dict(q.get(timeout=60) for _ in range(2))
+    for p in procs:
+        p.join(30)
+    assert results[1] == "aborted" and results[0] == "woke", results
+    assert time.monotonic() - t0 < 30, "abort did not wake the peer"

@@ -221,3 +221,60 @@ def test_ring_attention_flash_impl_matches_reference(causal):
     fn = make_sharded_ring_attention(mesh, causal=causal, impl="flash")
     got = jax.jit(fn)(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# bucketed_psum_mean (parallel/overlap.py device path): one lax.psum per
+# reverse-topological bucket must equal the fused pmean — vmap's named
+# axis exercises the psum semantics without needing shard_map
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("bucket_bytes_", [16, 64, 1 << 20])
+def test_bucketed_psum_mean_matches_fused(bucket_bytes_):
+    from dmlc_tpu.parallel.overlap import bucketed_psum_mean
+
+    n = 4
+    rng = np.random.default_rng(0)
+    tree = {
+        "w": rng.normal(size=(n, 3, 5)).astype(np.float32),
+        "b": rng.normal(size=(n, 7)).astype(np.float32),
+        "scale": rng.normal(size=(n, 1)).astype(np.float32),
+    }
+
+    out = jax.vmap(lambda t: bucketed_psum_mean(
+        t, "i", bucket_bytes_=bucket_bytes_), axis_name="i")(tree)
+    for key in tree:
+        want = np.broadcast_to(tree[key].mean(axis=0, keepdims=True),
+                               tree[key].shape)
+        np.testing.assert_allclose(np.asarray(out[key]), want,
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_bucketed_psum_mean_splits_on_dtype_boundary():
+    """Mixed-dtype leaves cannot share a concatenated bucket — the
+    bucketer must split them, and both dtypes still reduce correctly."""
+    from dmlc_tpu.parallel.overlap import bucketed_psum_mean
+
+    n = 2
+    tree = [jnp.arange(2 * n, dtype=jnp.float32).reshape(n, 2),
+            jnp.arange(3 * n, dtype=jnp.bfloat16).reshape(n, 3)]
+    out = jax.vmap(lambda t: bucketed_psum_mean(t, "i", bucket_bytes_=1 << 20),
+                   axis_name="i")(tree)
+    for got, src in zip(out, tree):
+        assert got.dtype == src.dtype
+        want = np.broadcast_to(
+            np.asarray(src, np.float32).mean(axis=0, keepdims=True),
+            src.shape)
+        np.testing.assert_allclose(np.asarray(got, np.float32), want,
+                                   rtol=1e-2)
+
+
+def test_make_train_step_overlap_arg_validation():
+    from dmlc_tpu.models import TransformerConfig, make_train_step
+    from dmlc_tpu.parallel import build_mesh
+
+    mesh = build_mesh(1, dp=1, sp=1, tp=1, pp=1, ep=1)
+    cfg = TransformerConfig(vocab=32, d_model=16, n_heads=2, head_dim=8,
+                            d_ff=32, n_layers=1, n_experts=1)
+    with pytest.raises(ValueError, match="overlap"):
+        make_train_step(mesh, cfg, overlap="bogus")

@@ -390,3 +390,146 @@ def test_worker_death_during_batch_brokering():
                        match="mid-brokering|protocol violation"):
         tracker.join(timeout=15)
     tracker.close()
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical allreduce (shm intra-host reduce-scatter/allgather +
+# chunked ring across host leaders) and the flatten-up-front contract
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n", [2, 4, 5])
+def test_hier_allreduce_matches_tree(n):
+    """The hier path (all ranks on one host here: pure shm leg) must
+    agree bit-for-bit with the tree on sum/max/min."""
+
+    def fn(c):
+        big = (np.arange(40000, dtype=np.float32) % 251) + c.rank
+        return (c.allreduce(big, "sum", algo="hier"),
+                c.allreduce(big, "sum", algo="tree"),
+                c.allreduce(big, "max", algo="hier"),
+                c.allreduce(big, "min", algo="hier"))
+
+    results = _run_workers(n, fn)
+    base = np.arange(40000, dtype=np.float32) % 251
+    for h_sum, t_sum, h_max, h_min in results:
+        np.testing.assert_array_equal(h_sum, t_sum)
+        np.testing.assert_array_equal(h_max, base + (n - 1))
+        np.testing.assert_array_equal(h_min, base)
+
+
+def test_hier_leader_ring_with_explicit_groups(monkeypatch):
+    """DMLC_COLL_HIER_GROUPS=2 splits one box into rank-block 'hosts':
+    shm inside each pair, the chunked ring across group leaders, and a
+    broadcast back — including a ragged singleton group at n=5."""
+    monkeypatch.setenv("DMLC_COLL_HIER_GROUPS", "2")
+
+    def fn(c):
+        x = (np.arange(9000, dtype=np.float64) % 13) * (c.rank + 1)
+        return c.allreduce(x, "sum", algo="hier")
+
+    n = 5
+    results = _run_workers(n, fn)
+    want = (np.arange(9000, dtype=np.float64) % 13) * (n * (n + 1) / 2)
+    for r in results:
+        np.testing.assert_allclose(r, want)
+
+
+def test_hier_vetoes_to_flat_path_when_shm_fails(monkeypatch):
+    """One rank failing shm setup must flip the WHOLE gang to the flat
+    path (gang-uniform MIN veto) — results stay correct, nobody hangs,
+    and the veto is cached for the generation."""
+    import dmlc_tpu.native.shm_collective as shmc
+
+    def boom(*a, **k):
+        raise shmc.ShmGroupError("forced setup failure")
+
+    monkeypatch.setattr(shmc, "ShmCollective", boom)
+
+    def fn(c):
+        x = np.ones(5000, np.float64) * (c.rank + 1)
+        first = c.allreduce(x, "sum", algo="hier")
+        second = c.allreduce(x, "sum", algo="hier")
+        return first, second
+
+    n = 3
+    results = _run_workers(n, fn)
+    want = np.ones(5000, np.float64) * (n * (n + 1) / 2)
+    for first, second in results:
+        np.testing.assert_allclose(first, want)
+        np.testing.assert_allclose(second, want)
+
+
+def test_allreduce_arbitrary_shapes_and_strides():
+    """Regression: non-C-contiguous / >1-D / 0-d inputs are flattened
+    to one contiguous copy up front on EVERY algorithm — shapes come
+    back intact and the values are right (the ring's uint8 reinterpret
+    used to assume a flat contiguous input)."""
+    n = 3
+
+    def fn(c):
+        m = np.arange(24, dtype=np.float64).reshape(4, 6) + c.rank
+        big = np.arange(2 << 18, dtype=np.float64).reshape(2, -1) + c.rank
+        return (c.allreduce_sum(m),              # 2-D
+                c.allreduce_sum(m.T),            # transposed view
+                c.allreduce_sum(m[:, ::2]),      # strided view
+                c.allreduce_sum(np.asarray(2.0)),  # 0-d
+                c.allreduce(big[:, ::2], "sum", algo="ring"),
+                c.allreduce(big, "max", algo="hier"))
+
+    results = _run_workers(n, fn)
+    base = np.arange(24, dtype=np.float64).reshape(4, 6)
+    bigb = np.arange(2 << 18, dtype=np.float64).reshape(2, -1)
+    rsum = n * (n - 1) / 2
+    for m, mt, ms, z, br, bm in results:
+        assert m.shape == (4, 6) and mt.shape == (6, 4)
+        assert ms.shape == (4, 3) and z.shape == ()
+        assert br.shape == (2, bigb.shape[1] // 2)
+        assert bm.shape == bigb.shape
+        np.testing.assert_allclose(m, base * n + rsum)
+        np.testing.assert_allclose(mt, (base * n + rsum).T)
+        np.testing.assert_allclose(ms, (base * n + rsum)[:, ::2])
+        assert float(z) == 2.0 * n
+        np.testing.assert_allclose(br, (bigb * n + rsum)[:, ::2])
+        np.testing.assert_allclose(bm, bigb + (n - 1))
+
+
+def test_allreduce_out_buffer_and_in_place():
+    """out= writes the reduction into a caller buffer (no fresh
+    allocation); out=arr reduces truly in place; mismatched out
+    raises."""
+    n = 3
+
+    def fn(c):
+        a = np.arange(100, dtype=np.float64) + c.rank
+        res = np.empty_like(a)
+        got = c.allreduce_sum(a, out=res)
+        assert got.base is res or got is res  # reshape view of res
+        inp = c.allreduce_sum(a, out=a)
+        with pytest.raises(ValueError, match="out="):
+            c.allreduce_sum(a, out=np.empty(99, np.float64))
+        with pytest.raises(ValueError, match="out="):
+            c.allreduce_sum(a, out=np.empty(100, np.float32))
+        return res, a, inp
+
+    results = _run_workers(n, fn)
+    want = np.arange(100, dtype=np.float64) * n + n * (n - 1) / 2
+    for res, a, inp in results:
+        np.testing.assert_allclose(res, want)
+        np.testing.assert_allclose(a, want)   # in-place mutated
+        np.testing.assert_allclose(inp, want)
+
+
+def test_allreduce_out_with_2d_input():
+    n = 2
+
+    def fn(c):
+        a = np.arange(24, dtype=np.float64).reshape(4, 6) + c.rank
+        out = np.empty((4, 6), np.float64)
+        got = c.allreduce_sum(a, out=out)
+        return got, out
+
+    for got, out in _run_workers(n, fn):
+        want = np.arange(24, dtype=np.float64).reshape(4, 6) * n + 1
+        assert got.shape == (4, 6)
+        np.testing.assert_allclose(got, want)
+        np.testing.assert_allclose(out, want)
