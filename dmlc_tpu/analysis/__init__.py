@@ -1,0 +1,49 @@
+"""dmlc-check: the repo-invariant static-analysis suite.
+
+The reference gated CI on pylint/cpplint (.travis.yml) — a style gate.
+This package generalizes the ``scripts/lint.py`` metric-name-contract
+idea into a framework of AST passes that enforce *repo invariants*,
+each of which has eaten a real review round in this repo's history:
+
+  * :mod:`style_pass`        the absorbed lint.py checks (unused
+                             imports, bare except, mutable defaults,
+                             whitespace, line length)
+  * :mod:`metrics_pass`      the absorbed metric-name contract
+                             (every emittable ``dmlc_*`` family is
+                             registered in telemetry/metric_names.py)
+  * :mod:`concurrency_pass`  blocking calls while holding a lock, the
+                             static lock-acquisition graph (cycles =
+                             potential deadlock pairs), non-daemon
+                             threads nobody joins
+  * :mod:`knob_pass`         every ``DMLC_*`` env read resolves against
+                             config_registry.py; raw ``os.environ``
+                             reads of DMLC keys must go through
+                             base.get_env; PASS_ENVS and the README
+                             knob table are complete
+  * :mod:`contract_pass`     except clauses that could swallow the
+                             typed exceptions that MUST propagate
+                             (WorldResized/CorruptRecord/
+                             EngineDraining), sockets without timeouts,
+                             fault_point site names vs DMLC_FAULT_SPEC
+                             literals
+
+Run via ``scripts/dmlc_check.py`` (a ci.sh stage).  Suppress a finding
+with an inline ``# dmlc-check: disable=<check-id>[,<check-id>...]``
+comment on the offending line (or the line above); suppressions are
+counted in the runner summary so they stay visible.
+"""
+
+from .core import Finding, FileContext, RepoIndex, Pass, run_passes
+from . import (concurrency_pass, contract_pass, knob_pass, metrics_pass,
+               style_pass)
+
+ALL_PASSES = (
+    style_pass.StylePass,
+    metrics_pass.MetricsPass,
+    concurrency_pass.ConcurrencyPass,
+    knob_pass.KnobPass,
+    contract_pass.ContractPass,
+)
+
+__all__ = ["ALL_PASSES", "Finding", "FileContext", "RepoIndex", "Pass",
+           "run_passes"]

@@ -99,17 +99,25 @@ def check_notnone(x: Any, msg: str = "") -> Any:
 _T = TypeVar("_T")
 
 _BOOL_TRUE = {"1", "true", "yes", "on"}
-_BOOL_FALSE = {"0", "false", "no", "off", ""}
+_BOOL_FALSE = {"0", "false", "no", "off"}
 
 
 def get_env(key: str, default: _T, ty: Optional[Type[_T]] = None) -> _T:
     """Typed environment lookup (analog of ``dmlc::GetEnv<T>``,
     parameter.h:1026-1036). The type is inferred from ``default`` unless
-    ``ty`` is given explicitly."""
+    ``ty`` is given explicitly.
+
+    An EMPTY value counts as unset for every non-str type: a wrapper
+    script's ``export <knob>=`` (which the ssh launcher forwards,
+    since the var IS in os.environ) means "not configured", not
+    "crash every worker parsing '' as int" — and not bool False
+    either, so the rule is one rule."""
     val = os.environ.get(key)
     if val is None:
         return default
     ty = ty or type(default)
+    if val == "" and ty is not str:
+        return default
     if ty is bool:
         low = val.strip().lower()
         if low in _BOOL_TRUE:

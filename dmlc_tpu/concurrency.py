@@ -24,12 +24,242 @@ import time
 from collections import deque
 from typing import Callable, Generic, Iterator, List, Optional, Tuple, TypeVar
 
-from .base import DMLCError
+from .base import DMLCError, get_env
 
-__all__ = ["BufferPool", "ConcurrentBlockingQueue", "ThreadedIter",
-           "MultiThreadedIter"]
+__all__ = ["BufferPool", "CheckedLock", "ConcurrentBlockingQueue",
+           "MultiThreadedIter", "ThreadedIter", "lockcheck_assert_clean",
+           "lockcheck_enabled", "lockcheck_report", "lockcheck_reset",
+           "make_lock", "make_rlock"]
 
 T = TypeVar("T")
+
+
+# ---------------------------------------------------------------------------
+# runtime lock-order watchdog (DMLC_LOCKCHECK=1)
+# ---------------------------------------------------------------------------
+# The static concurrency pass (dmlc_tpu/analysis/concurrency_pass.py)
+# proves what it can from the AST; acquisition ORDERS it cannot.  Under
+# DMLC_LOCKCHECK=1 every lock built through make_lock()/make_rlock()
+# is wrapped in a CheckedLock that maintains a per-thread held stack
+# and a process-wide dynamic lock-order graph:
+#
+#   * order inversion — acquiring B while holding A after ANY thread
+#     ever acquired A while holding B.  The classic deadlock pair,
+#     flagged even when the two runs never actually interleave (which
+#     is exactly the case a stress test gets "lucky" on).
+#   * held-while-blocked — an acquire that stalled longer than
+#     DMLC_LOCKCHECK_BLOCK_S (default 1 s) while the thread holds
+#     another lock: some lock holder is doing blocking work.
+#
+# Violations are logged and collected (bounded, deduplicated);
+# lockcheck_report() returns them and lockcheck_assert_clean() raises.
+# Off (the default) make_lock returns a plain threading.Lock — zero
+# overhead, byte-identical behavior.
+
+_lc_graph_lock = threading.Lock()
+_lc_edges: dict = {}        # (held_name, acquired_name) -> witness str
+_lc_violations: List[dict] = []
+_LC_MAX_VIOLATIONS = 256
+_lc_tls = threading.local()
+
+
+def lockcheck_enabled() -> bool:
+    """Whether make_lock() instruments (``DMLC_LOCKCHECK``, read per
+    lock construction so tests can flip it)."""
+    return get_env("DMLC_LOCKCHECK", False)
+
+
+def _lc_held() -> list:
+    held = getattr(_lc_tls, "held", None)
+    if held is None:
+        held = _lc_tls.held = []
+    return held
+
+
+def _lc_site() -> str:
+    """The USER frame that acquired the lock: walk up past this module
+    and threading.py (a Condition ``with``/wait adds interpreter
+    frames, so any fixed depth reports threading internals)."""
+    import sys
+
+    try:
+        depth = 1
+        while True:
+            f = sys._getframe(depth)
+            fn = f.f_code.co_filename
+            base = fn.rsplit("/", 1)[-1]
+            if base not in ("threading.py", "concurrency.py"):
+                return f"{base}:{f.f_lineno}"
+            depth += 1
+    except (ValueError, AttributeError):
+        return "?"
+
+
+def _lc_record(kind: str, detail: str, **ctx) -> None:
+    with _lc_graph_lock:
+        for v in _lc_violations:
+            if v["kind"] == kind and v["detail"] == detail:
+                return  # deduplicate repeat offenders
+        if len(_lc_violations) >= _LC_MAX_VIOLATIONS:
+            return
+        _lc_violations.append({"kind": kind, "detail": detail, **ctx})
+    import logging
+
+    logging.getLogger("dmlc_tpu.concurrency").error(
+        "lockcheck %s: %s", kind, detail)
+
+
+class CheckedLock:
+    """Instrumented lock for the DMLC_LOCKCHECK watchdog.  Context
+    manager + acquire/release, so it drops in for ``threading.Lock``
+    (and, with ``reentrant=True``, ``threading.RLock``) everywhere in
+    this codebase, including as the lock behind a
+    ``threading.Condition`` (whose wait() releases and re-acquires
+    through these methods, keeping the held stack truthful)."""
+
+    __slots__ = ("name", "graph_name", "_lock", "_reentrant", "_block_s")
+
+    #: instance counter: edges are recorded per INSTANCE (``name#n``),
+    #: not per class-level name — two queues of the same class acquired
+    #: q1→q2 on one thread and q2→q1 on another are a real ABBA pair
+    #: that identical names would collapse into an invisible self-edge
+    _counter = [0]
+
+    def __init__(self, name: str, reentrant: bool = False):
+        self.name = name
+        with _lc_graph_lock:
+            self._counter[0] += 1
+            self.graph_name = f"{name}#{self._counter[0]}"
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+        self._reentrant = reentrant
+        self._block_s = get_env("DMLC_LOCKCHECK_BLOCK_S", 1.0)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        held = _lc_held()
+        t0 = time.monotonic()
+        got = self._lock.acquire(blocking, timeout)
+        if not got:
+            return False
+        waited = time.monotonic() - t0
+        reacquire = self._reentrant and any(l is self for l in held)
+        outer = [l for l in held if l is not self]
+        if outer and not reacquire:
+            site = _lc_site()
+            if waited > self._block_s:
+                _lc_record(
+                    "held-while-blocked",
+                    f"acquire of {self.graph_name} blocked "
+                    f"{waited:.2f}s at {site} while holding "
+                    f"{[l.graph_name for l in outer]}",
+                    lock=self.name, waited_s=waited, site=site)
+            a, b = outer[-1].graph_name, self.graph_name
+            if a != b:
+                with _lc_graph_lock:
+                    _lc_edges.setdefault((a, b), site)
+                    inverse = _lc_edges.get((b, a))
+                if inverse is not None:
+                    _lc_record(
+                        "order-inversion",
+                        f"{b} -> {a} (at {inverse}) but also "
+                        f"{a} -> {b} (at {site}) — potential "
+                        f"deadlock pair",
+                        locks=sorted((a, b)), site=site)
+        held.append(self)
+        return True
+
+    def release(self) -> None:
+        held = _lc_held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is self:
+                del held[i]
+                break
+        self._lock.release()
+
+    def __enter__(self) -> "CheckedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked() if not self._reentrant \
+            else self._lock._is_owned()  # type: ignore[attr-defined]
+
+    # -- threading.Condition integration --------------------------------
+    # Condition(lock) prefers these over plain acquire/release; without
+    # _is_owned a reentrant lock would fail Condition's ownership probe
+    # (its fallback treats a successful try-acquire as "not owned",
+    # which is wrong for an RLock the CALLER already holds).  All three
+    # keep the held stack truthful across wait()'s release/reacquire.
+    def _is_owned(self) -> bool:
+        if self._reentrant:
+            return self._lock._is_owned()  # type: ignore[attr-defined]
+        return any(l is self for l in _lc_held())
+
+    def _release_save(self):
+        held = _lc_held()
+        count = sum(1 for l in held if l is self)
+        held[:] = [l for l in held if l is not self]
+        if self._reentrant:
+            state = self._lock._release_save()  # type: ignore[attr-defined]
+        else:
+            self._lock.release()
+            state = None
+        return count, state
+
+    def _acquire_restore(self, saved) -> None:
+        count, state = saved
+        if self._reentrant:
+            self._lock._acquire_restore(state)  # type: ignore[attr-defined]
+        else:
+            self._lock.acquire()
+        _lc_held().extend([self] * count)
+
+    def __repr__(self) -> str:
+        return f"CheckedLock({self.name!r})"
+
+
+def make_lock(name: str):
+    """A ``threading.Lock`` — or, under ``DMLC_LOCKCHECK=1``, a
+    :class:`CheckedLock` feeding the runtime lock-order watchdog.
+    ``name`` identifies the lock in the order graph and in violation
+    reports; by convention ``Class.attr`` or ``module.attr`` (matching
+    the static pass's node naming)."""
+    if lockcheck_enabled():
+        return CheckedLock(name)
+    return threading.Lock()
+
+
+def make_rlock(name: str):
+    """Reentrant variant of :func:`make_lock`."""
+    if lockcheck_enabled():
+        return CheckedLock(name, reentrant=True)
+    return threading.RLock()
+
+
+def lockcheck_report() -> List[dict]:
+    """Violations recorded so far (deduplicated, bounded)."""
+    with _lc_graph_lock:
+        return [dict(v) for v in _lc_violations]
+
+
+def lockcheck_reset() -> None:
+    """Clear the order graph and violation list (tests)."""
+    with _lc_graph_lock:
+        _lc_edges.clear()
+        del _lc_violations[:]
+
+
+def lockcheck_assert_clean() -> None:
+    """Raise :class:`DMLCError` when the watchdog saw violations — the
+    smoke-test exit gate."""
+    bad = lockcheck_report()
+    if bad:
+        lines = "; ".join(f"{v['kind']}: {v['detail']}" for v in bad[:8])
+        raise DMLCError(
+            f"lock-order watchdog recorded {len(bad)} violation(s): "
+            f"{lines}")
 
 
 class BufferPool(Generic[T]):
@@ -61,7 +291,7 @@ class BufferPool(Generic[T]):
         self._capacity = max(1, capacity)
         self._free: List[T] = []
         self._created = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("BufferPool._lock")
         self._avail = threading.Condition(self._lock)
         self._killed = False
 
@@ -137,7 +367,7 @@ class ConcurrentBlockingQueue(Generic[T]):
         self._fifo: deque = deque()
         self._heap: List[Tuple[int, int, T]] = []
         self._seq = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("ConcurrentBlockingQueue._lock")
         self._not_empty = threading.Condition(self._lock)
         self._not_full = threading.Condition(self._lock)
         self._killed = False
@@ -216,7 +446,7 @@ class ThreadedIter(Generic[T]):
         self._next_fn = next_fn
         self._before_first_fn = before_first_fn
         self._cap = max(1, max_capacity)
-        self._lock = threading.Lock()
+        self._lock = make_lock("ThreadedIter._lock")
         self._cv_consumer = threading.Condition(self._lock)
         self._cv_producer = threading.Condition(self._lock)
         self._queue: deque = deque()          # filled items awaiting consumption
@@ -356,7 +586,7 @@ class MultiThreadedIter(Generic[T]):
         self._work = work_fn
         self._n = num_threads
         self._out: ConcurrentBlockingQueue = ConcurrentBlockingQueue(max_capacity)
-        self._src_lock = threading.Lock()
+        self._src_lock = make_lock("MultiThreadedIter._src_lock")
         self._sentinels_seen = 0
         self._ended = False
         self._worker_exc: Optional[BaseException] = None

@@ -34,9 +34,11 @@ def effective_nthread(requested: Optional[int]) -> int:
     the cores); DMLC_TPU_PARSE_NTHREAD overrides, requested caps."""
     import os
 
-    env = os.environ.get("DMLC_TPU_PARSE_NTHREAD")
+    from ..base import get_env
+
+    env = get_env("DMLC_TPU_PARSE_NTHREAD", 0)
     if env:
-        return max(1, int(env))
+        return max(1, env)
     cap = max(1, (os.cpu_count() or 2) // 2)
     if requested is None:
         return min(4, cap)

@@ -33,13 +33,13 @@ import functools
 import os
 import threading
 import time
-from queue import Queue
+from queue import Empty, Queue
 from typing import Dict, Iterator, List, Optional
 
 import numpy as np
 
 from ..base import check, get_env
-from ..concurrency import BufferPool
+from ..concurrency import BufferPool, make_rlock
 from ..parallel.mesh import AXIS_DP, AXIS_SP, addressable_shards, \
     mesh_config
 
@@ -214,7 +214,7 @@ class DeviceFeed:
         self._template: Optional[Dict[str, np.ndarray]] = None
         self._pool: Optional[BufferPool] = None
         self._pending: Dict[int, _Slot] = {}
-        self._cv = threading.Condition()
+        self._cv = threading.Condition(make_rlock("DeviceFeed._cv"))
         self._error: Optional[BaseException] = None
         self._empty_epoch = False
         self._thread: Optional[threading.Thread] = None  # placer
@@ -592,8 +592,8 @@ class DeviceFeed:
             while not self._queue.empty():
                 try:
                     self._queue.get_nowait()
-                except Exception:
-                    break
+                except Empty:
+                    break  # racing consumer drained it first
             for t in threads:
                 t.join(timeout=0.05)
         if not any(t.is_alive() for t in threads):

@@ -29,7 +29,7 @@ import xml.etree.ElementTree as ET
 from email.utils import formatdate
 from typing import List, Optional
 
-from ..base import DMLCError, check
+from ..base import DMLCError, check, get_env
 from .filesys import FileInfo, FileSystem
 from .http_filesys import HttpReadStream
 from .rest import rest_request
@@ -49,7 +49,7 @@ def _account() -> str:
 
 
 def _endpoint() -> str:
-    env = os.environ.get("DMLC_AZURE_ENDPOINT")
+    env = get_env("DMLC_AZURE_ENDPOINT", "")
     if env:
         return env if "://" in env else f"http://{env}"
     return f"https://{_account()}.blob.core.windows.net"
@@ -159,7 +159,7 @@ class AzureWriteStream(Stream):
     no-partial-object property."""
 
     def __init__(self, url: str):
-        mb = int(os.environ.get("DMLC_AZURE_BLOCK_MB", "64"))
+        mb = get_env("DMLC_AZURE_BLOCK_MB", 64)
         self._block = max(mb << 20, 1 << 20)
         self._url = url
         self._buf = bytearray()

@@ -24,7 +24,7 @@ import urllib.parse
 import urllib.request
 from typing import Callable, List, Optional
 
-from ..base import DMLCError, check
+from ..base import DMLCError, check, get_env
 from ..resilience import RetryPolicy, fault_point
 from ..resilience.retry import TRANSIENT_HTTP
 from .filesys import FileInfo, FileSystem
@@ -127,7 +127,7 @@ class GCSWriteStream(Stream):
     """
 
     def __init__(self, bucket: str, obj: str):
-        mb = int(os.environ.get("DMLC_GCS_WRITE_BUFFER_MB", "64"))
+        mb = get_env("DMLC_GCS_WRITE_BUFFER_MB", 64)
         # resumable chunks must be 256 KiB multiples (API contract)
         self._chunk = max(mb << 20, 256 << 10)
         self._buf = bytearray()

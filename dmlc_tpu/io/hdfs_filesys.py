@@ -38,13 +38,13 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import urllib.error
 import urllib.parse
 import urllib.request
 from typing import List, Optional
 
-from ..base import DMLCError, check
+from ..base import DMLCError, check, get_env
+from ..concurrency import make_lock
 from ..resilience import RetryPolicy, fault_point
 from .filesys import FileInfo, FileSystem
 from .http_filesys import HttpReadStream
@@ -57,18 +57,18 @@ _DEFAULT_HTTP_PORT = "9870"  # Hadoop 3 namenode HTTP; 2.x used 50070
 
 
 def _endpoint(uri: URI) -> str:
-    env = os.environ.get("DMLC_WEBHDFS_ENDPOINT")
+    env = get_env("DMLC_WEBHDFS_ENDPOINT", "")
     if env:
         return env if "://" in env else f"http://{env}"
     host = uri.host.split(":", 1)[0]  # URI port = RPC port, not HTTP
     check(bool(host), "hdfs:// URI has no namenode host and "
                       "DMLC_WEBHDFS_ENDPOINT is unset")
-    port = os.environ.get("DMLC_WEBHDFS_PORT", _DEFAULT_HTTP_PORT)
+    port = get_env("DMLC_WEBHDFS_PORT", _DEFAULT_HTTP_PORT)
     return f"http://{host}:{port}"
 
 
 def _user_params() -> dict:
-    user = os.environ.get("DMLC_HDFS_USER") or os.environ.get("USER")
+    user = get_env("DMLC_HDFS_USER", "") or os.environ.get("USER")
     return {"user.name": user} if user else {}
 
 
@@ -216,7 +216,7 @@ class WebHdfsWriteStream(Stream):
     old-version-lost window of a DELETE-then-RENAME."""
 
     def __init__(self, base: str, path: str):
-        mb = int(os.environ.get("DMLC_HDFS_WRITE_BUFFER_MB", "64"))
+        mb = get_env("DMLC_HDFS_WRITE_BUFFER_MB", 64)
         self._chunk = max(mb << 20, 1 << 20)
         self._base = base
         self._path = path
@@ -320,7 +320,7 @@ class WebHdfsWriteStream(Stream):
         return bool(json.loads(resp.read()).get("boolean"))
 
 
-_nonce_lock = threading.Lock()
+_nonce_lock = make_lock("hdfs_filesys._nonce_lock")
 _nonce = [0]
 
 

@@ -35,7 +35,7 @@ import struct
 from bisect import bisect_right
 from typing import List, Optional, Tuple
 
-from ..base import DMLCError, check
+from ..base import DMLCError, check, get_env
 from .. import native
 from .filesys import FileInfo, FileSystem, UnsupportedListing
 from .recordio import HEAD_CFLAGS, KMAGIC, decode_flag, decode_length
@@ -157,7 +157,7 @@ class InputSplitBase(InputSplit):
         # copy path below).  DMLC_TPU_DISABLE_MMAP=1 forces the copy path.
         self._local_paths = [filesys.local_path(f.path) for f in self._files]
         self._mmap_ok = (
-            not os.environ.get("DMLC_TPU_DISABLE_MMAP")
+            not get_env("DMLC_TPU_DISABLE_MMAP", False)
             and all(p is not None for p in self._local_paths)
         )
         self._maps: List[Optional[mmap.mmap]] = [None] * len(self._files)

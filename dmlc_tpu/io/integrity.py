@@ -37,11 +37,10 @@ Every event lands in the ``dmlc_integrity_*`` metric family
 
 from __future__ import annotations
 
-import os
-import threading
 from typing import Dict, List, Optional, Tuple
 
-from ..base import DMLCError
+from ..base import DMLCError, get_env
+from ..concurrency import make_lock
 
 __all__ = [
     "CorruptRecord",
@@ -112,7 +111,7 @@ def crc32c(data, value: int = 0) -> int:
 def policy() -> str:
     """The active corruption policy (re-read per call: tests and the
     self-heal rollback flip it at runtime)."""
-    p = os.environ.get(ENV_POLICY, "raise").strip().lower() or "raise"
+    p = get_env(ENV_POLICY, "raise").strip().lower() or "raise"
     if p not in _POLICIES:
         raise DMLCError(
             f"bad {ENV_POLICY}={p!r} (choose from {_POLICIES})")
@@ -123,7 +122,7 @@ def policy() -> str:
 # quarantine skip-list
 # ---------------------------------------------------------------------------
 
-_lock = threading.Lock()
+_lock = make_lock("integrity._lock")
 # source -> {begin_offset: end_offset}; begins are the deterministic
 # record-head offsets the byte-range partition contract reproduces, so
 # a replay recognizes the same poison in any world size

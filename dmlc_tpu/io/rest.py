@@ -17,12 +17,11 @@ stage).
 
 from __future__ import annotations
 
-import os
 import urllib.error
 import urllib.request
 from typing import Callable, Optional
 
-from ..base import DMLCError, check
+from ..base import DMLCError, check, get_env
 from ..resilience import RetryPolicy, fault_point
 from ..resilience.retry import TRANSIENT_HTTP  # noqa: F401  (re-export)
 
@@ -47,7 +46,7 @@ def rest_request(service: str, url: str, method: str = "GET",
     """
     policy = RetryPolicy.from_env(retries_env=retries_env,
                                   name=service.lower())
-    timeout = float(os.environ.get("DMLC_REST_TIMEOUT_S", "60"))
+    timeout = get_env("DMLC_REST_TIMEOUT_S", 60.0)
     short_url = url.split("?")[0]
     site = f"{service.lower()}.request"
 

@@ -37,7 +37,7 @@ import urllib.parse
 import xml.etree.ElementTree as ET
 from typing import List, Optional, Tuple
 
-from ..base import DMLCError, check
+from ..base import DMLCError, check, get_env
 from .filesys import FileInfo, FileSystem
 from .http_filesys import HttpReadStream
 from .rest import rest_request
@@ -57,7 +57,7 @@ def _region() -> str:
 def _endpoint_for(bucket: str) -> Tuple[str, str]:
     """(base URL, path prefix) for a bucket: custom endpoints use
     path-style addressing, AWS uses virtual-host style."""
-    env = os.environ.get("DMLC_S3_ENDPOINT")
+    env = get_env("DMLC_S3_ENDPOINT", "")
     if env:
         base = env if "://" in env else f"http://{env}"
         return base, f"/{bucket}"
@@ -173,7 +173,7 @@ class S3WriteStream(Stream):
     the upload is aborted so no orphan parts linger."""
 
     def __init__(self, url: str):
-        mb = int(os.environ.get("DMLC_S3_WRITE_BUFFER_MB", "64"))
+        mb = get_env("DMLC_S3_WRITE_BUFFER_MB", 64)
         self._part = max(mb << 20, 5 << 20)
         self._url = url
         self._buf = bytearray()

@@ -16,18 +16,18 @@ output stays attributable:
 
 from __future__ import annotations
 
-import os
 import sys
 import threading
 import time
 from typing import Callable, Optional
 
-from .base import DMLCError
+from .base import DMLCError, get_env
+from .concurrency import make_lock
 
 __all__ = ["log", "info", "warning", "error", "fatal", "set_log_sink", "set_verbosity"]
 
 _LEVELS = {"DEBUG": 0, "INFO": 1, "WARNING": 2, "ERROR": 3, "FATAL": 4}
-_lock = threading.Lock()
+_lock = make_lock("logging._lock")
 _sink: Optional[Callable[[str], None]] = None
 _verbosity = 1  # default: INFO and above
 _rank_prefix: Optional[str] = None  # lazy: env read once at first format
@@ -50,8 +50,8 @@ def _get_rank_prefix() -> str:
     is fixed at launch, and the hot path must not hit os.environ per line."""
     global _rank_prefix
     if _rank_prefix is None:
-        rank = os.environ.get("DMLC_TASK_ID") or os.environ.get("DMLC_RANK")
-        _rank_prefix = f"r{rank} " if rank not in (None, "", "NULL") else ""
+        rank = get_env("DMLC_TASK_ID", "") or get_env("DMLC_RANK", "")
+        _rank_prefix = f"r{rank} " if rank not in ("", "NULL") else ""
     return _rank_prefix
 
 

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .base import check
+from .concurrency import make_lock
 
 __all__ = ["MemoryPool", "BufferPool", "ThreadLocalPool"]
 
@@ -49,7 +50,7 @@ class MemoryPool:
         self._max_free = int(max_free)
         self._free: List[np.ndarray] = []    # returned via free()
         self._fresh: List[np.ndarray] = []   # carved, never handed out
-        self._lock = threading.Lock()
+        self._lock = make_lock("MemoryPool._lock")
         self.allocated = 0   # total pieces handed out over the lifetime
         self.recycled = 0    # pieces that went through free() and back
 
@@ -89,7 +90,7 @@ class BufferPool:
 
     def __init__(self, *, max_bytes: int = 256 << 20):
         self._classes: Dict[int, List[np.ndarray]] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("BufferPool._lock")
         self._max_bytes = int(max_bytes)
         self._held = 0
         self.hits = 0

@@ -615,10 +615,9 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
     import optax
 
     if overlap == "auto":
-        import os
+        from ..base import get_env
 
-        overlap = "device" if os.environ.get(
-            "DMLC_COLL_OVERLAP", "0").strip() not in ("0", "", "false") \
+        overlap = "device" if get_env("DMLC_COLL_OVERLAP", False) \
             else None
     if overlap not in (None, "device"):
         raise ValueError(f"unknown overlap mode {overlap!r} "

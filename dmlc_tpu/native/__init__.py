@@ -22,13 +22,16 @@ from typing import Optional
 
 import numpy as np
 
+from ..base import get_env
+from ..concurrency import make_lock
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(os.path.dirname(_HERE), "cpp", "dmlc_native.cc")
 _SO = os.path.join(_HERE, "libdmlc_native.so")
 _ABI = 5
 
 _lib = None
-_lib_lock = threading.Lock()
+_lib_lock = make_lock("native._lib_lock")
 _tried = False
 
 
@@ -74,7 +77,7 @@ def _load():
         if _tried:
             return _lib
         _tried = True
-        if os.environ.get("DMLC_TPU_DISABLE_NATIVE"):
+        if get_env("DMLC_TPU_DISABLE_NATIVE", False):
             return None
         so = _build()
         if so is None:

@@ -17,17 +17,17 @@ from __future__ import annotations
 
 import ctypes
 import os
-import threading
 from typing import Optional
 
 import numpy as np
+from ..concurrency import make_lock
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(os.path.dirname(_HERE), "cpp", "dmlc_collective.cc")
 _SO = os.path.join(_HERE, "libdmlc_collective.so")
 
 _lib = None
-_lib_lock = threading.Lock()
+_lib_lock = make_lock("shm_collective._lib_lock")
 _tried = False
 
 #: numpy dtype -> dmlc_collective.h dtype code (DMLC_F32..DMLC_I64)
@@ -56,7 +56,9 @@ def _load():
         if _tried:
             return _lib
         _tried = True
-        if os.environ.get("DMLC_TPU_DISABLE_NATIVE"):
+        from ..base import get_env
+
+        if get_env("DMLC_TPU_DISABLE_NATIVE", False):
             return None
         so = _build()
         if so is None:

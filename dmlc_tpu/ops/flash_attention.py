@@ -34,6 +34,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..base import get_env
+
 _NEG_BIG = -1e30
 _POS_BIG = 1e30
 
@@ -241,9 +243,7 @@ def _flash_forward(static, q, k, v, qoff, kvoff):
     # f32 [G,bq,bk] softmax intermediates hit the 16 MB scoped-VMEM
     # cap) and every (G>1, smaller-block) point lost to (G=1, 1024²)
     # on the flagship step — 52.4-53.2% vs 53.7% MFU at T=1024.
-    import os as _os
-
-    gmax = int(_os.environ.get("DMLC_FLASH_BH_BLOCK", 0)) or 1
+    gmax = get_env("DMLC_FLASH_BH_BLOCK", 0) or 1
     g = 1
     while g * 2 <= gmax and bh % (g * 2) == 0:  # never exceed the cap
         g *= 2
@@ -579,7 +579,6 @@ def flash_attention(q, k, v, *, causal: bool = True,
     pipeline).  DMLC_FLASH_BLOCK_Q/K and DMLC_FLASH_BWD_BLOCK_Q/K
     override for sweeps (read at trace time).
     """
-    import os
 
     from .. import telemetry
 
@@ -605,13 +604,13 @@ def flash_attention(q, k, v, *, causal: bool = True,
     # must not get surprise-larger backward tiles); env/defaults fill
     # whatever remains
     bwd_q = block_q if block_q is not None \
-        else int(os.environ.get("DMLC_FLASH_BWD_BLOCK_Q", 0)) or 1024
+        else get_env("DMLC_FLASH_BWD_BLOCK_Q", 0) or 1024
     bwd_k = block_k if block_k is not None \
-        else int(os.environ.get("DMLC_FLASH_BWD_BLOCK_K", 0)) or 1024
+        else get_env("DMLC_FLASH_BWD_BLOCK_K", 0) or 1024
     if block_q is None:
-        block_q = int(os.environ.get("DMLC_FLASH_BLOCK_Q", 0)) or 1024
+        block_q = get_env("DMLC_FLASH_BLOCK_Q", 0) or 1024
     if block_k is None:
-        block_k = int(os.environ.get("DMLC_FLASH_BLOCK_K", 0)) or 1024
+        block_k = get_env("DMLC_FLASH_BLOCK_K", 0) or 1024
     static = (float(scale), bool(causal), int(block_q), int(block_k),
               bool(interpret), int(bwd_q), int(bwd_k))
     return _flash_attn(static, q, k, v)

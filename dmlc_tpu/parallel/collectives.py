@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..base import get_env
 from .. import telemetry
 
 AxisName = Union[str, Sequence[str]]
@@ -170,10 +171,8 @@ def process_rank_world() -> tuple:
     jobs launched by dmlc-submit agree with jax.distributed; falls back to
     the JAX runtime's own notion.
     """
-    import os
-
-    task_id = os.environ.get("DMLC_TASK_ID")
-    nworker = os.environ.get("DMLC_NUM_WORKER")
+    task_id = get_env("DMLC_TASK_ID", None, str)
+    nworker = get_env("DMLC_NUM_WORKER", None, str)
     if task_id is not None and nworker is not None:
         return int(task_id), int(nworker)
     return jax.process_index(), jax.process_count()
@@ -190,21 +189,19 @@ def initialize_distributed(coordinator: Optional[str] = None) -> None:
     process_rank_world() (DMLC_TASK_ID / DMLC_NUM_WORKER).  No-op when
     single-process or when jax.distributed is already up.
     """
-    import os
-
     rank, world = process_rank_world()
     if world <= 1:
         return
     if jax.distributed.is_initialized():
         return
     if coordinator is None:
-        uri = (os.environ.get("DMLC_JAX_COORD_URI")
-               or os.environ.get("DMLC_TRACKER_URI", "127.0.0.1"))
+        uri = (get_env("DMLC_JAX_COORD_URI", "")
+               or get_env("DMLC_TRACKER_URI", "127.0.0.1"))
         # no tracker-port fallback on purpose (see docstring), and no
         # made-up default either: tracker_host:<guess> can never be right
         # on multi-host jobs, so dialing it would trade a clear error for
         # a multi-minute gRPC hang
-        port = os.environ.get("DMLC_JAX_COORD_PORT")
+        port = get_env("DMLC_JAX_COORD_PORT", None, str)
         if port is None:
             raise RuntimeError(
                 "DMLC_JAX_COORD_PORT is not set — this process was not "

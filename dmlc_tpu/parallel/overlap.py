@@ -34,13 +34,13 @@ immediately reusable after ``TrackerClient.resize()``.
 
 from __future__ import annotations
 
-import os
 import queue
 import threading
 import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
+from ..concurrency import make_lock
 
 __all__ = [
     "CollectiveFuture",
@@ -56,7 +56,9 @@ def bucket_bytes() -> int:
     large enough that each bucket clears the ring/hier cutover
     (DMLC_COLL_RING_MIN_BYTES, 1 MB), small enough that several buckets
     are in flight per step)."""
-    mb = float(os.environ.get("DMLC_COLL_BUCKET_MB", "4"))
+    from ..base import get_env
+
+    mb = get_env("DMLC_COLL_BUCKET_MB", 4.0)
     return max(1, int(mb * (1 << 20)))
 
 
@@ -117,7 +119,7 @@ class _CollectiveThread:
         self._q: "queue.Queue" = queue.Queue()
         self._name = name
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("_CollectiveThread._lock")
 
     def submit(self, fn: Callable[[], object]) -> CollectiveFuture:
         with self._lock:
@@ -184,7 +186,7 @@ class GradientBucketer:
         self._worker = _CollectiveThread()
         self._failed: Optional[BaseException] = None
         self._timings: List[Tuple[int, float]] = []
-        self._tlock = threading.Lock()
+        self._tlock = make_lock("GradientBucketer._tlock")
 
     @property
     def bucket_elems(self) -> int:

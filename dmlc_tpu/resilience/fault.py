@@ -41,9 +41,9 @@ from __future__ import annotations
 
 import logging
 import os
-import threading
 import time
 from typing import Dict, List, Optional
+from ..concurrency import make_lock
 
 __all__ = [
     "FaultInjected",
@@ -126,11 +126,13 @@ class FaultInjector:
     def __init__(self, spec: str = ""):
         self.spec = spec
         self._rules = _parse(spec)
-        self._lock = threading.Lock()
+        self._lock = make_lock("FaultInjector._lock")
 
     @classmethod
     def from_env(cls) -> "FaultInjector":
-        return cls(os.environ.get(ENV_VAR, ""))
+        from ..base import get_env
+
+        return cls(get_env(ENV_VAR, ""))
 
     @property
     def enabled(self) -> bool:
@@ -192,7 +194,7 @@ class FaultInjector:
 # process-global injector (env-tracked)
 # ---------------------------------------------------------------------------
 
-_lock = threading.Lock()
+_lock = make_lock("fault._lock")
 _injector: Optional[FaultInjector] = None
 _pinned = False  # install_injector() wins over env tracking
 
@@ -203,7 +205,9 @@ def get_injector() -> FaultInjector:
     global _injector
     with _lock:
         if not _pinned:
-            spec = os.environ.get(ENV_VAR, "")
+            from ..base import get_env
+
+            spec = get_env(ENV_VAR, "")
             if _injector is None or _injector.spec != spec:
                 _injector = FaultInjector(spec)
         assert _injector is not None

@@ -25,7 +25,6 @@ uses only this module's backoff/classification pieces.
 
 from __future__ import annotations
 
-import os
 import random
 import time
 import urllib.error
@@ -63,8 +62,9 @@ def default_retryable(exc: BaseException) -> bool:
 def _env_float(name: Optional[str], default: float) -> float:
     if not name:
         return default
-    raw = os.environ.get(name)
-    return float(raw) if raw else default
+    from ..base import get_env
+
+    return get_env(name, float(default))
 
 
 class RetryPolicy:
@@ -109,12 +109,13 @@ class RetryPolicy:
           DMLC_RETRY_MAX_S       backoff ceiling (default 30)
           DMLC_RETRY_DEADLINE_S  overall deadline (default: none)
         """
-        attempts = int(os.environ.get(retries_env) or default_attempts)
+        from ..base import get_env
+
+        attempts = get_env(retries_env, int(default_attempts))
         base = _env_float(base_env, default_base)
         max_s = _env_float("DMLC_RETRY_MAX_S", kwargs.pop("max_s", 30.0))
-        deadline = os.environ.get("DMLC_RETRY_DEADLINE_S")
         kwargs.setdefault("deadline_s",
-                          float(deadline) if deadline else None)
+                          get_env("DMLC_RETRY_DEADLINE_S", None, float))
         return cls(attempts=attempts, base_s=base, max_s=max_s,
                    name=name, **kwargs)
 

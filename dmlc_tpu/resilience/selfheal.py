@@ -43,11 +43,11 @@ integrity smoke injects a poisoned step without touching model math.
 from __future__ import annotations
 
 import math
-import threading
 import time
 from typing import Dict, Optional
 
 from ..base import DMLCError, get_env
+from ..concurrency import make_lock
 
 __all__ = ["SelfHealGuard", "SelfHealAbort", "status", "reset_selfheal"]
 
@@ -59,7 +59,7 @@ ABORT = "abort"
 
 _EWMA_ALPHA = 0.1
 
-_status_lock = threading.Lock()
+_status_lock = make_lock("selfheal._status_lock")
 _status: Dict = {}
 
 

@@ -36,7 +36,8 @@ from ..base import DMLCError, get_env
 from ..concurrency import BufferPool
 from ..models import transformer as tfm
 from .kv_cache import PagedKVCache
-from .scheduler import ACTIVE, ContinuousBatchScheduler, Request
+from .scheduler import (ACTIVE, AlreadyFinished,
+                        ContinuousBatchScheduler, Request)
 
 __all__ = ["InferenceEngine", "AdmissionFull", "EngineDraining"]
 
@@ -159,7 +160,7 @@ class InferenceEngine:
             # so do it here rather than hang the waiter
             try:
                 self._finish(req, error="engine shut down")
-            except DMLCError:
+            except AlreadyFinished:
                 pass
             raise DMLCError("engine shut down")
         return req
@@ -256,7 +257,7 @@ class InferenceEngine:
         for req in self.scheduler.all_pending():
             try:
                 self._finish(req, error="engine shut down")
-            except DMLCError:
+            except AlreadyFinished:
                 pass  # racing terminal transition already happened
 
     def _loop(self) -> None:
@@ -272,7 +273,7 @@ class InferenceEngine:
                     try:
                         self._finish(
                             req, error=f"engine iteration failed: {e!r}")
-                    except DMLCError:
+                    except AlreadyFinished:
                         pass
                 logger.error("serving iteration failed: %r", e)
                 did = False

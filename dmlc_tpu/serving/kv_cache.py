@@ -31,13 +31,13 @@ contract as the training feed.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..base import DMLCError
 from .. import telemetry
+from ..concurrency import make_lock
 
 __all__ = ["BlockAllocator", "PagedKVCache", "kv_partition_spec"]
 
@@ -59,7 +59,7 @@ class BlockAllocator:
         # pop() from the tail → ascending ids first; order is cosmetic
         self._free: List[int] = list(range(self.n_blocks - 1, -1, -1))
         self._in_use: set = set()
-        self._lock = threading.Lock()
+        self._lock = make_lock("BlockAllocator._lock")
 
     @property
     def n_free(self) -> int:
@@ -154,7 +154,7 @@ class PagedKVCache:
         self.v_pool = np.zeros(shape, dtype)
         self._alloc = BlockAllocator(self.n_blocks)
         self._seqs: Dict[int, _SeqEntry] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("PagedKVCache._lock")
         telemetry.set_gauge("serving", "kv_blocks_total", self.n_blocks)
         self._publish_usage()
 

@@ -21,6 +21,7 @@ import time
 import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
+from ..concurrency import make_lock
 
 __all__ = ["LoadGenerator", "percentile"]
 
@@ -53,7 +54,7 @@ class LoadGenerator:
         self.results: List[Dict] = []
         self.failures: List[Dict] = []
         self.rejections = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("LoadGenerator._lock")
 
     # ---- one synthetic user --------------------------------------------
     def _post(self, doc: Dict) -> Dict:

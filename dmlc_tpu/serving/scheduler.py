@@ -34,9 +34,20 @@ from typing import Dict, List, Optional
 from .. import telemetry
 from ..base import DMLCError
 from .kv_cache import PagedKVCache
+from ..concurrency import make_lock
 
-__all__ = ["Request", "ContinuousBatchScheduler",
+__all__ = ["AlreadyFinished", "Request", "ContinuousBatchScheduler",
            "WAITING", "ACTIVE", "DONE", "FAILED"]
+
+class AlreadyFinished(DMLCError):
+    """Raised by :meth:`ContinuousBatchScheduler.finish` when the
+    request already reached a terminal state — the exactly-once
+    transition's race signal.  A dedicated type so sweep paths that
+    legitimately race a terminal transition (engine shutdown/crash
+    cleanup) can swallow exactly this and nothing broader: a generic
+    ``except DMLCError`` there would also eat cache double-free
+    errors or :class:`serving.engine.EngineDraining`."""
+
 
 WAITING = "waiting"
 ACTIVE = "active"
@@ -146,7 +157,7 @@ class ContinuousBatchScheduler:
         self.max_active = int(max_active)
         self._waiting: deque = deque()
         self._active: List[Request] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("ContinuousBatchScheduler._lock")
 
     # ---- queue views ----------------------------------------------------
     @property
@@ -235,7 +246,7 @@ class ContinuousBatchScheduler:
         request's cache blocks, mark DONE/FAILED, and wake waiters."""
         with self._lock:
             if req.state in (DONE, FAILED):
-                raise DMLCError(f"request {req.id} finished twice")
+                raise AlreadyFinished(f"request {req.id} finished twice")
             if req in self._active:
                 self._active.remove(req)
             elif req in self._waiting:

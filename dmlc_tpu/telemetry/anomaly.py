@@ -36,11 +36,12 @@ from __future__ import annotations
 import json
 import logging
 import math
-import os
-import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
+
+from ..base import get_env
+from ..concurrency import make_lock
 
 __all__ = ["Watchdog", "ANOMALY_KINDS"]
 
@@ -103,19 +104,16 @@ class Watchdog:
     def __init__(self, k: Optional[float] = None,
                  window: Optional[int] = None, log=logger):
         if k is None:
-            k = float(os.environ.get("DMLC_WATCHDOG_K", "4"))
+            k = get_env("DMLC_WATCHDOG_K", 4.0)
         if window is None:
-            window = int(os.environ.get("DMLC_WATCHDOG_WINDOW", "5"))
+            window = get_env("DMLC_WATCHDOG_WINDOW", 5)
         self.k = k
         self.window = max(1, window)
-        self.regression_frac = float(
-            os.environ.get("DMLC_WATCHDOG_REGRESSION", "0.5"))
-        self.feed_frac = float(
-            os.environ.get("DMLC_WATCHDOG_FEED_FRAC", "0.5"))
-        self.goodput_frac = float(
-            os.environ.get("DMLC_WATCHDOG_GOODPUT_FRAC", "0.5"))
+        self.regression_frac = get_env("DMLC_WATCHDOG_REGRESSION", 0.5)
+        self.feed_frac = get_env("DMLC_WATCHDOG_FEED_FRAC", 0.5)
+        self.goodput_frac = get_env("DMLC_WATCHDOG_GOODPUT_FRAC", 0.5)
         self._log = log
-        self._lock = threading.Lock()
+        self._lock = make_lock("Watchdog._lock")
         self._ranks: Dict[int, _RankState] = {}
         self._verdicts: deque = deque(maxlen=self.MAX_VERDICTS)
 

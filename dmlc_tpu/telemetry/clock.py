@@ -21,8 +21,8 @@ so a tight ping beats any amount of averaging over loose ones.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, Optional
+from ..concurrency import make_lock
 
 __all__ = ["ClockSample", "ClockOffsetEstimator", "offset_from_timestamps"]
 
@@ -60,7 +60,7 @@ class ClockOffsetEstimator:
 
     def __init__(self, window: int = 16):
         self.window = max(1, int(window))
-        self._lock = threading.Lock()
+        self._lock = make_lock("ClockOffsetEstimator._lock")
         self._samples: Dict[int, list] = {}   # rank -> recent ClockSamples
         self._best: Dict[int, ClockSample] = {}
 

@@ -27,12 +27,14 @@ from __future__ import annotations
 
 import contextlib
 import math
-import os
 import threading
 import time
 from bisect import bisect_left
 from collections import defaultdict, deque
 from typing import Dict, List, Optional
+
+from ..base import get_env
+from ..concurrency import make_lock
 
 __all__ = [
     "Histogram",
@@ -59,7 +61,7 @@ __all__ = [
 DEFAULT_BOUNDS = tuple(1e-6 * 2.0 ** i for i in range(28))
 
 # spans ring capacity; bounded so a week-long run cannot OOM the host
-_MAX_SPANS = int(os.environ.get("DMLC_TELEMETRY_MAX_SPANS", "8192"))
+_MAX_SPANS = get_env("DMLC_TELEMETRY_MAX_SPANS", 8192)
 
 
 class Histogram:
@@ -162,7 +164,7 @@ class Histogram:
 # process-global registry
 # ---------------------------------------------------------------------------
 
-_lock = threading.Lock()
+_lock = make_lock("telemetry_core._lock")
 _counters: Dict[str, Dict[str, float]] = defaultdict(lambda: defaultdict(float))
 _gauges: Dict[str, Dict[str, float]] = defaultdict(dict)
 _hists: Dict[str, Dict[str, Histogram]] = defaultdict(dict)

@@ -17,18 +17,19 @@ that is what counters/histograms/spans are for.
 from __future__ import annotations
 
 import json
-import os
-import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from ..base import get_env
+from ..concurrency import make_lock
+
 __all__ = ["record_event", "events", "events_tail", "to_jsonl",
            "reset_events"]
 
-_MAX_EVENTS = int(os.environ.get("DMLC_TELEMETRY_MAX_EVENTS", "2048"))
+_MAX_EVENTS = get_env("DMLC_TELEMETRY_MAX_EVENTS", 2048)
 
-_lock = threading.Lock()
+_lock = make_lock("events._lock")
 _events: deque = deque(maxlen=_MAX_EVENTS)
 _seq = 0
 

@@ -16,13 +16,13 @@ from __future__ import annotations
 
 import json
 import logging
-import os
-import threading
 from collections import deque
 from typing import Dict, List, Optional
 
+from ..base import get_env
 from . import core
 from .clock import ClockOffsetEstimator
+from ..concurrency import make_lock
 
 __all__ = ["FlightRecorder", "TRACKER_PID"]
 
@@ -53,14 +53,14 @@ class FlightRecorder:
     def __init__(self, max_spans_per_rank: Optional[int] = None,
                  local_spans=None, log=logger):
         if max_spans_per_rank is None:
-            max_spans_per_rank = int(
-                os.environ.get("DMLC_TRACE_MAX_SPANS_PER_RANK", "4096"))
+            max_spans_per_rank = get_env(
+                "DMLC_TRACE_MAX_SPANS_PER_RANK", 4096)
         self.max_spans_per_rank = max_spans_per_rank
         self.clock = ClockOffsetEstimator()
         self._local_spans = local_spans
         self.marker_source = None
         self._log = log
-        self._lock = threading.Lock()
+        self._lock = make_lock("FlightRecorder._lock")
         self._spans: Dict[int, deque] = {}
         self._anchor: Dict[int, float] = {}
         self._host: Dict[int, str] = {}

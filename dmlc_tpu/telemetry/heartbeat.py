@@ -20,14 +20,15 @@ from __future__ import annotations
 
 import json
 import logging
-import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
+from ..base import get_env
 from . import core, exporters
 from .core import Histogram
+from ..concurrency import make_lock
 
 __all__ = [
     "DEFAULT_STRAGGLER_KEYS",
@@ -143,7 +144,7 @@ class TelemetryAggregator:
         self._local_label = local_label
         self.extra_health = None
         self.extra_text = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("TelemetryAggregator._lock")
         self._ranks: Dict[int, Dict] = {}      # rank -> snapshot dict
         # rank -> last heartbeat, on time.monotonic(): heartbeat AGE is a
         # duration, and measuring it on the wall clock let any backward
@@ -485,11 +486,10 @@ class HeartbeatSender:
         self._client = client
         self.interval = float(interval)
         if ship_trace is None:
-            ship_trace = os.environ.get(
-                "DMLC_TELEMETRY_SHIP_TRACE", "1") != "0"
+            ship_trace = get_env("DMLC_TELEMETRY_SHIP_TRACE", True)
         self.ship_trace = bool(ship_trace)
-        self.max_beat_bytes = int(os.environ.get(
-            "DMLC_TELEMETRY_MAX_BEAT_BYTES", str(256 << 10)))
+        self.max_beat_bytes = get_env(
+            "DMLC_TELEMETRY_MAX_BEAT_BYTES", 256 << 10)
         self._last_seq = 0
         self._last_step_seq = 0
         self._clock: Optional[Tuple[float, float]] = None  # (offset, rtt)

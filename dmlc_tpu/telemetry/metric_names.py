@@ -198,4 +198,6 @@ NON_METRIC_TOKENS = frozenset({
     "dmlc_pack_spans",      # native ABI symbol
     "dmlc_comm_allreduce",  # native collective ABI symbol
     "dmlc_shm_coll",        # native shm-group ABI symbol prefix
+    "dmlc_check",           # scripts/dmlc_check.py static-analysis suite
+    "dmlc_crc32c",          # native ABI symbol (dmlc_native.cc)
 })

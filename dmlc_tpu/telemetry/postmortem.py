@@ -38,11 +38,12 @@ import json
 import os
 import signal
 import sys
-import threading
 import time
 from typing import Dict, List, Optional
 
+from ..base import get_env
 from . import core, events
+from ..concurrency import make_lock
 
 __all__ = ["ENV_DIR", "postmortem_dir", "dump", "install",
            "list_dumps", "set_rank", "uninstall"]
@@ -52,7 +53,7 @@ ENV_DIR = "DMLC_POSTMORTEM_DIR"
 # signals we can still run Python under; SIGKILL is unhookable by design
 DEFAULT_SIGNALS = ("SIGTERM", "SIGQUIT", "SIGABRT")
 
-_lock = threading.Lock()
+_lock = make_lock("postmortem._lock")
 _installed_dir: Optional[str] = None
 _faulthandler_file = None
 _prev_excepthook = None
@@ -74,21 +75,21 @@ def set_rank(rank) -> None:
 
 def postmortem_dir(directory: Optional[str] = None) -> Optional[str]:
     """Resolve the dump directory: explicit arg > installed dir > env."""
-    return directory or _installed_dir or os.environ.get(ENV_DIR) or None
+    return directory or _installed_dir or get_env(ENV_DIR, "") or None
 
 
 def _identity() -> Dict:
     if _rank is not None:
         rank: Optional[str] = str(_rank)
     else:
-        rank = os.environ.get("DMLC_TASK_ID") or os.environ.get("DMLC_RANK")
+        rank = get_env("DMLC_TASK_ID", "") or get_env("DMLC_RANK", "")
         if rank in ("", "NULL"):
             rank = None
     return {
         "pid": os.getpid(),
         "rank": rank,
-        "attempt": os.environ.get("DMLC_NUM_ATTEMPT"),
-        "role": os.environ.get("DMLC_ROLE"),
+        "attempt": get_env("DMLC_NUM_ATTEMPT", None, str),
+        "role": get_env("DMLC_ROLE", None, str),
         "argv": list(sys.argv),
     }
 

@@ -43,13 +43,14 @@ per-rank step health is scrapeable from /metrics with no new plumbing.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from ..base import ParamError, get_env
 from . import core
+from ..concurrency import make_lock
 
 __all__ = [
     "StepLedger",
@@ -81,13 +82,12 @@ def detect_peak_flops() -> Optional[float]:
     """Peak FLOP/s for MFU accounting: ``DMLC_PEAK_FLOPS`` wins (an
     operator statement about the hardware), else the device-kind table,
     else None (MFU unreported rather than wrong)."""
-    env = os.environ.get("DMLC_PEAK_FLOPS")
-    if env:
-        try:
-            v = float(env)
-            return v if v > 0 else None
-        except ValueError:
-            return None
+    try:
+        env = get_env("DMLC_PEAK_FLOPS", None, float)
+    except ParamError:
+        return None  # an operator typo mutes MFU, never crashes a step
+    if env is not None:
+        return env if env > 0 else None
     try:
         import jax
 
@@ -131,8 +131,8 @@ class StepLedger:
     def __init__(self, capacity: Optional[int] = None,
                  peak_flops: Optional[float] = None):
         if capacity is None:
-            capacity = int(os.environ.get("DMLC_STEP_LEDGER_MAX", "1024"))
-        self._lock = threading.Lock()
+            capacity = get_env("DMLC_STEP_LEDGER_MAX", 1024)
+        self._lock = make_lock("StepLedger._lock")
         self._records: deque = deque(maxlen=max(1, capacity))
         self._seq = 0
         self._flops_per_token: Optional[float] = None

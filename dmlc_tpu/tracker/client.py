@@ -26,7 +26,6 @@ from __future__ import annotations
 
 import json
 import logging
-import os
 import select
 import socket
 import time
@@ -34,7 +33,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from ..base import DMLCError, check
+from ..base import DMLCError, check, get_env
 from ..resilience import RetryPolicy, fault_point
 from .protocol import MAGIC, FrameSocket, recover_cmd
 
@@ -69,7 +68,7 @@ def _coll_algo_env() -> str:
       still degrades to ``ring`` when no shm segment can be mapped,
       with a one-time warning, so a heterogeneous fleet never hangs).
     """
-    algo = os.environ.get("DMLC_COLL_ALGO", "auto").strip().lower()
+    algo = get_env("DMLC_COLL_ALGO", "auto").strip().lower()
     if algo not in ("auto", "tree", "ring", "hier"):
         raise ValueError(f"DMLC_COLL_ALGO={algo!r} not in "
                          "tree|ring|hier|auto")
@@ -102,11 +101,7 @@ def _hier_min_bytes() -> int:
     cutover sweep shows the shm leg already beating both tree and flat
     ring there; below it the tree's 2·log2(n) latency wins).  Negative
     disables hier in auto mode."""
-    try:
-        return int(os.environ.get("DMLC_COLL_HIER_MIN_BYTES",
-                                  str(64 << 10)))
-    except ValueError:
-        return 64 << 10
+    return get_env("DMLC_COLL_HIER_MIN_BYTES", 64 << 10)
 
 
 def _ring_min_bytes() -> int:
@@ -120,8 +115,6 @@ def _ring_min_bytes() -> int:
     ever sends 2·(n-1)/n of the payload, all links busy at once, so it
     wins as soon as bandwidth dominates latency.  Small control-plane
     messages stay on the tree."""
-    from ..base import get_env
-
     return get_env("DMLC_COLL_RING_MIN_BYTES", 1 << 20)
 
 
@@ -132,7 +125,7 @@ def _connect_timeout() -> Optional[float]:
     """Per-dial connect timeout (DMLC_CLIENT_CONNECT_TIMEOUT_S, default
     15; 0 disables).  Bounds how long one attempt can hang on a dead
     tracker or peer before the reconnect backoff takes over."""
-    t = float(os.environ.get("DMLC_CLIENT_CONNECT_TIMEOUT_S", "15"))
+    t = get_env("DMLC_CLIENT_CONNECT_TIMEOUT_S", 15.0)
     return t if t > 0 else None
 
 
@@ -142,14 +135,14 @@ def _op_timeout() -> Optional[float]:
     disables).  A tracker or peer that dies without a FIN raises
     ``socket.timeout`` (an OSError, so the recover path catches it)
     instead of blocking a recv forever."""
-    t = float(os.environ.get("DMLC_CLIENT_OP_TIMEOUT_S", "300"))
+    t = get_env("DMLC_CLIENT_OP_TIMEOUT_S", 300.0)
     return t if t > 0 else None
 
 
 def _resize_timeout() -> float:
     """Upper bound on one resize() re-rendezvous, settle-wait included
     (DMLC_ELASTIC_RESIZE_TIMEOUT_S, default 120)."""
-    return float(os.environ.get("DMLC_ELASTIC_RESIZE_TIMEOUT_S", "120"))
+    return get_env("DMLC_ELASTIC_RESIZE_TIMEOUT_S", 120.0)
 
 
 def _dial_policy() -> RetryPolicy:
@@ -168,11 +161,11 @@ class TrackerClient:
     def __init__(self, tracker_uri: Optional[str] = None,
                  tracker_port: Optional[int] = None,
                  jobid: Optional[str] = None):
-        self.tracker_uri = tracker_uri or os.environ.get(
+        self.tracker_uri = tracker_uri or get_env(
             "DMLC_TRACKER_URI", "127.0.0.1")
         self.tracker_port = int(
-            tracker_port or os.environ.get("DMLC_TRACKER_PORT", "9091"))
-        self.jobid = jobid or os.environ.get("DMLC_TASK_ID", "NULL")
+            tracker_port or get_env("DMLC_TRACKER_PORT", "9091"))
+        self.jobid = jobid or get_env("DMLC_TASK_ID", "NULL")
         self.rank = -1
         self.world_size = -1
         self.parent = -1
@@ -824,7 +817,6 @@ class TrackerClient:
         foldable by the shm collective and shm not env-disabled.  Library
         availability is deliberately NOT checked here (it can differ per
         host); _hier_state()'s MIN-veto makes the real verdict uniform."""
-        from ..base import get_env
         from ..native import shm_collective as shmc
 
         return shmc.supports_dtype(dtype) and get_env("DMLC_COLL_SHM", 1) != 0
@@ -879,8 +871,8 @@ class TrackerClient:
         leader ring on one box).  Polls the tracker until the map covers
         the whole world — a worker still mid-brokering has no accept
         port yet."""
-        deadline = time.monotonic() + float(
-            os.environ.get("DMLC_COLL_HIER_SETUP_TIMEOUT_S", "20"))
+        deadline = time.monotonic() + get_env(
+            "DMLC_COLL_HIER_SETUP_TIMEOUT_S", 20.0)
         hostports: Dict[int, tuple] = {}
         while True:
             doc = self._query_hostmap()
@@ -898,7 +890,7 @@ class TrackerClient:
                     f"tracker job map covers {len(hosts)}/"
                     f"{self.world_size} ranks (workers still brokering?)")
             time.sleep(0.2)
-        block = int(os.environ.get("DMLC_COLL_HIER_GROUPS", "0") or 0)
+        block = get_env("DMLC_COLL_HIER_GROUPS", 0)
         if block > 0:
             groups = [list(range(i, min(i + block, self.world_size)))
                       for i in range(0, self.world_size, block)]
@@ -918,8 +910,7 @@ class TrackerClient:
         accept order can never cycle into a deadlock).  New links land
         in ``self.links`` so teardown and the WorldResized cascade cover
         them like any brokered link."""
-        setup_t = float(
-            os.environ.get("DMLC_COLL_HIER_SETUP_TIMEOUT_S", "20"))
+        setup_t = get_env("DMLC_COLL_HIER_SETUP_TIMEOUT_S", 20.0)
         to_accept = set()
         for peer in sorted(need):
             if peer == self.rank or peer in self.links:
@@ -991,8 +982,7 @@ class TrackerClient:
                 ok = False  # no intra-host sharing: hier ≡ ring + overhead
         if ok and len(st.group) > 1:
             try:
-                chunk_kb = int(
-                    os.environ.get("DMLC_COLL_SHM_CHUNK_KB", "0") or 0)
+                chunk_kb = get_env("DMLC_COLL_SHM_CHUNK_KB", 0)
                 st.shm = shmc.ShmCollective(
                     f"dmlc-hier-{self.tracker_port}-{self.gen}-{st.leader}",
                     st.local_rank, len(st.group), chunk_kb=chunk_kb)

@@ -21,18 +21,54 @@ import threading
 from typing import Callable, Dict, List, Optional, Sequence
 
 from .rendezvous import submit_job
+from ..concurrency import make_lock
 
 logger = logging.getLogger("dmlc_tpu.tracker")
 
-# env vars forwarded to remote tasks (reference ssh.py:26 plus JAX/TPU
-# plus the elastic-world knobs — every worker must agree on them)
+# Env vars forwarded to remote tasks (reference ssh.py:26 plus JAX/TPU
+# plus every DMLC_* knob workers must see).  The DMLC_* entries mirror
+# config_registry.py's pass_to_workers knobs — a knob a worker reads
+# but the launcher does not forward works locally and silently does
+# nothing on ssh/tpu-vm (the PR 7/9 gang-uniform DMLC_COLL_* cutovers
+# depend on forwarding) — and scripts/dmlc_check.py's knob pass fails
+# CI when the two lists drift.  Kept explicit rather than imported:
+# the ssh export line is security-sensitive, so what it ships should
+# be reviewable here, not computed at launch time.
 PASS_ENVS = [
-    "OMP_NUM_THREADS", "LD_LIBRARY_PATH", "PYTHONPATH", "DMLC_INTERFACE",
+    "OMP_NUM_THREADS", "LD_LIBRARY_PATH", "PYTHONPATH",
     "AWS_ACCESS_KEY_ID", "AWS_SECRET_ACCESS_KEY",
     "GOOGLE_APPLICATION_CREDENTIALS", "JAX_PLATFORMS", "XLA_FLAGS",
     "TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES",
-    "DMLC_ELASTIC", "DMLC_ELASTIC_GRACE_S",
-    "DMLC_ELASTIC_RESIZE_TIMEOUT_S",
+    # -- registry pass_to_workers knobs (config_registry.py order) ----
+    "DMLC_INTERFACE", "DMLC_FEED_WORKERS", "DMLC_FEED_DEPTH",
+    "DMLC_TPU_PARSE_NTHREAD", "DMLC_TPU_DISABLE_NATIVE",
+    "DMLC_TPU_DISABLE_MMAP", "DMLC_COLL_ALGO", "DMLC_COLL_BUCKET_MB",
+    "DMLC_COLL_RING_MIN_BYTES", "DMLC_COLL_HIER_MIN_BYTES",
+    "DMLC_COLL_HIER_GROUPS", "DMLC_COLL_HIER_SETUP_TIMEOUT_S",
+    "DMLC_COLL_SHM", "DMLC_COLL_SHM_CHUNK_KB",
+    "DMLC_COLL_SHM_JOIN_TIMEOUT_S", "DMLC_COLL_SHM_TIMEOUT_S",
+    "DMLC_COLL_OVERLAP", "DMLC_CLIENT_CONNECT_TIMEOUT_S",
+    "DMLC_CLIENT_OP_TIMEOUT_S", "DMLC_CLIENT_RETRIES",
+    "DMLC_CLIENT_RETRY_BASE_S", "DMLC_ELASTIC", "DMLC_ELASTIC_GRACE_S",
+    "DMLC_ELASTIC_RESIZE_TIMEOUT_S", "DMLC_S3_ENDPOINT",
+    "DMLC_S3_RETRIES", "DMLC_S3_WRITE_BUFFER_MB", "DMLC_GCS_RETRIES",
+    "DMLC_GCS_RETRY_BASE_S", "DMLC_GCS_WRITE_BUFFER_MB",
+    "DMLC_AZURE_ENDPOINT", "DMLC_AZURE_RETRIES", "DMLC_AZURE_BLOCK_MB",
+    "DMLC_HDFS_USER", "DMLC_HDFS_RETRIES", "DMLC_HDFS_WRITE_BUFFER_MB",
+    "DMLC_WEBHDFS_ENDPOINT", "DMLC_WEBHDFS_PORT", "DMLC_HTTP_RETRIES",
+    "DMLC_REST_RETRIES", "DMLC_REST_TIMEOUT_S", "DMLC_RETRY_ATTEMPTS",
+    "DMLC_RETRY_MAX_S", "DMLC_RETRY_DEADLINE_S",
+    "DMLC_RECORDIO_CHECKSUM", "DMLC_INTEGRITY_POLICY",
+    "DMLC_INTEGRITY_VERIFY_READS", "DMLC_INTEGRITY_READ_RETRIES",
+    "DMLC_SELFHEAL_MAX_SKIPS", "DMLC_SELFHEAL_MAX_ROLLBACKS",
+    "DMLC_SELFHEAL_SPIKE_FACTOR", "DMLC_SELFHEAL_WARMUP",
+    "DMLC_FAULT_SPEC", "DMLC_TELEMETRY_MAX_SPANS",
+    "DMLC_TELEMETRY_MAX_EVENTS", "DMLC_TELEMETRY_SHIP_TRACE",
+    "DMLC_TELEMETRY_MAX_BEAT_BYTES", "DMLC_POSTMORTEM_DIR",
+    "DMLC_STEP_LEDGER_MAX", "DMLC_PEAK_FLOPS", "DMLC_LOCKCHECK",
+    "DMLC_LOCKCHECK_BLOCK_S", "DMLC_FLASH_BH_BLOCK",
+    "DMLC_FLASH_BLOCK_Q", "DMLC_FLASH_BLOCK_K",
+    "DMLC_FLASH_BWD_BLOCK_Q", "DMLC_FLASH_BWD_BLOCK_K",
 ]
 
 
@@ -42,7 +78,7 @@ def _elastic() -> bool:
     return get_env("DMLC_ELASTIC", False)
 
 
-_postmortem_scan_lock = threading.Lock()
+_postmortem_scan_lock = make_lock("launch._postmortem_scan_lock")
 
 
 def collect_postmortems(seen: set, role: str, task_id,
@@ -295,7 +331,7 @@ class GangScheduler:
         self.host_failures: Dict[str, int] = {}
         self.blacklist: set = set()
         self._collected: set = set()  # postmortems: one claim set per job
-        self._lock = threading.Lock()
+        self._lock = make_lock("GangScheduler._lock")
 
     def _pick_host(self, idx: int) -> str:
         with self._lock:

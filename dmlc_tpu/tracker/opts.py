@@ -10,6 +10,8 @@ from __future__ import annotations
 import argparse
 import os
 
+from ..base import get_env
+
 CLUSTERS = ["local", "ssh", "mpi", "sge", "slurm", "mesos", "yarn", "tpu-vm"]
 
 
@@ -27,7 +29,8 @@ def build_parser() -> argparse.ArgumentParser:
         prog="dmlc-submit",
         description="submit a distributed dmlc_tpu job",
     )
-    p.add_argument("--cluster", default=os.environ.get("DMLC_SUBMIT_CLUSTER"),
+    p.add_argument("--cluster",
+                   default=get_env("DMLC_SUBMIT_CLUSTER", None, str),
                    choices=CLUSTERS, help="cluster backend")
     p.add_argument("--num-workers", required=True, type=int)
     p.add_argument("--num-servers", default=0, type=int)

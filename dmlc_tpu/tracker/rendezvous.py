@@ -18,7 +18,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from ..base import DMLCError
+from ..base import DMLCError, get_env
+from ..concurrency import make_lock
 from .protocol import MAGIC, FrameSocket, link_maps, parse_worker_cmd, \
     resolve_ip
 
@@ -31,7 +32,7 @@ def _sock_timeout() -> Optional[float]:
     the tracker blocked forever on a dead recv mid-brokering; the
     reference tracker (tracker.py:80-135) hangs exactly this way.
     0 disables (DMLC_TRACKER_TIMEOUT seconds, default 300)."""
-    t = float(os.environ.get("DMLC_TRACKER_TIMEOUT", "300"))
+    t = get_env("DMLC_TRACKER_TIMEOUT", 300.0)
     return t if t > 0 else None
 
 
@@ -50,7 +51,7 @@ class AcceptRegistry:
 
     def __init__(self):
         self._listening: Dict[int, "WorkerEntry"] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("AcceptRegistry._lock")
 
     def __contains__(self, rank: int) -> bool:
         with self._lock:
@@ -242,6 +243,10 @@ class RabitTracker:
                  elastic: Optional[bool] = None,
                  elastic_grace_s: Optional[float] = None):
         family = socket.getaddrinfo(host_ip, None)[0][0]
+        # the accept loop IS the tracker's main loop: blocking forever
+        # on accept() between sessions is its designed idle state, and
+        # every ACCEPTED connection gets a per-socket timeout in
+        # WorkerEntry  # dmlc-check: disable=socket-no-timeout
         sock = socket.socket(family, socket.SOCK_STREAM)
         for p in range(port, port_end):
             try:
@@ -260,20 +265,16 @@ class RabitTracker:
         self.start_time: Optional[float] = None
         self.end_time: Optional[float] = None
         if miss_window_s is None:
-            miss_window_s = float(
-                os.environ.get("DMLC_TRACKER_MISS_WINDOW_S", "0"))
+            miss_window_s = get_env("DMLC_TRACKER_MISS_WINDOW_S", 0.0)
         self.miss_window_s = miss_window_s
         if elastic is None:
-            from ..base import get_env
-
             elastic = get_env("DMLC_ELASTIC", False)
         self.elastic = bool(elastic)
         if elastic_grace_s is None:
-            elastic_grace_s = float(
-                os.environ.get("DMLC_ELASTIC_GRACE_S", "5"))
+            elastic_grace_s = get_env("DMLC_ELASTIC_GRACE_S", 5.0)
         self.elastic_grace_s = elastic_grace_s
         self.gen = 0
-        self._resize_lock = threading.Lock()
+        self._resize_lock = make_lock("RabitTracker._resize_lock")
         self._resize_req: Optional[Dict] = None
         self._rank_maps: Dict[int, Dict[int, int]] = {}  # gen -> old->new
         self._dead_since: Dict[int, float] = {}          # rank -> monotonic
@@ -289,7 +290,7 @@ class RabitTracker:
         self._shutdown: Dict[int, "WorkerEntry"] = {}
         self.dead_ranks: set = set()
         self._finished_ranks: set = set()  # clean shutdowns: never "dead"
-        self._dead_lock = threading.Lock()
+        self._dead_lock = make_lock("RabitTracker._dead_lock")
         self._entries: Dict[int, "WorkerEntry"] = {}
         self._registry: Optional[AcceptRegistry] = None
         self._monitor: Optional[threading.Thread] = None
@@ -323,8 +324,7 @@ class RabitTracker:
         self.metrics_server = None
         self.metrics_port: Optional[int] = None
         if metrics_port is None:
-            env = os.environ.get("DMLC_TRACKER_METRICS_PORT")
-            metrics_port = int(env) if env else None
+            metrics_port = get_env("DMLC_TRACKER_METRICS_PORT", None, int)
         if metrics_port is not None:
             from ..telemetry import TelemetryHTTPServer
 
@@ -945,7 +945,8 @@ class RabitTracker:
 def free_port(host_ip: str = "127.0.0.1") -> int:
     """Find a currently-free TCP port on ``host_ip`` without holding it."""
     probe = socket.socket()
-    probe.bind((host_ip, 0))
+    probe.settimeout(5.0)  # bind/getsockname never block, but keep the
+    probe.bind((host_ip, 0))  # no-unbounded-socket invariant uniform
     port = probe.getsockname()[1]
     probe.close()
     return port
@@ -1027,7 +1028,7 @@ def submit_job(n_workers: int, n_servers: int, fun_submit, host_ip: str = "auto"
     n_servers == 0, PS path otherwise.
     """
     if host_ip == "auto":
-        host_ip = os.environ.get("DMLC_TRACKER_URI") or _default_host_ip()
+        host_ip = get_env("DMLC_TRACKER_URI", "") or _default_host_ip()
     envs = {"DMLC_NUM_WORKER": str(n_workers),
             "DMLC_NUM_SERVER": str(n_servers)}
     # The jax.distributed coordinator is a gRPC service that rank 0 of the
@@ -1062,6 +1063,7 @@ def _default_host_ip() -> str:
     """Best-effort local IP (no egress needed: UDP connect is routing-only)."""
     try:
         s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.settimeout(5.0)
         s.connect(("10.255.255.255", 1))
         ip = s.getsockname()[0]
         s.close()

@@ -14,7 +14,11 @@
 #      full correctness suite (shm transport + TCP fallback) under the
 #      real launcher, leak detection on — the shm/KV code is the one
 #      native surface with nontrivial object lifecycle
+#   5.5 UBSan build+run of the collective ABI (same skip pattern):
+#      all three sanitizers now cover the C sources
 #   6. telemetry smoke: 2-worker local rendezvous pushing heartbeats
+#      (workers run under DMLC_LOCKCHECK=1 — the runtime lock-order
+#      watchdog — and assert a clean report before exiting)
 #      while driving the step ledger with rank 1 fault-injected slow;
 #      the anomaly watchdog must flag exactly that rank as a straggler
 #      on /anomalies (no false positive on rank 0), dmlc-top renders a
@@ -71,8 +75,14 @@ python -m compileall -q dmlc_tpu tests scripts examples bin \
     bench.py __graft_entry__.py \
     || { echo "FAIL: syntax errors"; exit 1; }
 
-echo "== stage 0.5: lint gate (scripts/lint.py) =="
-python scripts/lint.py || { echo "FAIL: lint findings"; exit 1; }
+echo "== stage 0.5: dmlc-check gate (static-analysis suite) =="
+# style + metrics (the absorbed lint.py) + concurrency (blocking-under-
+# lock, lock-graph cycles, non-daemon threads) + knobs (config_registry
+# coverage, raw-env ban, PASS_ENVS + README knob table) + contracts
+# (swallowed WorldResized/CorruptRecord/EngineDraining, timeout-less
+# sockets, typo'd DMLC_FAULT_SPEC sites); zero findings = pass,
+# suppressions are inline-commented and counted in the summary
+python scripts/dmlc_check.py || { echo "FAIL: dmlc-check findings"; exit 1; }
 
 echo "== stage 1: native build =="
 NATIVE_OK=0
@@ -161,6 +171,48 @@ if command -v g++ >/dev/null 2>&1 && command -v gcc >/dev/null 2>&1; then
     fi
 fi
 
+echo "== stage 5.5: UBSan pass on the collective ABI =="
+# third sanitizer next to TSAN/ASAN: undefined behavior (misaligned
+# loads, signed overflow, bad shifts) in the C collective + driver,
+# same runtime-probe skip pattern as the asan stage
+UBSAN_OK=skipped
+if command -v g++ >/dev/null 2>&1 && command -v gcc >/dev/null 2>&1; then
+    UBSAN_DIR=$(mktemp -d)
+    trap 'rm -rf "$TSAN_DIR" "$ASAN_DIR" "$UBSAN_DIR"' EXIT
+    echo 'int main(){return 0;}' > "$UBSAN_DIR/probe.cc"
+    if g++ -fsanitize=undefined "$UBSAN_DIR/probe.cc" \
+           -o "$UBSAN_DIR/probe" 2>/dev/null && "$UBSAN_DIR/probe"; then
+        g++ -O1 -g -fsanitize=undefined -fno-sanitize-recover=undefined \
+            -std=c++17 -shared -fPIC \
+            dmlc_tpu/cpp/dmlc_collective.cc \
+            -o "$UBSAN_DIR/libdmlc_collective.so" -lrt \
+            || { echo "FAIL: ubsan build of collective broke"; exit 1; }
+        gcc -O1 -g -fsanitize=undefined -fno-sanitize-recover=undefined \
+            -std=c99 -I dmlc_tpu/cpp \
+            dmlc_tpu/cpp/test_collective.c \
+            "$UBSAN_DIR/libdmlc_collective.so" \
+            -o "$UBSAN_DIR/test_collective" -lm -lubsan -lrt \
+            -Wl,-rpath,"$UBSAN_DIR" \
+            || { echo "FAIL: ubsan build of collective driver broke"; exit 1; }
+        for shm in 1 0; do
+            DMLC_COLL_SHM=$shm python -m dmlc_tpu.tracker.submit \
+                --cluster local --num-workers 4 --max-attempts 1 \
+                --host-ip 127.0.0.1 -- "$UBSAN_DIR/test_collective" \
+                > "$UBSAN_DIR/run.log" 2>&1 \
+                || { echo "FAIL: ubsan collective run (shm=$shm)";
+                     tail -30 "$UBSAN_DIR/run.log"; exit 1; }
+            if grep -q "runtime error:" "$UBSAN_DIR/run.log"; then
+                echo "FAIL: undefined behavior (shm=$shm)"
+                grep "runtime error:" -A3 "$UBSAN_DIR/run.log" | head -40
+                exit 1
+            fi
+        done
+        UBSAN_OK=1
+    else
+        echo "ubsan runtime unavailable; skipping"
+    fi
+fi
+
 echo "== stage 6: telemetry smoke (rendezvous heartbeats + /metrics) =="
 timeout -k 10 180 python scripts/telemetry_smoke.py \
     || { echo "FAIL: telemetry smoke"; exit 1; }
@@ -186,4 +238,5 @@ timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/integrity_smoke.py \
     || { echo "FAIL: integrity smoke"; exit 1; }
 
 echo "== CI OK (native=$NATIVE_OK tsan=$TSAN_OK asan=$ASAN_OK" \
-     "telemetry=1 chaos=1 perf=1 serving=1 elastic=1 integrity=1) =="
+     "ubsan=$UBSAN_OK telemetry=1 chaos=1 perf=1 serving=1 elastic=1" \
+     "integrity=1) =="

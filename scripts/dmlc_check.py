@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""dmlc-check: run the repo-invariant static-analysis suite.
+
+The generalization of the old ``scripts/lint.py`` (whose checks live on
+as the ``style`` and ``metrics`` passes) into the pluggable framework
+under ``dmlc_tpu/analysis/``:
+
+  style        unused imports, bare except, mutable defaults, whitespace
+  metrics      every emittable dmlc_* family is registered
+  concurrency  blocking-under-lock, static lock-graph cycles,
+               non-daemon threads nobody joins
+  knobs        every DMLC_* env read resolves against
+               dmlc_tpu/config_registry.py; raw os.environ reads are
+               banned in dmlc_tpu/; PASS_ENVS + README table complete
+  contracts    swallowed WorldResized/CorruptRecord/EngineDraining,
+               sockets without timeouts, typo'd DMLC_FAULT_SPEC sites
+
+Usage:
+  python scripts/dmlc_check.py [paths...]         # all passes
+  python scripts/dmlc_check.py --passes knobs,contracts
+  python scripts/dmlc_check.py --list             # show passes/checks
+  python scripts/dmlc_check.py --write-knob-table # regenerate README
+
+Suppress one finding with an inline comment on (or directly above) the
+offending line::
+
+    something_noisy()  # dmlc-check: disable=<check-id> -- why
+
+Suppressions are counted in the summary so they stay visible.  Exit 0
+clean, 1 with findings.
+"""
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from dmlc_tpu.analysis import ALL_PASSES, run_passes  # noqa: E402
+from dmlc_tpu.analysis.core import RepoIndex, default_paths  # noqa: E402
+
+DEFAULT_ROOTS = ["dmlc_tpu", "tests", "scripts", "examples", "bench.py",
+                 "__graft_entry__.py", "bin"]
+
+
+def write_knob_table() -> int:
+    from dmlc_tpu import config_registry
+    from dmlc_tpu.analysis.knob_pass import readme_with_table
+
+    path = os.path.join(REPO, "README.md")
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    out = readme_with_table(src, config_registry.render_markdown_table())
+    if out is None:
+        print("README.md: knob-table markers not found", file=sys.stderr)
+        return 1
+    if out != src:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(out)
+        print("README.md: knob table regenerated", file=sys.stderr)
+    else:
+        print("README.md: knob table already current", file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dmlc_check.py",
+        description="repo-invariant static-analysis suite")
+    ap.add_argument("paths", nargs="*", help="files/dirs to check "
+                    "(default: the whole repo surface)")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated subset of pass names")
+    ap.add_argument("--list", action="store_true",
+                    help="list passes and their check ids")
+    ap.add_argument("--write-knob-table", action="store_true",
+                    help="regenerate the README knob table from "
+                         "config_registry.py and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for cls in ALL_PASSES:
+            print(f"{cls.name}: {', '.join(cls.checks)}")
+        return 0
+    if args.write_knob_table:
+        return write_knob_table()
+
+    passes = [cls() for cls in ALL_PASSES]
+    if args.passes:
+        wanted = {p.strip() for p in args.passes.split(",") if p.strip()}
+        unknown = wanted - {p.name for p in passes}
+        if unknown:
+            print(f"unknown passes: {sorted(unknown)}", file=sys.stderr)
+            return 2
+        passes = [p for p in passes if p.name in wanted]
+
+    paths = default_paths(args.paths or DEFAULT_ROOTS, REPO)
+    index = RepoIndex(paths, REPO)
+    findings, suppressed = run_passes(index, passes)
+    for f in findings:
+        print(f)
+    by_check = {}
+    for s in suppressed:
+        by_check[s.check] = by_check.get(s.check, 0) + 1
+    supp = ", ".join(f"{k}={v}" for k, v in sorted(by_check.items()))
+    print(f"dmlc-check: {len(index.files)} files, "
+          f"{len(passes)} passes, {len(findings)} findings, "
+          f"{len(suppressed)} suppressed"
+          + (f" ({supp})" if supp else ""), file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

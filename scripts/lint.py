@@ -1,218 +1,20 @@
 #!/usr/bin/env python
-"""Static style/correctness gate (reference scripts/lint.py role).
+"""Deprecated shim: the lint gate grew into ``scripts/dmlc_check.py``.
 
-The reference repo gated CI on pylint + cpplint (.travis.yml:8-16); this
-image ships no third-party linter, so the same role is filled with an
-AST walk over every repo Python file checking the high-value classes:
+The checks that lived here (unused imports, bare except, mutable
+defaults, whitespace, line length, the dmlc_* metric-name contract)
+are now the ``style`` and ``metrics`` passes of the dmlc-check
+static-analysis framework (``dmlc_tpu/analysis/``), which adds the
+concurrency / knob / contract passes on top.  This entry point keeps
+muscle memory and old automation working by running exactly the
+absorbed passes; run ``scripts/dmlc_check.py`` for the full suite.
 
-  * unused imports          (dead weight; masks real dependencies)
-  * bare ``except:``        (swallows KeyboardInterrupt/SystemExit)
-  * mutable default args    (shared-state bugs)
-  * tabs / trailing whitespace
-  * lines over 100 columns
-  * metric-name contract    every ``dmlc_*`` metric family the code can
-                            emit (literal telemetry.inc/observe/... call
-                            sites resolve to ``dmlc_<stage>_<name>``)
-                            and every literal ``dmlc_*`` string must
-                            appear in the checked-in registry
-                            ``dmlc_tpu/telemetry/metric_names.py`` —
-                            MIGRATION.md's "no renames, additive only"
-                            promise, enforced (a typo'd duplicate
-                            family or a scrape assertion on a name
-                            nobody emits fails here, not in prod)
-
-Exit 0 clean, 1 with findings (one per line: path:line: message).
 Usage: python scripts/lint.py [paths...]
 """
 
-import ast
-import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-DEFAULT_ROOTS = ["dmlc_tpu", "tests", "scripts", "examples", "bench.py",
-                 "__graft_entry__.py", "bin/dmlc-submit", "bin/dmlc-top",
-                 "bin/dmlc-serve"]
-MAX_COLS = 100
-
-# roots whose telemetry call sites define REAL metric families; tests
-# register throwaway stages ("stage", "smoke") that are not contract
-METRIC_ROOTS = ("dmlc_tpu", "scripts", "examples", "bench.py")
-_METRIC_FUNCS = {"inc", "set_gauge", "observe", "observe_duration",
-                 "timed"}
-_METRIC_TOKEN_RE = re.compile(r"dmlc_[a-z0-9]+(?:_[a-z0-9]+)*")
-_METRIC_SUFFIXES = ("_bucket", "_sum", "_count", "_total")
-
-
-def py_files(roots):
-    for root in roots:
-        path = os.path.join(REPO, root)
-        if os.path.isfile(path):
-            yield path
-        else:
-            for dirpath, dirnames, filenames in os.walk(path):
-                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-                for f in filenames:
-                    if f.endswith(".py"):
-                        yield os.path.join(dirpath, f)
-
-
-class ImportCollector(ast.NodeVisitor):
-    def __init__(self):
-        self.imports = []   # (local_name, lineno, statement_desc)
-        self.used = set()
-
-    def visit_Import(self, node):
-        for a in node.names:
-            local = a.asname or a.name.split(".")[0]
-            self.imports.append((local, node.lineno, a.name))
-
-    def visit_ImportFrom(self, node):
-        if node.module == "__future__":  # directives, not bindings
-            return
-        for a in node.names:
-            if a.name == "*":
-                continue
-            local = a.asname or a.name
-            self.imports.append((local, node.lineno, a.name))
-
-    def visit_Name(self, node):
-        self.used.add(node.id)
-
-    def visit_Attribute(self, node):
-        self.generic_visit(node)
-
-
-def check_file(path):
-    findings = []
-    rel = os.path.relpath(path, REPO)
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    for i, line in enumerate(src.splitlines(), 1):
-        if "\t" in line:
-            findings.append(f"{rel}:{i}: tab character")
-        if line != line.rstrip():
-            findings.append(f"{rel}:{i}: trailing whitespace")
-        if len(line) > MAX_COLS:
-            findings.append(f"{rel}:{i}: line longer than {MAX_COLS} cols")
-    try:
-        tree = ast.parse(src)
-    except SyntaxError as e:
-        findings.append(f"{rel}:{e.lineno}: syntax error: {e.msg}")
-        return findings
-
-    # unused imports — skip __init__.py (re-export surface by design)
-    if os.path.basename(path) != "__init__.py":
-        col = ImportCollector()
-        col.visit(tree)
-        exported = set()
-        for node in ast.walk(tree):
-            if (isinstance(node, ast.Assign)
-                    and any(isinstance(t, ast.Name) and t.id == "__all__"
-                            for t in node.targets)
-                    and isinstance(node.value, (ast.List, ast.Tuple))):
-                exported |= {e.value for e in node.value.elts
-                             if isinstance(e, ast.Constant)}
-        for local, lineno, what in col.imports:
-            if local not in col.used and local not in exported:
-                findings.append(f"{rel}:{lineno}: unused import {what!r}")
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            findings.append(f"{rel}:{node.lineno}: bare except")
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            for d in list(node.args.defaults) + [
-                    d for d in node.args.kw_defaults if d is not None]:
-                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
-                    findings.append(
-                        f"{rel}:{d.lineno}: mutable default argument")
-    return findings
-
-
-def _registry():
-    sys.path.insert(0, REPO)
-    from dmlc_tpu.telemetry import metric_names
-
-    return metric_names
-
-
-def _is_registered(token: str, known: set) -> bool:
-    if token in known:
-        return True
-    for suf in _METRIC_SUFFIXES:
-        if token.endswith(suf) and token[: -len(suf)] in known:
-            return True
-    return False
-
-
-def check_metric_contract(paths) -> list:
-    """Cross-file pass: derive every metric family literal call sites
-    can emit, plus every literal ``dmlc_*`` string, and demand each is
-    registered in dmlc_tpu/telemetry/metric_names.py."""
-    reg = _registry()
-    known = (set(reg.METRIC_NAMES) | set(reg.SPAN_ANNOTATIONS)
-             | set(reg.NON_METRIC_TOKENS))
-    registry_path = os.path.join(REPO, "dmlc_tpu", "telemetry",
-                                 "metric_names.py")
-    findings = []
-    for path in paths:
-        if os.path.abspath(path) == registry_path:
-            continue  # the registry trivially contains itself
-        rel = os.path.relpath(path, REPO)
-        in_metric_root = any(
-            rel == r or rel.startswith(r + os.sep) for r in METRIC_ROOTS)
-        with open(path, encoding="utf-8") as f:
-            src = f.read()
-        try:
-            tree = ast.parse(src)
-        except SyntaxError:
-            continue  # already reported by check_file
-        for node in ast.walk(tree):
-            # derived families: telemetry.inc("stage", "name", ...) and
-            # friends with literal args resolve to dmlc_<stage>_<name>
-            if in_metric_root and isinstance(node, ast.Call):
-                fn = node.func
-                fname = (fn.attr if isinstance(fn, ast.Attribute)
-                         else fn.id if isinstance(fn, ast.Name) else None)
-                args = node.args
-                if (fname in _METRIC_FUNCS and len(args) >= 2
-                        and all(isinstance(a, ast.Constant)
-                                and isinstance(a.value, str)
-                                for a in args[:2])):
-                    suffix = ("_secs" if fname in ("observe_duration",
-                                                   "timed") else "")
-                    name = f"dmlc_{args[0].value}_{args[1].value}{suffix}"
-                    if not _is_registered(name, known):
-                        findings.append(
-                            f"{rel}:{node.lineno}: metric family "
-                            f"{name!r} not in telemetry/metric_names.py "
-                            f"(add it, or fix the typo'd stage/name)")
-            # literal names: scrape assertions, hand-rendered families
-            if (isinstance(node, ast.Constant)
-                    and isinstance(node.value, str)):
-                for token in _METRIC_TOKEN_RE.findall(node.value):
-                    if not _is_registered(token, known):
-                        findings.append(
-                            f"{rel}:{node.lineno}: dmlc_* token "
-                            f"{token!r} not in telemetry/"
-                            f"metric_names.py")
-    return findings
-
-
-def main():
-    roots = sys.argv[1:] or DEFAULT_ROOTS
-    all_findings = []
-    paths = list(py_files(roots))
-    for path in paths:
-        all_findings += check_file(path)
-    all_findings += check_metric_contract(paths)
-    for f in all_findings:
-        print(f)
-    print(f"lint: {len(paths)} files, {len(all_findings)} findings",
-          file=sys.stderr)
-    return 1 if all_findings else 0
-
+from dmlc_check import main  # noqa: E402  (same scripts/ directory)
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--passes", "style,metrics"] + sys.argv[1:]))

@@ -24,6 +24,11 @@ fault-injected (``DMLC_FAULT_SPEC`` delay) to be a straggler — then:
   6. exports the smoke process's own spans as Chrome trace JSON and
      validates it is well-formed with >= 1 complete ("X") event.
 
+Both workers run under ``DMLC_LOCKCHECK=1`` (the runtime lock-order
+watchdog instruments every ``concurrency.make_lock`` lock) and assert
+a clean violation report before exiting — a lock-order regression in
+the telemetry path fails this smoke, not production.
+
 Exit 0 on success, 1 with a diagnostic on any failure.
 """
 
@@ -74,6 +79,12 @@ for i in range({n_steps}):
 time.sleep(1.0)
 hb.close()
 c.shutdown()
+# this worker ran with DMLC_LOCKCHECK=1: every make_lock() lock in the
+# telemetry/heartbeat/step-ledger path was instrumented — any recorded
+# order inversion or held-while-blocked wait fails the worker (and so
+# the smoke) right here
+from dmlc_tpu.concurrency import lockcheck_assert_clean
+lockcheck_assert_clean()
 """
 
 def fail(msg: str) -> None:
@@ -199,6 +210,10 @@ def main() -> None:
     # straggler the watchdog must catch (and rank 0 must not trip on)
     env["DMLC_FAULT_SPEC"] = \
         f"smoke.step@rank:1=delay:{STRAGGLE_DELAY_S}:*"
+    # run the workers under the runtime lock-order watchdog: the whole
+    # heartbeat/ledger/telemetry lock surface is exercised end-to-end
+    # and each worker asserts a clean lockcheck report before exiting
+    env["DMLC_LOCKCHECK"] = "1"
     workers = [
         subprocess.Popen(
             [sys.executable, "-c",
