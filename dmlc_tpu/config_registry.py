@@ -128,6 +128,19 @@ KNOBS: Tuple[Knob, ...] = (
     _k("DMLC_FEED_DEPTH", int, 2,
        "staging buffers in the feed pool = pipeline depth "
        "(2 = double buffering)", ship=True, group="feed"),
+    _k("DMLC_FEED_AUTOTUNE", bool, False,
+       "1 = ledger-driven auto-tuning: adapt feed workers/depth to the "
+       "step ledger's feed-wait fraction at epoch boundaries", ship=True,
+       group="feed"),
+    _k("DMLC_FEED_WORKERS_MIN", int, 1,
+       "autotune lower bound on parser worker threads", ship=True,
+       group="feed"),
+    _k("DMLC_FEED_WORKERS_MAX", int, 0,
+       "autotune upper bound on parser worker threads (0 = cpu count, "
+       "always capped at n_parts)", ship=True, group="feed"),
+    _k("DMLC_FEED_DEPTH_MAX", int, 4,
+       "autotune upper bound on staging-pool depth", ship=True,
+       group="feed"),
     _k("DMLC_TPU_PARSE_NTHREAD", int, None,
        "native parse fanout threads (default: cpu count)", ship=True,
        group="feed"),

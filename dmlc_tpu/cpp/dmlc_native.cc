@@ -517,6 +517,386 @@ long dmlc_pack_spans(const char* src, long src_len, char* dst, long dst_cap,
   return i;
 }
 
-int dmlc_native_abi_version() { return 5; }
+// ---------------------------------------------------------------------
+// Fused single-pass scan + verify (ABI 6).
+//
+// dmlc_recordio_spans_verify walks a chunk ONCE, CRC32C-verifying
+// checksummed segments inline (verify != 0), and instead of failing the
+// whole chunk on corruption it emits TYPED REJECT triples and resyncs
+// to the next record head — the Python side routes rejects through the
+// DMLC_INTEGRITY_POLICY machinery (raise / skip / quarantine) with no
+// second pass over the bytes.  Good triples keep the flag 0-3 contract
+// of dmlc_recordio_spans; reject triples use flag >= 8:
+//
+//   8  bad magic at a record head position
+//   9  truncated payload (record extends past the chunk)
+//   10 torn multi-segment record (continuation header gone)
+//   11 missing end segment (continuation cflag wrong)
+//   12 non-head cflag at a record head position
+//   13 crc32c mismatch (span = [head, payload end))
+//   14 torn tail: sub-word remainder no header fits in (suppressed
+//      when the chunk already reported — the other report covers it)
+//
+// A reject's (offset, len) covers [begin, resync point) so Python can
+// key the quarantine skip-list without re-walking.  The walk and the
+// resync are EXACTLY the Python fallback's (_py_chunk_spans in
+// feed/device_feed.py) — the differential test suite holds the two to
+// byte-identical triple tables so the walkers can never drift.
+
+namespace {
+
+// find_next_record_head (io/recordio.py): first 4-aligned offset in
+// [begin, end) holding the magic followed by a head-cflag lrec.
+inline long find_head(const uint8_t* buf, long begin, long end,
+                      uint32_t magic) {
+  for (long idx = begin; idx + 8 <= end; idx += 4) {
+    uint32_t m;
+    memcpy(&m, buf + idx, 4);
+    if (m != magic) continue;
+    uint32_t lrec;
+    memcpy(&lrec, buf + idx + 4, 4);
+    uint32_t cf = lrec >> 29u;
+    if (cf == 0 || cf == 1 || cf == 4 || cf == 5) return idx;
+  }
+  return end;
+}
+
+// resync target after corruption at pos: next aligned word, then the
+// next record head within the whole-word prefix of the chunk.
+inline long resync_from(const uint8_t* buf, long n, long pos,
+                        uint32_t magic) {
+  long nxt = pos + 4 < n ? pos + 4 : n;
+  nxt += (4 - (nxt & 3)) & 3;
+  long end = n - (n & 3);
+  return nxt < end ? find_head(buf, nxt, end, magic) : end;
+}
+
+// stored_crc (io/recordio.py): a crc equal to the magic is written
+// flipped in its low bit so no stored cell scans as a record head.
+inline uint32_t stored_crc32(uint32_t c, uint32_t magic) {
+  return c == magic ? c ^ 1u : c;
+}
+
+// CRC-verify every segment of one structurally-validated checksummed
+// region [off, off+len) — the old _verify_region, fused into the scan.
+inline bool region_crc_ok(const uint8_t* buf, long off, long len,
+                          uint32_t magic) {
+  long pos = off, end = off + len;
+  while (pos + 12 <= end) {
+    uint32_t lrec, want;
+    memcpy(&lrec, buf + pos + 4, 4);
+    memcpy(&want, buf + pos + 8, 4);
+    uint32_t n = lrec & ((1u << 29u) - 1u);
+    if (stored_crc32(dmlc_crc32c(buf + pos + 12, n, 0), magic) != want)
+      return false;
+    pos += 12 + ((n + 3u) & ~3u);
+  }
+  return true;
+}
+
+}  // namespace
+
+long dmlc_recordio_spans_verify(const uint8_t* buf, long n, uint32_t magic,
+                                int verify, uint64_t* out, long max_spans,
+                                long* n_spans) {
+  long count = 0;
+  long pos = 0;
+  int any_reject = 0;
+#define EMIT(o, l, f)                      \
+  do {                                     \
+    if (count >= max_spans) return -1;     \
+    out[3 * count] = (uint64_t)(o);        \
+    out[3 * count + 1] = (uint64_t)(l);    \
+    out[3 * count + 2] = (uint64_t)(f);    \
+    ++count;                               \
+  } while (0)
+#define REJECT(o, l, f)                    \
+  do {                                     \
+    EMIT(o, l, f);                         \
+    any_reject = 1;                        \
+  } while (0)
+  while (pos + 8 <= n) {
+    uint32_t m, lrec;
+    memcpy(&m, buf + pos, 4);
+    if (m != magic) {
+      long r = resync_from(buf, n, pos, magic);
+      REJECT(pos, r - pos, 8);
+      pos = r;
+      continue;
+    }
+    memcpy(&lrec, buf + pos + 4, 4);
+    uint32_t cflag = lrec >> 29u;
+    uint32_t len = lrec & ((1u << 29u) - 1u);
+    int ck = cflag >= 4u;
+    long hdr = ck ? 12 : 8;
+    if ((cflag & 3u) == 0u && (cflag == 0u || cflag == 4u)) {
+      long nxt = pos + hdr + ((len + 3u) & ~3u);
+      if (nxt > n) {
+        long r = resync_from(buf, n, pos, magic);
+        REJECT(pos, r - pos, 9);
+        pos = r;
+        continue;
+      }
+      if (ck && verify) {
+        uint32_t want;
+        memcpy(&want, buf + pos + 8, 4);
+        if (stored_crc32(dmlc_crc32c(buf + pos + hdr, len, 0), magic)
+            != want) {
+          // span = [head, payload end): the quarantine key contract
+          REJECT(pos, (pos + hdr + len) - pos, 13);
+          pos = nxt;
+          continue;
+        }
+      }
+      EMIT(pos + hdr, len, ck ? 2 : 0);
+      pos = nxt;
+    } else if ((cflag & 3u) == 1u && (cflag == 1u || cflag == 5u)) {
+      long start = pos;
+      long p = pos + hdr + ((len + 3u) & ~3u);
+      int kind = 0;  // 0 = structurally sound
+      while (true) {
+        if (p + hdr > n) {
+          kind = 10;
+          break;
+        }
+        memcpy(&m, buf + p, 4);
+        if (m != magic) {
+          kind = 10;
+          break;
+        }
+        memcpy(&lrec, buf + p + 4, 4);
+        uint32_t cf = lrec >> 29u;
+        uint32_t l2 = lrec & ((1u << 29u) - 1u);
+        if (((cf & 3u) != 2u && (cf & 3u) != 3u) || ((cf >= 4u) != ck)) {
+          kind = 11;
+          break;
+        }
+        p += hdr + ((l2 + 3u) & ~3u);
+        if (p > n) {
+          kind = 9;
+          break;
+        }
+        if ((cf & 3u) == 3u) break;
+      }
+      if (kind != 0) {
+        long r = resync_from(buf, n, start, magic);
+        REJECT(start, r - start, kind);
+        pos = r;
+        continue;
+      }
+      if (ck && verify && !region_crc_ok(buf, start, p - start, magic)) {
+        REJECT(start, p - start, 13);
+      } else {
+        EMIT(start, p - start, ck ? 3 : 1);
+      }
+      pos = p;
+    } else {
+      long r = resync_from(buf, n, pos, magic);
+      REJECT(pos, r - pos, 12);
+      pos = r;
+    }
+  }
+  if (pos < n && !any_reject) EMIT(pos, n - pos, 14);
+#undef EMIT
+#undef REJECT
+  *n_spans = count;
+  return 0;
+}
+
+// ---------------------------------------------------------------------
+// Pad-pack: span records of one chunk → padded [g, max_bytes] rows,
+// written straight into the caller-provided batch slice (the staging
+// BufferPool hand-off).  Replaces the Python-side broadcast gather
+// (feed/device_feed.py _gather_rows_into), whose [g, max_bytes] int
+// index array cost 4-8 bytes of traffic per padded byte.  Handles both
+// direct-payload spans (flags 0/2: memcpy + zero tail) and the rare
+// escaped-magic regions (flags 1/3: segment reassembly with magic
+// re-insertion, truncated at max_bytes).  Returns 0, or -1 when a span
+// walks outside the chunk (corrupt span table).
+long dmlc_pad_pack_rows(const uint8_t* src, long src_len,
+                        const uint64_t* spans, long n_rows, uint32_t magic,
+                        long max_bytes, uint8_t* out_rows,
+                        int32_t* out_lens) {
+  for (long i = 0; i < n_rows; ++i) {
+    long off = (long)spans[3 * i];
+    long len = (long)spans[3 * i + 1];
+    long flag = (long)spans[3 * i + 2];
+    uint8_t* row = out_rows + i * max_bytes;
+    if (off < 0 || len < 0 || off > src_len || len > src_len - off)
+      return -1;
+    if ((flag & 1) == 0) {
+      long m = len < max_bytes ? len : max_bytes;
+      memcpy(row, src + off, (size_t)m);
+      if (m < max_bytes) memset(row + m, 0, (size_t)(max_bytes - m));
+      out_lens[i] = (int32_t)m;
+    } else {
+      // multi-segment region: [magic|lrec[|crc]|payload|pad]* with the
+      // elided magic re-inserted between segments
+      long hdr = flag == 3 ? 12 : 8;
+      long pos = off, end = off + len, at = 0;
+      int first = 1;
+      while (pos + hdr <= end && at < max_bytes) {
+        uint32_t lrec;
+        memcpy(&lrec, src + pos + 4, 4);
+        long sl = (long)(lrec & ((1u << 29u) - 1u));
+        if (pos + hdr + sl > end) return -1;
+        if (!first) {
+          long m = 4 < max_bytes - at ? 4 : max_bytes - at;
+          memcpy(row + at, &magic, (size_t)m);
+          at += m;
+        }
+        if (at < max_bytes) {
+          long m = sl < max_bytes - at ? sl : max_bytes - at;
+          memcpy(row + at, src + pos + hdr, (size_t)m);
+          at += m;
+        }
+        first = 0;
+        uint32_t cf = lrec >> 29u;
+        pos += hdr + ((sl + 3u) & ~3u);
+        if ((cf & 3u) == 0u || (cf & 3u) == 3u) break;
+      }
+      if (at < max_bytes) memset(row + at, 0, (size_t)(max_bytes - at));
+      out_lens[i] = (int32_t)at;
+    }
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------
+// CSR → padded batch (feed/device_feed.py pack_rowblock, native): rows
+// [0, b) of a CSR block written as {label [B], value [B,K], index
+// [B,K], mask [B,K]} with per-row truncation at K, zero padding, and
+// the num_col upper clamp — bit-identical to the numpy path (incl. its
+// clamped-read behavior when offsets run past the value array).
+long dmlc_pad_pack_csr(const float* labels, const uint64_t* offsets,
+                       const uint32_t* index, const float* value,
+                       long nnz_size, long b, long batch_size, long max_nnz,
+                       long num_col, float* out_label, float* out_value,
+                       int32_t* out_index, float* out_mask) {
+  for (long i = 0; i < b; ++i) out_label[i] = labels[i];
+  for (long i = b; i < batch_size; ++i) out_label[i] = 0.0f;
+  long cells = batch_size * max_nnz;
+  if (b == 0 || nnz_size == 0) {
+    memset(out_value, 0, (size_t)cells * 4);
+    memset(out_index, 0, (size_t)cells * 4);
+    memset(out_mask, 0, (size_t)cells * 4);
+    return 0;
+  }
+  for (long i = 0; i < b; ++i) {
+    long off = (long)offsets[i];
+    long rl = (long)(offsets[i + 1] - offsets[i]);
+    // non-monotone (corrupt) offsets wrap the uint64 subtraction; the
+    // numpy twin zero-fills such rows, and a negative m would start
+    // the zero-fill loop out of bounds
+    if (rl < 0) rl = 0;
+    long m = rl < max_nnz ? rl : max_nnz;
+    float* v = out_value + i * max_nnz;
+    int32_t* x = out_index + i * max_nnz;
+    float* mk = out_mask + i * max_nnz;
+    for (long j = 0; j < m; ++j) {
+      // numpy parity: reads are clamped to the last element (the mask
+      // keeps them from mattering on well-formed CSR)
+      long s = off + j < nnz_size ? off + j : nnz_size - 1;
+      v[j] = value[s];
+      x[j] = (int32_t)index[s];
+      mk[j] = 1.0f;
+    }
+    for (long j = m; j < max_nnz; ++j) {
+      v[j] = 0.0f;
+      x[j] = 0;
+      mk[j] = 0.0f;
+    }
+  }
+  long pad = (batch_size - b) * max_nnz;
+  if (pad > 0) {
+    memset(out_value + b * max_nnz, 0, (size_t)pad * 4);
+    memset(out_index + b * max_nnz, 0, (size_t)pad * 4);
+    memset(out_mask + b * max_nnz, 0, (size_t)pad * 4);
+  }
+  if (num_col > 0) {
+    int32_t cap = (int32_t)(num_col - 1);
+    for (long i = 0; i < cells; ++i)
+      if (out_index[i] > cap) out_index[i] = cap;
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------
+// LibSVM text → padded batch, fused (tokenize + pad-pack in ONE pass,
+// no intermediate CSR): parses lines from buf[start:n] and writes each
+// row straight into the caller's padded arrays at [*rows_out,
+// batch_rows), zero-filling row tails, truncating (but still
+// consuming) features past max_nnz, clamping indices to num_col-1 when
+// num_col > 0.  Stops at batch_rows rows or end of input;
+// *consumed_out is the offset of the first unparsed byte (a line
+// boundary), so the caller re-enters after emitting the batch.  The
+// feed runs one call per (chunk window, batch) with the GIL released,
+// so DMLC_FEED_WORKERS partition threads genuinely overlap.
+// Returns 0 ok, -2 malformed input.
+long dmlc_parse_libsvm_into(const char* buf, long n, long start,
+                            long row_base, long batch_rows, long max_nnz,
+                            long num_col, float* out_label, float* out_value,
+                            int32_t* out_index, float* out_mask,
+                            long* rows_out, long* consumed_out) {
+  const char* p = buf + start;
+  const char* end = buf + n;
+  long r = row_base;
+  *rows_out = r;
+  *consumed_out = start;
+  while (p != end && r < batch_rows) {
+    const char* line_end = static_cast<const char*>(memchr(p, '\n',
+                                                           end - p));
+    if (line_end == nullptr) line_end = end;
+    const char* q = skip_blank(p, line_end);
+    if (q != line_end) {
+      double label;
+      q = parse_float(q, line_end, &label);
+      if (q == nullptr) return -2;
+      if (q != line_end && *q == ':') {  // weight: consumed, not packed
+        double w;
+        q = parse_float(q + 1, line_end, &w);
+        if (q == nullptr) return -2;
+      }
+      out_label[r] = (float)label;
+      float* v = out_value + r * max_nnz;
+      int32_t* x = out_index + r * max_nnz;
+      float* mk = out_mask + r * max_nnz;
+      long nnz = 0;
+      while (true) {
+        q = skip_blank(q, line_end);
+        if (q == line_end) break;
+        uint64_t a;
+        q = parse_uint(q, line_end, &a);
+        if (q == nullptr) return -2;
+        double val = 1.0;  // omitted value => implicit 1.0
+        if (q != line_end && *q == ':') {
+          q = parse_float(q + 1, line_end, &val);
+          if (q == nullptr) return -2;
+        }
+        if (nnz < max_nnz) {
+          int32_t xi = (int32_t)(uint32_t)a;
+          if (num_col > 0 && xi > (int32_t)(num_col - 1))
+            xi = (int32_t)(num_col - 1);
+          v[nnz] = (float)val;
+          x[nnz] = xi;
+          mk[nnz] = 1.0f;
+        }
+        ++nnz;  // features past max_nnz are consumed but not packed
+      }
+      for (long j = nnz < max_nnz ? nnz : max_nnz; j < max_nnz; ++j) {
+        v[j] = 0.0f;
+        x[j] = 0;
+        mk[j] = 0.0f;
+      }
+      ++r;
+    }
+    p = (line_end == end) ? end : line_end + 1;
+    *rows_out = r;
+    *consumed_out = p - buf;
+  }
+  return 0;
+}
+
+int dmlc_native_abi_version() { return 6; }
 
 }  // extern "C"

@@ -11,6 +11,7 @@ buffering), so parse overlaps transfer and steady state allocates
 nothing — see device_feed.DeviceFeed and README "Feed pipeline".
 """
 
+from .autotune import FeedAutotuner  # noqa: F401
 from .device_feed import (  # noqa: F401
     DeviceFeed,
     libsvm_feed,

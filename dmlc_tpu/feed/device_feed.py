@@ -65,6 +65,11 @@ def pack_rowblock(blk, batch_size: int, max_nnz: int, num_col: int = 0,
     place and returned, so a hot loop that copies batches onward anyway
     — the DeviceFeed staging pipeline — reuses one output buffer per
     iterator instead of allocating four arrays per batch.
+
+    Hot path: the whole pad-pack runs in ONE native call
+    (``dmlc_pad_pack_csr``, cpp/dmlc_native.cc) writing the four arrays
+    in place; the numpy broadcast-gather below is the bit-identical
+    fallback (``DMLC_TPU_DISABLE_NATIVE=1``).
     """
     if out is None:
         out = {"label": np.empty(batch_size, np.float32),
@@ -74,6 +79,18 @@ def pack_rowblock(blk, batch_size: int, max_nnz: int, num_col: int = 0,
     label, value = out["label"], out["value"]
     index, mask = out["index"], out["mask"]
     b = min(batch_size, blk.size)
+    _expect = (("label", np.float32, (batch_size,)),
+               ("value", np.float32, (batch_size, max_nnz)),
+               ("index", np.int32, (batch_size, max_nnz)),
+               ("mask", np.float32, (batch_size, max_nnz)))
+    if all(out[k].flags["C_CONTIGUOUS"] and out[k].dtype == dt
+           and out[k].shape == shp for k, dt, shp in _expect):
+        from .. import native
+
+        if native.pad_pack_csr(blk.label[:b], blk.offset[: b + 1],
+                               blk.index, blk.value, b, batch_size,
+                               max_nnz, num_col, out):
+            return out
     label[b:] = 0
     label[:b] = blk.label[:b]
     src_val = np.asarray(blk.value)
@@ -92,8 +109,10 @@ def pack_rowblock(blk, batch_size: int, max_nnz: int, num_col: int = 0,
     sel = ar[None, :] < lens[:, None]                        # [b, K]
     src = np.minimum(offsets[:-1, None] + ar[None, :], src_val.size - 1)
     value[b:] = 0
-    value[:b] = src_val[src]
-    value[:b] *= sel
+    # masked cells are WRITTEN zero, not multiplied to zero: the clamped
+    # gather reads neighboring rows' values, and NaN/Inf * 0 = NaN would
+    # leak garbage into padding (and diverge from the native path)
+    value[:b] = np.where(sel, src_val[src], np.float32(0))
     index[b:] = 0
     index[:b] = src_idx[src]
     index[:b] *= sel
@@ -207,6 +226,34 @@ class DeviceFeed:
         self._workers = max(1, min(n_parts, num_workers
                             or get_env("DMLC_FEED_WORKERS",
                                        min(4, os.cpu_count() or 2))))
+        # post-placement batch hook (producer side): recordio_feed's
+        # packed-transport mode installs its on-device expander here
+        # (it needs the constructed feed's sharding, so it cannot be a
+        # constructor argument)
+        self._transform = None
+        # ledger-driven auto-tuning: when DMLC_FEED_AUTOTUNE=1, the
+        # controller watches the step ledger's feed-wait fraction and
+        # re-sizes workers/depth within bounds at every epoch boundary
+        # (worker→partition assignment is w mod W, so W may only change
+        # between epochs without breaking per-partition batch order).
+        # The ledger's feed-wait is a property of the TRAINING STEP, so
+        # the signal assumes this is the one feed the ledgered loop
+        # consumes — with several concurrently-autotuned feeds, each
+        # would adapt to wait the others caused (enable the knob for
+        # the training feed only)
+        self._autotuner = None
+        if get_env("DMLC_FEED_AUTOTUNE", False):
+            from .autotune import FeedAutotuner
+
+            wmax = get_env("DMLC_FEED_WORKERS_MAX", 0) or \
+                (os.cpu_count() or 2)
+            self._autotuner = FeedAutotuner(
+                workers=self._workers, depth=self._depth,
+                min_workers=max(1, get_env("DMLC_FEED_WORKERS_MIN", 1)),
+                max_workers=max(1, min(n_parts, wmax)),
+                max_depth=max(self._depth,
+                              get_env("DMLC_FEED_DEPTH_MAX", 4)))
+            self._ledger_seen_seq = 0
         self._queue: Queue = Queue(maxsize=self._depth)
         self.part_iters: list = []
         self._part_done = [False] * n_parts
@@ -415,6 +462,15 @@ class DeviceFeed:
                         telemetry.timed("feed", "device_put"):
                     dev = self._place(slot)
                 dev["parts_alive"] = slot.alive.astype(np.float32)
+                if self._transform is not None:
+                    # e.g. the padded feed's on-device expansion: runs
+                    # on this placer thread so it overlaps the
+                    # consumer's step, and the staging recycle below
+                    # still waits on the PRE-transform arrays it fed
+                    staged = dev
+                    dev = self._transform(staged)
+                else:
+                    staged = dev
                 # count bytes actually shipped: drained partitions ride
                 # cached device-resident zero shards, not the link
                 nbytes = (sum(v.nbytes // self._n_parts
@@ -439,7 +495,7 @@ class DeviceFeed:
                 # recycled for a later step (device arrays never alias
                 # host staging memory after this point)
                 jax.block_until_ready(
-                    [dev[k] for k in self._template.keys()])
+                    [staged[k] for k in self._template.keys()])
                 self._pool.release(slot.sbuf)
                 step += 1
         except BaseException as e:  # surface on the consumer side
@@ -467,6 +523,7 @@ class DeviceFeed:
                 "pass iterator factories (callables) for multi-epoch use"
             )
         self._epochs_started += 1
+        self._apply_autotune()
         self.part_iters = [s() if callable(s) else s for s in self._sources]
         self._part_done = [False] * self._n_parts
         self._n_dead = 0
@@ -507,6 +564,44 @@ class DeviceFeed:
 
     def _make_staging(self) -> _StagingBuf:
         return _StagingBuf(self._template, self._n_parts)
+
+    # ---- ledger-driven auto-tuning -------------------------------------
+    def _apply_autotune(self) -> None:
+        """Epoch-boundary controller step: feed the StepLedger's recent
+        feed-wait fraction to the FeedAutotuner and apply its
+        (workers, depth) decision before the pipeline threads spawn.
+        Worker count changes re-map partitions (w mod W) for the FRESH
+        epoch only; depth changes re-size the staging pool, which is
+        rebuilt per epoch anyway."""
+        if self._autotuner is None:
+            return
+        from .. import telemetry
+
+        recs, last = telemetry.ledger().records_since(
+            self._ledger_seen_seq)
+        walls = sum(r["wall_s"] for r in recs)
+        if len(recs) < self._autotuner.window or walls <= 0:
+            # too thin to decide — do NOT advance the seen-seq, so
+            # short epochs (fewer steps than the window) accumulate
+            # evidence across boundaries instead of discarding it
+            telemetry.set_gauge("feed", "autotune_workers", self._workers)
+            telemetry.set_gauge("feed", "autotune_depth", self._depth)
+            return
+        self._ledger_seen_seq = last
+        fw = sum(r["feed_wait_s"] for r in recs) / walls
+        workers, depth = self._autotuner.observe(fw)
+        workers = max(1, min(self._n_parts, workers))
+        if workers != self._workers or depth != self._depth:
+            from ..logging import info
+
+            info(f"feed autotune: feed-wait {fw:.2f} over {len(recs)} "
+                 f"steps -> workers {self._workers}->{workers}, "
+                 f"depth {self._depth}->{depth}")
+            telemetry.inc("feed", "autotune_adjustments")
+            self._workers = workers
+            self._depth = depth
+        telemetry.set_gauge("feed", "autotune_workers", self._workers)
+        telemetry.set_gauge("feed", "autotune_depth", self._depth)
 
     # ---- elastic repartition -------------------------------------------
     @staticmethod
@@ -622,10 +717,18 @@ def libsvm_feed(uri: str, mesh, *, batch_size: int, max_nnz: int,
     batch_size * dp_size * sp_size.  ``world=(rank, world_size)``
     partitions across an elastic multi-process world (resizable via
     :meth:`DeviceFeed.resize`).
-    """
-    from ..data import create_row_iter
 
-    def part_iter(part: int, n_parts: int):
+    LibSVM URIs without a ``#cachefile`` take the fused native path:
+    one ``dmlc_parse_libsvm_into`` call per (chunk window, batch)
+    tokenizes the text AND writes the padded batch arrays in place —
+    no intermediate CSR, no per-token Python ``float()`` loop, GIL
+    released so DMLC_FEED_WORKERS partition threads genuinely overlap.
+    The classic parser path below is the bit-identical fallback (and
+    serves csv/libfm and cached URIs)."""
+    from ..data import create_row_iter
+    from ..io.uri import URISpec
+
+    def part_iter_classic(part: int, n_parts: int):
         it = create_row_iter(uri, part, n_parts, fmt)
         ncol = it.num_col()
         out = None
@@ -639,6 +742,52 @@ def libsvm_feed(uri: str, mesh, *, batch_size: int, max_nnz: int,
                                     out=out)
                 yield out
 
+    def part_iter_fused(part: int, n_parts: int):
+        from .. import native, telemetry
+        from ..io import input_split as isplit
+
+        if not native.available():  # e.g. disabled since construction
+            yield from part_iter_classic(part, n_parts)
+            return
+        split = isplit.create(uri, part, n_parts, "text")
+        try:
+            # ONE borrowed batch dict per iterator, rows written in
+            # place by the fused native tokenizer; num_col clamping is
+            # a no-op here by construction (the classic path clamps to
+            # the partition's own max index + 1, which no parsed index
+            # can exceed), so batches stay bit-identical
+            out = {"label": np.zeros(batch_size, np.float32),
+                   "value": np.zeros((batch_size, max_nnz), np.float32),
+                   "index": np.zeros((batch_size, max_nnz), np.int32),
+                   "mask": np.zeros((batch_size, max_nnz), np.float32)}
+            r = 0
+            while True:
+                chunk = split.next_chunk()
+                if chunk is None:
+                    break
+                start, n = 0, len(chunk)
+                while start < n:
+                    with telemetry.span("feed.parse_native",
+                                        stage="feed"), \
+                            telemetry.timed("feed", "parse_native"):
+                        r, start = native.parse_libsvm_into(
+                            chunk, start, r, max_nnz, 0, out)
+                    if r == batch_size:
+                        yield out
+                        r = 0
+            if r:  # epoch-tail short batch: zero-pad like pack_rowblock
+                out["label"][r:] = 0
+                out["value"][r:] = 0
+                out["index"][r:] = 0
+                out["mask"][r:] = 0
+                yield out
+        finally:
+            split.close()
+
+    spec = URISpec(uri, 0, 1)
+    fused = (fmt == "libsvm" and spec.cache_file is None
+             and spec.args.get("format", "libsvm") == "libsvm")
+    part_iter = part_iter_fused if fused else part_iter_classic
     # factories, not iterators: each epoch re-creates the row iters (which
     # hit the DiskRowIter/#cachefile cache when the URI requests one)
     builder = lambda p, n: functools.partial(part_iter, p, n)  # noqa: E731
@@ -646,36 +795,60 @@ def libsvm_feed(uri: str, mesh, *, batch_size: int, max_nnz: int,
                       source_builder=builder, world=world)
 
 
-def _py_chunk_spans(mv: memoryview, source=None, base=None):
-    """Validated Python header walk producing (offset, len, flag)
-    triples — flags 0/1 plain, 2/3 checksummed (even = direct payload
-    span, odd = multi-segment region).  Under a non-``raise``
-    DMLC_INTEGRITY_POLICY a structurally corrupt region is counted and
-    resynced past (next record head) instead of failing the epoch;
-    ``source``/``base`` key the poisoned span for the quarantine
-    skip-list exactly like the verified-crc path."""
+#: reject kinds emitted by the fused scanners (flag >= 8), rendered as
+#: the same message strings the pre-fused walkers reported
+_REJECT_WHAT = {
+    8: "bad magic",
+    9: "truncated payload",
+    10: "torn multi-segment record",
+    11: "missing end segment",
+    13: "crc32c mismatch",
+    14: "torn tail (sub-word remainder)",
+}  # kind 12 renders with the offending cflag read back from the chunk
+
+
+def _py_chunk_spans(mv: memoryview, verify: bool = True):
+    """Pure fused single-pass walker — the Python twin of the native
+    ``dmlc_recordio_spans_verify`` scanner (ABI 6), held to byte-
+    identical triple tables by the differential test matrix.  Produces
+    (offset, len, flag) triples: flags 0/1 plain, 2/3 checksummed
+    (CRC32C-verified inline when ``verify``), and TYPED REJECTS with
+    flag >= 8 covering [begin, resync point) for every corruption —
+    bad magic, truncated/torn structure, crc mismatch, stray sub-word
+    tail (the tail reject is suppressed when the chunk already
+    reported; the other report covers those bytes).  No integrity
+    policy is applied here: :func:`_verify_spans` routes rejects."""
     from ..io import integrity
     from ..io.recordio import CRC_BIT, HEAD_CFLAGS, _MAGIC_BYTES, _U32, \
-        decode_flag, decode_length, find_next_record_head
-
-    corrupt_seen = False
-
-    def bad(pos, what):
-        nonlocal corrupt_seen
-        corrupt_seen = True
-        nxt = min(n, pos + 4)
-        nxt += (-nxt) % 4
-        nxt = find_next_record_head(mv, nxt, n - n % 4)
-        integrity.handle_corrupt(  # raises under policy 'raise'
-            what, source=source,
-            begin=None if base is None else base + pos,
-            end=None if base is None else base + nxt)
-        return nxt
+        decode_flag, decode_length, find_next_record_head, stored_crc
 
     triples, pos, n = [], 0, len(mv)
+    any_reject = False
+
+    def resync(p):
+        nxt = min(n, p + 4)
+        nxt += (-nxt) % 4
+        end = n - n % 4
+        return find_next_record_head(mv, nxt, end) if nxt < end else end
+
+    def region_crc_ok(off, ln):
+        p2, end2 = off, off + ln
+        while p2 + 12 <= end2:
+            lrec2 = _U32.unpack_from(mv, p2 + 4)[0]
+            want = _U32.unpack_from(mv, p2 + 8)[0]
+            m = decode_length(lrec2)
+            if stored_crc(integrity.crc32c(
+                    mv[p2 + 12: p2 + 12 + m])) != want:
+                return False
+            p2 += 12 + ((m + 3) & ~3)
+        return True
+
     while pos + 8 <= n:
         if mv[pos:pos + 4] != _MAGIC_BYTES:
-            pos = bad(pos, "bad magic")
+            r = resync(pos)
+            triples.append((pos, r - pos, 8))
+            any_reject = True
+            pos = r
             continue
         lrec = _U32.unpack_from(mv, pos + 4)[0]
         cflag, ln = decode_flag(lrec), decode_length(lrec)
@@ -684,131 +857,125 @@ def _py_chunk_spans(mv: memoryview, source=None, base=None):
         if cflag & 3 == 0 and cflag in HEAD_CFLAGS:
             nxt = pos + hdr + ((ln + 3) & ~3)
             if nxt > n:
-                pos = bad(pos, "truncated payload")
+                r = resync(pos)
+                triples.append((pos, r - pos, 9))
+                any_reject = True
+                pos = r
                 continue
+            if ck and verify:
+                want = _U32.unpack_from(mv, pos + 8)[0]
+                if stored_crc(integrity.crc32c(
+                        mv[pos + hdr: pos + hdr + ln])) != want:
+                    # span = [head, payload end): the quarantine key
+                    triples.append((pos, hdr + ln, 13))
+                    any_reject = True
+                    pos = nxt
+                    continue
             triples.append((pos + hdr, ln, 2 if ck else 0))
             pos = nxt
         elif cflag & 3 == 1 and cflag in HEAD_CFLAGS:
             start = pos
-            pos += hdr + ((ln + 3) & ~3)
-            ok = True
+            p = pos + hdr + ((ln + 3) & ~3)
+            kind = 0  # 0 = structurally sound
             while True:
-                if pos + hdr > n or mv[pos:pos + 4] != _MAGIC_BYTES:
-                    pos = bad(start, "torn multi-segment record")
-                    ok = False
+                if p + hdr > n or mv[p:p + 4] != _MAGIC_BYTES:
+                    kind = 10
                     break
-                lrec = _U32.unpack_from(mv, pos + 4)[0]
+                lrec = _U32.unpack_from(mv, p + 4)[0]
                 cf, l2 = decode_flag(lrec), decode_length(lrec)
                 if cf & 3 not in (2, 3) or (cf >= CRC_BIT) != ck:
-                    pos = bad(start, "missing end segment")
-                    ok = False
+                    kind = 11
                     break
-                pos += hdr + ((l2 + 3) & ~3)
-                if pos > n:
-                    pos = bad(start, "truncated payload")
-                    ok = False
+                p += hdr + ((l2 + 3) & ~3)
+                if p > n:
+                    kind = 9
                     break
                 if cf & 3 == 3:
                     break
-            if ok:
-                triples.append((start, pos - start, 3 if ck else 1))
+            if kind:
+                r = resync(start)
+                triples.append((start, r - start, kind))
+                any_reject = True
+                pos = r
+                continue
+            if ck and verify and not region_crc_ok(start, p - start):
+                triples.append((start, p - start, 13))
+                any_reject = True
+            else:
+                triples.append((start, p - start, 3 if ck else 1))
+            pos = p
         else:
-            pos = bad(pos, f"cflag {cflag} at record head")
-    if pos < n and not corrupt_seen:
-        # stray bytes no 8-byte header fits in — same contract as
-        # RecordIOChunkReader: loud under policy 'raise', counted
-        # otherwise (suppressed when this chunk already reported; the
-        # truncated-record report there covers these bytes)
-        integrity.handle_corrupt(
-            "torn tail (sub-word remainder)", source=source,
-            begin=None if base is None else base + pos,
-            end=None if base is None else base + n)
+            r = resync(pos)
+            triples.append((pos, r - pos, 12))
+            any_reject = True
+            pos = r
+    if pos < n and not any_reject:
+        triples.append((pos, n - pos, 14))
     return np.asarray(triples, np.uint64).reshape(-1, 3)
 
 
 def _chunk_spans(mv: memoryview, source=None, base=None):
     """Span triples (offset, len, flag) for one record-aligned RecordIO
-    chunk: native scan, or a validated Python header walk as fallback.
-    Checksummed spans (flags 2/3) are CRC32C-verified here; corrupt and
-    quarantined records are dropped per DMLC_INTEGRITY_POLICY.
-    ``source``/``base`` key quarantined spans as (uri, global byte
-    offset of the record head)."""
-    from .. import native
-    from ..io import integrity
+    chunk via the fused single-pass scan: structure walk + inline
+    CRC32C verification in ONE native call (Python twin as fallback),
+    typed rejects routed through DMLC_INTEGRITY_POLICY, quarantined
+    spans dropped on replay.  ``source``/``base`` key quarantined spans
+    as (uri, global byte offset of the record head).  Since PR 11 the
+    crc never costs a second pass over the chunk — the ``feed.crc``
+    stage below times only the residual reject/skip-list routing."""
+    from .. import native, telemetry
     from ..io.recordio import KMAGIC
 
-    try:
-        sp = native.recordio_spans(mv, KMAGIC)
-    except ValueError:
-        # structurally corrupt chunk: re-walk in Python so the fault is
-        # classified through the integrity policy (CorruptRecord under
-        # 'raise' — counted, with the poisoned span keyed — instead of
-        # the native scanner's bare ValueError; count + resync past it
-        # otherwise)
-        sp = _py_chunk_spans(mv, source, base)
-    if sp is None:  # no native library: walk headers in Python
-        sp = _py_chunk_spans(mv, source, base)
-    return _verify_spans(mv, sp, source, base)
+    with telemetry.span("feed.parse_native", stage="feed"), \
+            telemetry.timed("feed", "parse_native"):
+        sp = native.recordio_spans(mv, KMAGIC, verify=True)
+        if sp is None:  # no native library: fused Python walk
+            sp = _py_chunk_spans(mv)
+    with telemetry.timed("feed", "crc"):
+        return _verify_spans(mv, sp, source, base)
 
 
 def _verify_spans(mv: memoryview, sp, source, base):
-    """Filter a chunk's span table through the integrity layer: verify
-    checksummed records, apply the corruption policy, and drop
-    skip-listed (quarantined) spans on replay.  The all-plain fast path
-    is one vectorized compare per chunk."""
+    """Route a fused scan's span table through the integrity layer:
+    typed rejects (flag >= 8) are reported under the active
+    DMLC_INTEGRITY_POLICY (raise / skip / quarantine) and dropped;
+    skip-listed (quarantined) spans are dropped on replay.  Verification
+    itself already happened inside the scan — the common clean-chunk
+    path is one vectorized compare and no byte is re-read."""
     from ..io import integrity
-    from ..io.recordio import _U32, stored_crc
+    from ..io.recordio import _U32, decode_flag
 
     if sp.shape[0] == 0:
         return sp
     flags = sp[:, 2]
-    checked = flags >= 2
+    rejects = flags >= 8
     listed = integrity.has_quarantine(source)
-    if not checked.any() and not listed:
+    if not rejects.any() and not listed:
         return sp
     keep = np.ones(sp.shape[0], bool)
-    for i in np.nonzero(checked)[0]:
-        off, ln, flag = int(sp[i, 0]), int(sp[i, 1]), int(sp[i, 2])
-        head = off - 12 if flag == 2 else off
-        gbegin = None if base is None else base + head
-        if integrity.should_drop(source, gbegin):
-            keep[i] = False
+    for i in np.nonzero(rejects)[0]:
+        keep[i] = False
+        off, ln, kind = int(sp[i, 0]), int(sp[i, 1]), int(sp[i, 2])
+        gbegin = None if base is None else base + off
+        if kind == 13 and integrity.should_drop(source, gbegin):
+            # quarantined on a previous (poisoned) pass: the replay
+            # contract counts a skip-list drop, not a fresh report
             continue
-        if flag == 2:
-            want = _U32.unpack_from(mv, off - 4)[0]
-            ok = stored_crc(integrity.crc32c(mv[off:off + ln])) == want
+        if kind == 12:
+            cf = decode_flag(_U32.unpack_from(mv, off + 4)[0])
+            what = f"cflag {cf} at record head"
         else:
-            ok = _verify_region(mv, off, ln)
-        if not ok:
-            integrity.handle_corrupt(
-                "crc32c mismatch", source=source, begin=gbegin,
-                end=None if gbegin is None else base + off + ln)
-            keep[i] = False
+            what = _REJECT_WHAT[kind]
+        integrity.handle_corrupt(  # raises under policy 'raise'
+            what, source=source, begin=gbegin,
+            end=None if base is None else base + off + ln)
     if listed and base is not None:
-        for i in np.nonzero(~checked)[0]:
+        for i in np.nonzero(~rejects)[0]:
             off, flag = int(sp[i, 0]), int(sp[i, 2])
-            head = off - 8 if flag == 0 else off
+            head = off - 12 if flag == 2 else off - 8 if flag == 0 else off
             if integrity.should_drop(source, base + head):
                 keep[i] = False
     return sp if keep.all() else sp[keep]
-
-
-def _verify_region(mv: memoryview, off: int, ln: int) -> bool:
-    """CRC-verify every segment of one checksummed multi-segment
-    region (flag 3)."""
-    from ..io import integrity
-    from ..io.recordio import _U32, decode_length, stored_crc
-
-    pos, end = off, off + ln
-    while pos + 12 <= end:
-        lrec = _U32.unpack_from(mv, pos + 4)[0]
-        want = _U32.unpack_from(mv, pos + 8)[0]
-        n = decode_length(lrec)
-        seg = mv[pos + 12: pos + 12 + n]
-        if stored_crc(integrity.crc32c(seg)) != want:
-            return False
-        pos += 12 + ((n + 3) & ~3)
-    return True
 
 
 def _reassemble_region(mv: memoryview, off: int, ln: int) -> bytes:
@@ -860,9 +1027,22 @@ def _gather_rows_into(mv: memoryview, sp, lo: int, hi: int,
     a single broadcast numpy gather straight into the batch buffer (no
     per-record Python loop, no intermediate row array).
 
-    The span scan yields (offset, len, flag) per logical record; flag-0
-    payloads are gathered with a broadcast index, the rare flag-1
-    multi-segment records are reassembled individually afterwards."""
+    The span scan yields (offset, len, flag) per logical record; the
+    hot path is ONE native call (``dmlc_pad_pack_rows``: memcpy +
+    zero-fill per row, escaped-magic reassembly in place) writing
+    straight into the batch buffer.  The numpy broadcast gather below
+    is the bit-identical fallback (``DMLC_TPU_DISABLE_NATIVE=1``)."""
+    from .. import native
+    from ..io.recordio import KMAGIC
+
+    g = hi - lo
+    rows_out = out_rows[:g]
+    lens_out = out_lens[:g]
+    if (rows_out.flags["C_CONTIGUOUS"] and lens_out.flags["C_CONTIGUOUS"]
+            and lens_out.dtype == np.int32
+            and native.pad_pack_rows(mv, sp[lo:hi], KMAGIC, max_bytes,
+                                     rows_out, lens_out)):
+        return
     arr = np.frombuffer(mv, np.uint8)
     offs = sp[lo:hi, 0].astype(np.int32)   # chunk-local: always < 2^31
     lens = np.minimum(sp[lo:hi, 1].astype(np.int64), max_bytes)
@@ -881,6 +1061,87 @@ def _gather_rows_into(mv: memoryview, sp, lo: int, hi: int,
     out_lens[:g] = lens
 
 
+def _packed_part_iter(uri: str, part: int, n_parts: int, buf_bytes: int,
+                      max_records: int, guard_bytes: int = 0):
+    """One partition of RecordIO shards as packed batches:
+    {data [buf_bytes + guard_bytes] uint8, offsets [max_records+1]
+    int32, count [1]} with record payloads packed back-to-back in
+    ``data[:buf_bytes]`` (``guard_bytes`` stays zero — the padded
+    transform's dynamic-slice guard region).
+
+    Batches assemble IN PLACE: record payloads go straight from the
+    mapped chunk into the static batch buffer via one native pack call
+    per (chunk, batch) pair (cpp/dmlc_native.cc dmlc_pack_spans) — no
+    intermediate pending-payload array, no concat chain, no second
+    copy.  The batch dict is BORROWED (DeviceFeed copies it into the
+    staging buffer before resuming this generator), so ONE
+    data/offsets/count buffer serves the whole epoch — zero
+    steady-state allocation."""
+    from .. import native, telemetry
+    from ..io import input_split
+
+    split = input_split.create(uri, part, n_parts, "recordio")
+    try:
+        data = np.empty(buf_bytes + guard_bytes, np.uint8)
+        pack_dst = data[:buf_bytes]
+        offsets = np.empty(max_records + 1, np.int32)
+        count_arr = np.empty(1, np.int32)
+        ends = np.empty(max_records, np.int64)
+        count = 0
+        pos = 0
+
+        def emit():
+            nonlocal count, pos
+            data[pos:] = 0  # zero tail (and guard) only, not the buffer
+            np.minimum(ends[:count], buf_bytes, out=ends[:count])
+            offsets[0] = 0
+            offsets[1: count + 1] = ends[:count]
+            offsets[count + 1:] = offsets[count]
+            count_arr[0] = count
+            count = 0
+            pos = 0
+            return {"data": data, "offsets": offsets,
+                    "count": count_arr}
+
+        while True:
+            mv = split.next_chunk()
+            if mv is None:
+                break
+            sp = _chunk_spans(
+                mv, source=uri,
+                base=getattr(split, "last_chunk_begin", None))
+            if (sp[:, 2] % 2 == 0).all():
+                # direct-payload spans (plain or verified
+                # checksummed): pack straight from the chunk
+                src = mv
+                offs = sp[:, 0].astype(np.int64)
+                lens = sp[:, 1].astype(np.int64)
+            else:  # rare escaped-magic chunk: flatten, then pack
+                views = _chunk_record_views(mv, sp)
+                lens = np.fromiter((v.size for v in views),
+                                   np.int64, count=len(views))
+                src = (np.concatenate(views) if views
+                       else np.empty(0, np.uint8))
+                offs = np.zeros(len(views), np.int64)
+                if len(views) > 1:
+                    np.cumsum(lens[:-1], out=offs[1:])
+            i = 0
+            n_spans = len(lens)
+            while i < n_spans:
+                with telemetry.timed("feed", "pack"):
+                    consumed, pos, full = native.pack_spans(
+                        src, offs[i:], lens[i:], pack_dst, pos,
+                        max_records - count, count == 0, ends[count:])
+                count += consumed
+                i += consumed
+                if full:
+                    yield emit()
+        if count:
+            yield emit()
+    finally:
+        split.close()
+
+
 def recordio_packed_feed(uri: str, mesh, *, buf_bytes: int,
                          max_records: int = 4096,
                          queue_depth: Optional[int] = None,
@@ -895,101 +1156,111 @@ def recordio_packed_feed(uri: str, mesh, *, buf_bytes: int,
     ``world=(rank, world_size)`` partitions across an elastic
     multi-process world (resizable via :meth:`DeviceFeed.resize`).
     """
-    from ..io import input_split
-
     def part_iter(part: int, n_parts: int):
-        from .. import native
-
-        split = input_split.create(uri, part, n_parts, "recordio")
-        try:
-            # batches assemble IN PLACE: record payloads go straight
-            # from the mapped chunk into the static [buf_bytes] batch
-            # buffer via one native pack call per (chunk, batch) pair
-            # (cpp/dmlc_native.cc dmlc_pack_spans) — no intermediate
-            # pending-payload array, no concat chain, no second copy.
-            # The round-4 producer profile showed exactly those copies
-            # as the remaining Python-side cost of the packed path.
-            # The batch dict is BORROWED (DeviceFeed copies it into the
-            # staging buffer before resuming this generator), so ONE
-            # data/offsets/count buffer serves the whole epoch — zero
-            # steady-state allocation.
-            data = np.empty(buf_bytes, np.uint8)
-            offsets = np.empty(max_records + 1, np.int32)
-            count_arr = np.empty(1, np.int32)
-            ends = np.empty(max_records, np.int64)
-            count = 0
-            pos = 0
-
-            def emit():
-                nonlocal count, pos
-                data[pos:] = 0  # zero tail only, not the whole buffer
-                np.minimum(ends[:count], buf_bytes, out=ends[:count])
-                offsets[0] = 0
-                offsets[1: count + 1] = ends[:count]
-                offsets[count + 1:] = offsets[count]
-                count_arr[0] = count
-                count = 0
-                pos = 0
-                return {"data": data, "offsets": offsets,
-                        "count": count_arr}
-
-            while True:
-                mv = split.next_chunk()
-                if mv is None:
-                    break
-                sp = _chunk_spans(
-                    mv, source=uri,
-                    base=getattr(split, "last_chunk_begin", None))
-                if (sp[:, 2] % 2 == 0).all():
-                    # direct-payload spans (plain or verified
-                    # checksummed): pack straight from the chunk
-                    src = mv
-                    offs = sp[:, 0].astype(np.int64)
-                    lens = sp[:, 1].astype(np.int64)
-                else:  # rare escaped-magic chunk: flatten, then pack
-                    views = _chunk_record_views(mv, sp)
-                    lens = np.fromiter((v.size for v in views),
-                                       np.int64, count=len(views))
-                    src = (np.concatenate(views) if views
-                           else np.empty(0, np.uint8))
-                    offs = np.zeros(len(views), np.int64)
-                    if len(views) > 1:
-                        np.cumsum(lens[:-1], out=offs[1:])
-                i = 0
-                n_spans = len(lens)
-                while i < n_spans:
-                    consumed, pos, full = native.pack_spans(
-                        src, offs[i:], lens[i:], data, pos,
-                        max_records - count, count == 0, ends[count:])
-                    count += consumed
-                    i += consumed
-                    if full:
-                        yield emit()
-            if count:
-                yield emit()
-        finally:
-            split.close()
+        return _packed_part_iter(uri, part, n_parts, buf_bytes,
+                                 max_records)
 
     builder = lambda p, n: functools.partial(part_iter, p, n)  # noqa: E731
     return DeviceFeed(mesh, queue_depth=queue_depth,
                       source_builder=builder, world=world)
 
 
+def _make_padded_expander(feed: DeviceFeed, batch_records: int,
+                          max_bytes: int, stride: int):
+    """On-device expansion for the packed-transport padded feed: one
+    jitted gather per batch turns the packed staging layout
+    ({data, offsets}) into the padded {data [n_parts*B, max_bytes],
+    length} contract AFTER the bytes crossed the host→device link —
+    the link ships payload, the accelerator materializes the padding.
+    Runs on the placer thread, so expansion overlaps the consumer's
+    step like any other producer work."""
+    import jax
+    import jax.numpy as jnp
+
+    n_parts = feed._n_parts
+    B = batch_records
+    sharding = feed.sharding
+
+    @functools.partial(jax.jit, out_shardings=(sharding, sharding))
+    def expand(data, offsets):
+        offs = offsets.reshape(n_parts, B + 1)
+        base = (jnp.arange(n_parts, dtype=jnp.int32) * stride)[:, None]
+        starts = (offs[:, :-1] + base).reshape(-1)
+        lens = jnp.minimum((offs[:, 1:] - offs[:, :-1]).reshape(-1),
+                           max_bytes).astype(jnp.int32)
+        # per-row dynamic_slice under vmap lowers to ONE gather with
+        # row-level (not cell-level) indices; the guard region appended
+        # to each partition's staging block keeps every slice in bounds
+        # so no clamp can shift a window
+        rows = jax.vmap(
+            lambda s: jax.lax.dynamic_slice(data, (s,), (max_bytes,))
+        )(starts)
+        mask = (jnp.arange(max_bytes, dtype=jnp.int32)[None, :]
+                < lens[:, None])
+        return jnp.where(mask, rows, jnp.uint8(0)), lens
+
+    def transform(batch):
+        data, length = expand(batch["data"], batch["offsets"])
+        return {"data": data, "length": length,
+                "parts_alive": batch["parts_alive"]}
+
+    return transform
+
+
 def recordio_feed(uri: str, mesh, *, batch_records: int, max_bytes: int,
                   queue_depth: Optional[int] = None,
-                  world=None) -> DeviceFeed:
+                  world=None,
+                  pack_bytes: Optional[int] = None) -> DeviceFeed:
     """RecordIO shards → {data [B, max_bytes] uint8, length [B] int32}.
 
     Payload decode (e.g. images) happens on device or downstream; this
     feed moves raw record bytes into HBM at full InputSplit throughput.
-    Batch assembly is chunk-at-a-time: the native span scan + one numpy
-    gather per chunk (cpp/dmlc_native.cc dmlc_recordio_spans), not a
-    per-record copy loop.  ``world=(rank, world_size)`` partitions
-    across an elastic multi-process world (resizable via
-    :meth:`DeviceFeed.resize`)."""
+    Batch assembly is chunk-at-a-time: the fused native span scan
+    (+inline CRC32C) and one native pad-pack per span group
+    (cpp/dmlc_native.cc), not a per-record copy loop.
+    ``world=(rank, world_size)`` partitions across an elastic
+    multi-process world (resizable via :meth:`DeviceFeed.resize`).
+
+    ``pack_bytes`` selects the **packed-transport** variant: the host
+    stages records back-to-back in a ``pack_bytes``-sized buffer per
+    partition (plus offsets) and a jitted on-device gather expands each
+    batch to the same padded ``{data, length}`` contract AFTER the
+    link — so the padded feed ships payload bytes, not padding, and
+    tracks the device_put ceiling like the packed layout.  The trade:
+    a batch then holds UP TO ``batch_records`` rows (whatever fills
+    ``pack_bytes``; trailing rows have length 0), so consumers must
+    honor ``length``/``parts_alive`` — which the epoch-tail contract
+    already requires.  Default (None) keeps the classic fully-padded
+    host staging."""
     from ..io import input_split
 
+    if pack_bytes is not None:
+        # the packed staging buffer must hold any record the padded
+        # contract would deliver: with pack_bytes < max_bytes, an
+        # oversized record would be truncated at pack_bytes (the
+        # pack_spans allow-truncate path) and silently lose bytes the
+        # default padded path delivers
+        check(pack_bytes >= max_bytes,
+              f"pack_bytes ({pack_bytes}) must be >= max_bytes "
+              f"({max_bytes}) so no record is truncated below the "
+              f"padded contract")
+
+        def part_iter_packed(part: int, n_parts: int):
+            return _packed_part_iter(uri, part, n_parts, pack_bytes,
+                                     batch_records,
+                                     guard_bytes=max_bytes)
+
+        builder = lambda p, n: functools.partial(  # noqa: E731
+            part_iter_packed, p, n)
+        feed = DeviceFeed(mesh, queue_depth=queue_depth,
+                          source_builder=builder, world=world)
+        feed._transform = _make_padded_expander(
+            feed, batch_records, max_bytes, pack_bytes + max_bytes)
+        return feed
+
     def part_iter(part: int, n_parts: int):
+        from .. import telemetry
+
         split = input_split.create(uri, part, n_parts, "recordio")
         try:
             # ONE batch buffer per iterator, filled in place chunk by
@@ -1000,7 +1271,9 @@ def recordio_feed(uri: str, mesh, *, batch_records: int, max_bytes: int,
             length = np.empty(batch_records, np.int32)
             batch = {"data": data, "length": length}
             # bound the transient gather index ≲16 MB even for MB-sized
-            # records by splitting a chunk's spans into groups
+            # records by splitting a chunk's spans into groups (the
+            # native pad-pack has no such transient; the cap only
+            # matters for the numpy fallback)
             group_cap = max(1, (16 << 20) // max(max_bytes, 1))
             r = 0
             while True:
@@ -1013,8 +1286,9 @@ def recordio_feed(uri: str, mesh, *, batch_records: int, max_bytes: int,
                 i, n_spans = 0, sp.shape[0]
                 while i < n_spans:
                     g = min(n_spans - i, batch_records - r, group_cap)
-                    _gather_rows_into(mv, sp, i, i + g, max_bytes,
-                                      data[r:], length[r:])
+                    with telemetry.timed("feed", "pack"):
+                        _gather_rows_into(mv, sp, i, i + g, max_bytes,
+                                          data[r:], length[r:])
                     i += g
                     r += g
                     if r == batch_records:

@@ -888,21 +888,19 @@ class RecordIOSplitter(InputSplitBase):
         while True:
             if chunk.pos >= chunk.end:
                 return None
-            # native fast path: scan the whole chunk once, then serve
-            # spans as plain int triples (no per-record numpy unpacking)
+            # native fast path: ONE fused scan+verify pass over the
+            # whole chunk (ABI 6), then serve spans as plain int
+            # triples — checksummed records were CRC32C-verified inside
+            # the scan, so the per-record serve below never re-reads a
+            # payload.  Any typed reject (corruption) drops the chunk
+            # to the per-record Python walk, which reproduces the
+            # pre-fused policy/resync/quarantine behavior exactly.
             if chunk.spans is None and chunk.pos == chunk.start:
-                try:
-                    sp = native.recordio_spans(
-                        memoryview(chunk.data)[chunk.start : chunk.end],
-                        KMAGIC)
-                except ValueError as e:
-                    from .integrity import policy
-
-                    if policy() == "raise":
-                        raise DMLCError(str(e)) from e
-                    # corrupt chunk structure: the Python walk below
-                    # resyncs record-by-record under the active policy
-                    chunk.spans = ()
+                sp = native.recordio_spans(
+                    memoryview(chunk.data)[chunk.start : chunk.end],
+                    KMAGIC, verify=True)
+                if sp is not None and bool((sp[:, 2] >= 8).any()):
+                    chunk.spans = ()  # corrupt: Python walk handles it
                     sp = None
                 if sp is not None:
                     base = chunk.start
@@ -931,20 +929,14 @@ class RecordIOSplitter(InputSplitBase):
                     continue
                 return chunk.mv[off : off + length]
             if flag == 2:
-                # checksummed complete record: crc word at off-4
+                # checksummed complete record, already CRC32C-verified
+                # by the fused scan that produced this span table — the
+                # payload is served without a second read
                 chunk.pos = off + ((length + 3) & ~3)
                 head = off - 12
                 if should_drop(self._source_uri, self._gpos(chunk, head)):
                     continue
-                from .integrity import crc32c
-                from .recordio import stored_crc
-
-                want = _U32.unpack_from(chunk.data, off - 4)[0]
-                seg = chunk.mv[off : off + length]
-                if stored_crc(crc32c(seg)) != want:
-                    self._corrupt_at(chunk, head, "crc32c mismatch")
-                    continue  # policy allowed the skip
-                return seg
+                return chunk.mv[off : off + length]
             # multi-segment record (flag 1 plain / 3 checksummed):
             # reassemble + verify via the Python walk over the region
             sub = ChunkCursor(chunk.data, start=off, end=off + length,

@@ -28,7 +28,7 @@ from ..concurrency import make_lock
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(os.path.dirname(_HERE), "cpp", "dmlc_native.cc")
 _SO = os.path.join(_HERE, "libdmlc_native.so")
-_ABI = 5
+_ABI = 6
 
 _lib = None
 _lib_lock = make_lock("native._lib_lock")
@@ -107,6 +107,24 @@ def _load():
         lib.dmlc_recordio_spans.argtypes = [
             c.c_void_p, c.c_long, c.c_uint32, c.c_void_p, c.c_long,
             c.POINTER(c.c_long)]
+        lib.dmlc_recordio_spans_verify.restype = c.c_long
+        lib.dmlc_recordio_spans_verify.argtypes = [
+            c.c_void_p, c.c_long, c.c_uint32, c.c_int, c.c_void_p,
+            c.c_long, c.POINTER(c.c_long)]
+        lib.dmlc_pad_pack_rows.restype = c.c_long
+        lib.dmlc_pad_pack_rows.argtypes = [
+            c.c_void_p, c.c_long, c.c_void_p, c.c_long, c.c_uint32,
+            c.c_long, c.c_void_p, c.c_void_p]
+        lib.dmlc_pad_pack_csr.restype = c.c_long
+        lib.dmlc_pad_pack_csr.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_long,
+            c.c_long, c.c_long, c.c_long, c.c_long, c.c_void_p,
+            c.c_void_p, c.c_void_p, c.c_void_p]
+        lib.dmlc_parse_libsvm_into.restype = c.c_long
+        lib.dmlc_parse_libsvm_into.argtypes = [
+            c.c_void_p, c.c_long, c.c_long, c.c_long, c.c_long, c.c_long,
+            c.c_long, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
+            c.POINTER(c.c_long), c.POINTER(c.c_long)]
         lib.dmlc_recordio_find_last.restype = c.c_long
         lib.dmlc_recordio_find_last.argtypes = [
             c.c_void_p, c.c_long, c.c_uint32]
@@ -245,10 +263,22 @@ def parse_csv(data, delim: bytes = b",", nthread: int = 1) -> Optional[np.ndarra
     return out[: r * ncol].reshape(r, ncol)
 
 
-def recordio_spans(data, magic: int):
+def recordio_spans(data, magic: int, verify: bool = False):
     """(spans [n,3] uint64: offset, len, flag) or None.  flag 0 = zero-copy
-    payload span; flag 1 = multi-segment region needing reassembly.
-    Raises ValueError if the chunk is not a clean sequence of records."""
+    payload span; flag 1 = multi-segment region needing reassembly;
+    flags 2/3 their checksummed variants.
+
+    ``verify=True`` selects the fused single-pass scanner (ABI 6):
+    checksummed segments are CRC32C-verified inline during the walk, and
+    corruption comes back as TYPED REJECT triples (flag >= 8, span =
+    [begin, resync point)) instead of a ValueError, so the caller routes
+    them through DMLC_INTEGRITY_POLICY with no second pass over the
+    chunk.  Reject kinds: 8 bad magic, 9 truncated payload, 10 torn
+    multi-segment record, 11 missing end segment, 12 bad head cflag,
+    13 crc32c mismatch, 14 torn sub-word tail.
+
+    ``verify=False`` keeps the strict legacy scan: raises ValueError if
+    the chunk is not a clean sequence of records."""
     lib = _load()
     if lib is None:
         return None
@@ -260,14 +290,87 @@ def recordio_spans(data, magic: int):
     while True:
         out = np.empty((max_spans, 3), np.uint64)
         n_spans = ctypes.c_long()
-        ret = lib.dmlc_recordio_spans(ptr, n, magic, out.ctypes.data,
-                                      max_spans, ctypes.byref(n_spans))
+        if verify:
+            ret = lib.dmlc_recordio_spans_verify(
+                ptr, n, magic, 1, out.ctypes.data, max_spans,
+                ctypes.byref(n_spans))
+        else:
+            ret = lib.dmlc_recordio_spans(ptr, n, magic, out.ctypes.data,
+                                          max_spans, ctypes.byref(n_spans))
         if ret == -1:  # capacity: legal with many zero-length records
             max_spans *= 2
             continue
         if ret != 0:
             raise ValueError(f"invalid RecordIO chunk (code {ret})")
         return out[: n_spans.value]
+
+
+def pad_pack_rows(src, spans: np.ndarray, magic: int, max_bytes: int,
+                  out_rows: np.ndarray, out_lens: np.ndarray) -> bool:
+    """Write the records of ``spans`` ([g, 3] uint64 good triples) as
+    padded ``[g, max_bytes]`` rows straight into ``out_rows`` (uint8,
+    C-contiguous — typically a slice of the borrowed batch buffer) with
+    per-row lengths in ``out_lens`` (int32).  One native pass: memcpy +
+    zero-fill per row, escaped-magic regions reassembled in place.
+    Returns False when the native library is unavailable (caller falls
+    back to the numpy gather)."""
+    lib = _load()
+    if lib is None:
+        return False
+    _, ptr, src_len = _as_carray(src)
+    spans = np.ascontiguousarray(spans, np.uint64)
+    ret = lib.dmlc_pad_pack_rows(
+        ptr, src_len, spans.ctypes.data, spans.shape[0], magic, max_bytes,
+        out_rows.ctypes.data, out_lens.ctypes.data)
+    if ret != 0:
+        raise ValueError("pad_pack_rows: span out of bounds for source")
+    return True
+
+
+def pad_pack_csr(labels, offsets, index, value, b: int, batch_size: int,
+                 max_nnz: int, num_col: int,
+                 out: "dict") -> bool:
+    """CSR rows [0, b) → the padded batch dict ``out`` ({label [B],
+    value [B,K], index [B,K], mask [B,K]}), written in place — the
+    native pack_rowblock.  Returns False when native is unavailable."""
+    lib = _load()
+    if lib is None:
+        return False
+    labels = np.ascontiguousarray(labels, np.float32)
+    offsets = np.ascontiguousarray(offsets, np.uint64)
+    index = np.ascontiguousarray(index, np.uint32)
+    value = np.ascontiguousarray(value, np.float32)
+    ret = lib.dmlc_pad_pack_csr(
+        labels.ctypes.data, offsets.ctypes.data, index.ctypes.data,
+        value.ctypes.data, value.size, b, batch_size, max_nnz, num_col,
+        out["label"].ctypes.data, out["value"].ctypes.data,
+        out["index"].ctypes.data, out["mask"].ctypes.data)
+    return ret == 0
+
+
+def parse_libsvm_into(data, start: int, row_base: int, max_nnz: int,
+                      num_col: int, out: "dict"):
+    """Fused libsvm tokenize + pad-pack: parse lines of ``data`` from
+    byte ``start``, writing padded rows straight into the batch dict
+    ``out`` at rows [row_base, B) — no intermediate CSR, no Python
+    per-token loop.  Returns (rows_filled, consumed_offset), or None
+    when the native library is unavailable.  Raises ValueError on
+    malformed input."""
+    lib = _load()
+    if lib is None:
+        return None
+    _, ptr, n = _as_carray(data)
+    batch_rows = out["label"].size
+    rows = ctypes.c_long()
+    consumed = ctypes.c_long()
+    ret = lib.dmlc_parse_libsvm_into(
+        ptr, n, start, row_base, batch_rows, max_nnz, num_col,
+        out["label"].ctypes.data, out["value"].ctypes.data,
+        out["index"].ctypes.data, out["mask"].ctypes.data,
+        ctypes.byref(rows), ctypes.byref(consumed))
+    if ret != 0:
+        raise ValueError(f"malformed LibSVM input (code {ret})")
+    return int(rows.value), int(consumed.value)
 
 
 def gather_spans(src, offs: np.ndarray, lens: np.ndarray) -> Optional[np.ndarray]:

@@ -55,11 +55,17 @@ METRIC_NAMES = frozenset({
     "dmlc_collective_overlap_bucket_secs",
     # device feed
     "dmlc_feed_assemble_secs",
+    "dmlc_feed_autotune_adjustments",
+    "dmlc_feed_autotune_depth",
+    "dmlc_feed_autotune_workers",
     "dmlc_feed_batches",
     "dmlc_feed_bytes_to_device",
     "dmlc_feed_consumer_stall_secs",
+    "dmlc_feed_crc_secs",
     "dmlc_feed_depth",
     "dmlc_feed_device_put_secs",
+    "dmlc_feed_pack_secs",
+    "dmlc_feed_parse_native_secs",
     "dmlc_feed_producer_stall_secs",
     "dmlc_feed_queue_depth",
     "dmlc_feed_resizes",
@@ -195,7 +201,11 @@ NON_METRIC_TOKENS = frozenset({
     "dmlc_serving",       # prose prefix for the dmlc_serving_* family
     "dmlc_serve",         # bin/dmlc-serve launcher name in prose
     "dmlc_recordio_spans",  # native ABI symbol (dmlc_native.cc)
+    "dmlc_recordio_spans_verify",  # native ABI symbol (fused scan+verify)
     "dmlc_pack_spans",      # native ABI symbol
+    "dmlc_pad_pack_rows",   # native ABI symbol (spans -> padded rows)
+    "dmlc_pad_pack_csr",    # native ABI symbol (CSR -> padded batch)
+    "dmlc_parse_libsvm_into",  # native ABI symbol (fused tokenize+pack)
     "dmlc_comm_allreduce",  # native collective ABI symbol
     "dmlc_shm_coll",        # native shm-group ABI symbol prefix
     "dmlc_check",           # scripts/dmlc_check.py static-analysis suite

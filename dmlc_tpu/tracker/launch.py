@@ -41,6 +41,8 @@ PASS_ENVS = [
     "TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES",
     # -- registry pass_to_workers knobs (config_registry.py order) ----
     "DMLC_INTERFACE", "DMLC_FEED_WORKERS", "DMLC_FEED_DEPTH",
+    "DMLC_FEED_AUTOTUNE", "DMLC_FEED_WORKERS_MIN",
+    "DMLC_FEED_WORKERS_MAX", "DMLC_FEED_DEPTH_MAX",
     "DMLC_TPU_PARSE_NTHREAD", "DMLC_TPU_DISABLE_NATIVE",
     "DMLC_TPU_DISABLE_MMAP", "DMLC_COLL_ALGO", "DMLC_COLL_BUCKET_MB",
     "DMLC_COLL_RING_MIN_BYTES", "DMLC_COLL_HIER_MIN_BYTES",

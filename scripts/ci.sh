@@ -34,10 +34,13 @@
 #      counters appear on /metrics, and the killed incarnation's
 #      postmortem dump (final open spans + event tail) is collected
 #      from DMLC_POSTMORTEM_DIR
-#   8. perf smoke: packed-feed shipped efficiency >= 0.90 through the
-#      overlapped DeviceFeed pipeline, and the chunked ring allreduce
-#      beating the binomial tree on busbw at a bandwidth-dominated
-#      payload under the real local launcher
+#   8. perf smoke: packed-feed shipped efficiency >= 0.90 AND
+#      padded-feed (packed-transport + on-device expansion) shipped
+#      efficiency >= 0.85 through the overlapped DeviceFeed pipeline
+#      (hard-fails when the native fused feed path is unavailable),
+#      single-pass integrity asserted (residual crc stage ~ 0), and the
+#      chunked ring allreduce beating the binomial tree on busbw at a
+#      bandwidth-dominated payload under the real local launcher
 #   9. serving smoke: continuous-batching inference server end to end —
 #      8 concurrent HTTP streams through the bounded admission queue,
 #      prefill/decode over the paged KV cache, p99 TTFT bound and
@@ -171,10 +174,13 @@ if command -v g++ >/dev/null 2>&1 && command -v gcc >/dev/null 2>&1; then
     fi
 fi
 
-echo "== stage 5.5: UBSan pass on the collective ABI =="
+echo "== stage 5.5: UBSan pass on the collective ABI + native core =="
 # third sanitizer next to TSAN/ASAN: undefined behavior (misaligned
 # loads, signed overflow, bad shifts) in the C collective + driver,
-# same runtime-probe skip pattern as the asan stage
+# same runtime-probe skip pattern as the asan stage.  Also builds and
+# runs the dmlc_native.cc stress driver (parse fanout + the ABI-6
+# fused scan/verify/pad-pack entry points, clean AND corrupt chunks)
+# under UBSan, so the new reject/resync paths get UB coverage too.
 UBSAN_OK=skipped
 if command -v g++ >/dev/null 2>&1 && command -v gcc >/dev/null 2>&1; then
     UBSAN_DIR=$(mktemp -d)
@@ -207,6 +213,19 @@ if command -v g++ >/dev/null 2>&1 && command -v gcc >/dev/null 2>&1; then
                 exit 1
             fi
         done
+        g++ -O1 -g -std=c++17 -fsanitize=undefined \
+            -fno-sanitize-recover=undefined \
+            dmlc_tpu/cpp/dmlc_native.cc dmlc_tpu/cpp/test_native_tsan.cc \
+            -o "$UBSAN_DIR/test_native_ubsan" -pthread \
+            || { echo "FAIL: ubsan build of native core broke"; exit 1; }
+        "$UBSAN_DIR/test_native_ubsan" > "$UBSAN_DIR/native.log" 2>&1 \
+            || { echo "FAIL: ubsan native core run";
+                 tail -30 "$UBSAN_DIR/native.log"; exit 1; }
+        if grep -q "runtime error:" "$UBSAN_DIR/native.log"; then
+            echo "FAIL: undefined behavior in dmlc_native.cc"
+            grep "runtime error:" -A3 "$UBSAN_DIR/native.log" | head -40
+            exit 1
+        fi
         UBSAN_OK=1
     else
         echo "ubsan runtime unavailable; skipping"
@@ -221,7 +240,7 @@ echo "== stage 7: chaos smoke (fault-injected worker death + self-heal) =="
 timeout -k 10 180 python scripts/chaos_smoke.py \
     || { echo "FAIL: chaos smoke"; exit 1; }
 
-echo "== stage 8: perf smoke (feed shipped-efficiency + ring vs tree) =="
+echo "== stage 8: perf smoke (packed+padded feed efficiency + collectives) =="
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/perf_smoke.py \
     || { echo "FAIL: perf smoke"; exit 1; }
 

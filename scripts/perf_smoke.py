@@ -7,6 +7,13 @@ waiting for the next full bench refresh:
      actually shipped to the device through recordio_packed_feed must
      stay >= 0.90 (the packed layout's whole point is not paying for
      padding; a tail-batch or offsets-table regression shows up here).
+  1b. PADDED-feed shipped efficiency: the packed-transport padded path
+     (recordio_feed(pack_bytes=...) + on-device expansion) must ship
+     >= 0.85 payload/shipped — the PR 11 gate that the padded contract
+     no longer pays for its padding on the link.  Hard-fails with a
+     clear message when the native library is unavailable: without the
+     fused native scan+pack the gate would measure the Python fallback
+     and pass/fail on noise.
   2. Host collective: at 64 MB under the real local launcher, the
      chunked ring allreduce must beat the binomial tree on bus
      bandwidth, and the hierarchical shm+ring path must beat the flat
@@ -63,6 +70,48 @@ def feed_smoke(tmp):
           f"{batches} batches)")
     assert got == payload, (got, payload)
     assert eff >= 0.90, f"packed shipped efficiency regressed: {eff:.3f}"
+    return path, payload
+
+
+def padded_feed_smoke(path, payload):
+    from dmlc_tpu import metrics, native
+    from dmlc_tpu.feed import recordio_feed
+    from dmlc_tpu.parallel import build_mesh
+
+    # without the native library the padded path runs the (bit-identical
+    # but slow) Python fallbacks and the stage split below measures
+    # nothing real — the gate is about the FUSED single-pass feed
+    assert native.available(), (
+        "native dmlc library unavailable (no g++? DMLC_TPU_DISABLE_NATIVE "
+        "set?) — the padded shipped-efficiency gate (>= 0.85) requires "
+        "the fused native parse+verify+pack path and cannot run")
+
+    mesh = build_mesh(1, dp=1, sp=1, tp=1, pp=1, ep=1)
+    before = metrics.snapshot().get("feed", {})
+    feed = recordio_feed(path, mesh, batch_records=512,
+                         max_bytes=12 << 10, pack_bytes=1 << 20)
+    got = 0
+    for b in feed:
+        got += int(np.sum(np.asarray(b["length"])))
+    after = metrics.snapshot().get("feed", {})
+    shipped = (after.get("bytes_to_device", 0.0)
+               - before.get("bytes_to_device", 0.0))
+    crc_s = after.get("crc_secs", 0.0) - before.get("crc_secs", 0.0)
+    scan_s = (after.get("parse_native_secs", 0.0)
+              - before.get("parse_native_secs", 0.0))
+    eff = got / shipped
+    print(f"perf_smoke: padded feed eff={eff:.3f} "
+          f"({got / 1e6:.1f} MB payload / {shipped / 1e6:.1f} MB shipped; "
+          f"fused scan {scan_s:.3f}s, residual crc {crc_s:.3f}s)")
+    assert got == payload, (got, payload)
+    assert eff >= 0.85, (
+        f"padded shipped efficiency regressed: {eff:.3f} < 0.85 — the "
+        "packed-transport padded path is shipping padding again")
+    # single-pass integrity: verification rides the fused scan; the
+    # residual crc stage (reject/skip-list routing) must be noise
+    assert crc_s <= max(0.1, 0.25 * max(scan_s, 1e-9)), (
+        f"separate verify pass detected: crc stage {crc_s:.3f}s vs "
+        f"fused scan {scan_s:.3f}s")
 
 
 def collective_smoke():
@@ -110,7 +159,8 @@ def main():
     import tempfile
 
     with tempfile.TemporaryDirectory() as tmp:
-        feed_smoke(tmp)
+        path, payload = feed_smoke(tmp)
+        padded_feed_smoke(path, payload)
     collective_smoke()
     print("perf_smoke: OK")
 

@@ -1,0 +1,548 @@
+"""The PR 11 fused single-pass feed: native vs Python scanner parity
+(all corruption shapes x all DMLC_INTEGRITY_POLICY values), native
+pad-pack parity, packed-transport padded feed, and the ledger-driven
+feed autotuner."""
+
+import struct
+
+import numpy as np
+import pytest
+
+import dmlc_tpu.native as native_mod
+from dmlc_tpu.feed.device_feed import (_chunk_spans, _gather_rows_into,
+                                       _py_chunk_spans, pack_rowblock)
+from dmlc_tpu.io import integrity
+from dmlc_tpu.io.recordio import KMAGIC, RecordIOWriter
+from dmlc_tpu.io.stream import MemoryBytesStream, Stream
+
+MAGIC = struct.pack("<I", KMAGIC)
+
+
+@pytest.fixture(autouse=True)
+def _clean_quarantine():
+    integrity.reset_quarantine()
+    yield
+    integrity.reset_quarantine()
+
+
+def _force_fallback(monkeypatch, disable: bool):
+    if disable:
+        monkeypatch.setenv("DMLC_TPU_DISABLE_NATIVE", "1")
+    else:
+        monkeypatch.delenv("DMLC_TPU_DISABLE_NATIVE", raising=False)
+    monkeypatch.setattr(native_mod, "_tried", False)
+    monkeypatch.setattr(native_mod, "_lib", None)
+
+
+def _write_records(recs, checksum):
+    s = MemoryBytesStream()
+    w = RecordIOWriter(s, checksum=checksum)
+    for r in recs:
+        w.write_record(r)
+    return bytearray(s.getvalue())
+
+
+def _base_records(checksum):
+    rng = np.random.default_rng(11)
+    recs = []
+    for i in range(24):
+        if i % 7 == 3:  # escaped magic -> multi-segment record
+            recs.append(b"P" * (4 * (i % 3)) + MAGIC + b"Q" * (4 + 4 * (i % 2)))
+        elif i % 5 == 2:
+            recs.append(b"")  # empty record
+        else:
+            recs.append(bytes(rng.integers(0, 256, 5 + i * 3,
+                                           dtype=np.uint8)))
+    return _write_records(recs, checksum)
+
+
+def _corruption_cases():
+    """(name, chunk bytes) for every corruption shape the scanners
+    classify — incl. the PR 8 stray-aligned-word-at-chunk-tail case."""
+    cases = []
+    for ck in (False, True):
+        tag = "crc" if ck else "plain"
+        clean = _base_records(ck)
+        cases.append((f"clean-{tag}", bytes(clean)))
+        b = bytearray(clean)
+        b[0:4] = b"\xde\xad\xbe\xef"  # head magic destroyed
+        cases.append((f"bad-magic-{tag}", bytes(b)))
+        cases.append((f"truncated-{tag}", bytes(clean[: len(clean) - 6])))
+        # stray ALIGNED word at the chunk tail: a writer killed one word
+        # into the next header passes the splitter's %4 admission
+        cases.append((f"stray-word-{tag}", bytes(clean) + MAGIC))
+        b = bytearray(clean)
+        # overwrite a record head's cflag with a continuation flag
+        lrec = struct.unpack_from("<I", b, 4)[0]
+        struct.pack_into("<I", b, 4, (lrec & ((1 << 29) - 1)) | (2 << 29))
+        cases.append((f"head-cflag-{tag}", bytes(b)))
+    # crc payload flips (checksummed only): single-segment and the
+    # multi-segment region
+    ckbuf = _base_records(True)
+    sp = _py_chunk_spans(memoryview(bytes(ckbuf)))
+    single = next(i for i in range(sp.shape[0]) if sp[i, 2] == 2
+                  and sp[i, 1] > 0)
+    b = bytearray(ckbuf)
+    b[int(sp[single, 0])] ^= 0xFF
+    cases.append(("crc-flip-single", bytes(b)))
+    multi = next(i for i in range(sp.shape[0]) if sp[i, 2] == 3)
+    b = bytearray(ckbuf)
+    b[int(sp[multi, 0]) + 12] ^= 0xFF  # first segment payload byte
+    cases.append(("crc-flip-multiseg", bytes(b)))
+    # torn multi-segment: cut inside the region
+    b = bytes(ckbuf[: int(sp[multi, 0]) + 16])
+    cases.append(("torn-multiseg", b))
+    return cases
+
+
+@pytest.mark.parametrize("name,chunk", _corruption_cases())
+def test_scanner_parity(name, chunk):
+    """The native fused scanner and the Python fallback walker emit
+    IDENTICAL triple tables — good spans AND typed rejects — for every
+    corruption shape, so the two walkers can never drift."""
+    if not native_mod.available():
+        pytest.skip("native library unavailable")
+    sp_native = native_mod.recordio_spans(memoryview(chunk), KMAGIC,
+                                         verify=True)
+    sp_py = _py_chunk_spans(memoryview(chunk))
+    assert sp_native.shape == sp_py.shape, name
+    assert (sp_native == sp_py).all(), (
+        f"{name}: native {sp_native.tolist()} != py {sp_py.tolist()}")
+    if name.startswith("clean"):
+        assert (sp_native[:, 2] < 8).all(), name
+    else:
+        assert (sp_native[:, 2] >= 8).any(), name
+    if name.startswith("stray-word"):
+        # the satellite case: exactly one torn-tail reject covering the
+        # stray aligned word
+        tail = sp_native[sp_native[:, 2] == 14]
+        assert tail.shape[0] == 1 and int(tail[0, 1]) == 4, name
+
+
+@pytest.mark.parametrize("policy", ["raise", "skip", "quarantine"])
+@pytest.mark.parametrize("disable_native", [False, True])
+@pytest.mark.parametrize(
+    "name,chunk",
+    [c for c in _corruption_cases() if not c[0].startswith("clean")])
+def test_chunk_spans_policy_differential(monkeypatch, policy,
+                                         disable_native, name, chunk):
+    """End-to-end differential matrix (the satellite-1 gate): native vs
+    DMLC_TPU_DISABLE_NATIVE=1 must agree on kept spans, raised error,
+    quarantined spans, and counters under all three integrity
+    policies."""
+    _force_fallback(monkeypatch, disable_native)
+    if not disable_native and not native_mod.available():
+        pytest.skip("native library unavailable")
+    monkeypatch.setenv("DMLC_INTEGRITY_POLICY", policy)
+    integrity.reset_quarantine()
+    from dmlc_tpu import telemetry
+
+    before = telemetry.counters_snapshot().get("integrity", {})
+    if policy == "raise":
+        with pytest.raises(integrity.CorruptRecord):
+            _chunk_spans(memoryview(chunk), source=f"t-{name}", base=0)
+        return
+    sp = _chunk_spans(memoryview(chunk), source=f"t-{name}", base=0)
+    after = telemetry.counters_snapshot().get("integrity", {})
+    assert (sp[:, 2] < 8).all()  # rejects never escape
+    corrupt = (after.get("corrupt_records", 0)
+               - before.get("corrupt_records", 0))
+    assert corrupt >= 1
+    spans = integrity.quarantined_spans(f"t-{name}")
+    if policy == "quarantine":
+        assert spans, name
+    else:
+        assert not spans
+
+    # the differential core: the OTHER walker must produce the same
+    # kept spans and the same quarantine keys
+    _force_fallback(monkeypatch, not disable_native)
+    if disable_native and not native_mod.available():
+        return
+    integrity.reset_quarantine()
+    sp2 = _chunk_spans(memoryview(chunk), source=f"t-{name}", base=0)
+    assert (sp == sp2).all(), name
+    assert integrity.quarantined_spans(f"t-{name}") == spans, name
+
+
+def test_fused_verify_quarantine_replay(monkeypatch):
+    """A crc-corrupt record under policy=quarantine: first pass reports
+    + quarantines, the REPLAY drops it via the skip-list (counted as a
+    skiplist drop, not a fresh corrupt-record report) — on both
+    walkers."""
+    from dmlc_tpu import telemetry
+
+    for disable in (False, True):
+        _force_fallback(monkeypatch, disable)
+        if not disable and not native_mod.available():
+            pytest.skip("native library unavailable")
+        monkeypatch.setenv("DMLC_INTEGRITY_POLICY", "quarantine")
+        integrity.reset_quarantine()
+        chunk = dict(_corruption_cases())["crc-flip-single"]
+        src = f"replay-{disable}"
+        sp1 = _chunk_spans(memoryview(chunk), source=src, base=0)
+        assert integrity.quarantined_spans(src)
+        before = telemetry.counters_snapshot().get("integrity", {})
+        sp2 = _chunk_spans(memoryview(chunk), source=src, base=0)
+        after = telemetry.counters_snapshot().get("integrity", {})
+        assert (sp1 == sp2).all()
+        assert (after.get("skiplist_drops", 0)
+                - before.get("skiplist_drops", 0)) >= 1
+        assert (after.get("corrupt_records", 0)
+                == before.get("corrupt_records", 0))
+
+
+def test_pad_pack_rows_native_matches_numpy(monkeypatch):
+    """dmlc_pad_pack_rows == the numpy broadcast gather, byte for byte,
+    incl. escaped-magic reassembly and truncation at max_bytes."""
+    if not native_mod.available():
+        pytest.skip("native library unavailable")
+    chunk = bytes(_base_records(False))
+    mv = memoryview(chunk)
+    sp = _chunk_spans(mv)
+    g = sp.shape[0]
+    for max_bytes in (8, 64):
+        a_rows = np.full((g, max_bytes), 7, np.uint8)
+        a_lens = np.full(g, -1, np.int32)
+        _gather_rows_into(mv, sp, 0, g, max_bytes, a_rows, a_lens)
+        b_rows = np.full((g, max_bytes), 9, np.uint8)
+        b_lens = np.full(g, -2, np.int32)
+        monkeypatch.setattr(native_mod, "_lib", None)
+        monkeypatch.setattr(native_mod, "_tried", True)  # force fallback
+        _gather_rows_into(mv, sp, 0, g, max_bytes, b_rows, b_lens)
+        monkeypatch.undo()
+        assert (a_rows == b_rows).all(), max_bytes
+        assert (a_lens == b_lens).all(), max_bytes
+
+
+def test_pack_rowblock_native_matches_numpy(monkeypatch):
+    """dmlc_pad_pack_csr == the numpy pack_rowblock, byte for byte:
+    truncated rows, short blocks, empty blocks, num_col clamping."""
+    if not native_mod.available():
+        pytest.skip("native library unavailable")
+    from dmlc_tpu.data.row_block import RowBlockContainer
+
+    c = RowBlockContainer()
+    c.push_arrays(
+        labels=np.array([1.0, 0.0, 1.0], np.float32),
+        offsets=np.array([0, 2, 2, 7], np.uint64),
+        index=np.array([0, 3, 1, 2, 4, 9, 5], np.uint32),
+        value=np.array([1, 2, 3, 4, 5, 6, 7], np.float32),
+    )
+    blk = c.get_block()
+    empty = RowBlockContainer()
+    empty.push_arrays(labels=np.empty(0, np.float32),
+                      offsets=np.array([0], np.uint64),
+                      index=np.empty(0, np.uint32),
+                      value=np.empty(0, np.float32))
+
+    def run(b, **kw):
+        return pack_rowblock(b, **kw)
+
+    nan = RowBlockContainer()
+    nan.push_arrays(  # NaN/Inf must never leak into masked padding
+        labels=np.array([1.0, 0.0], np.float32),
+        offsets=np.array([0, 1, 2], np.uint64),
+        index=np.array([0, 1], np.uint32),
+        value=np.array([np.nan, np.inf], np.float32),
+    )
+    for b, kw in [
+        (blk, dict(batch_size=4, max_nnz=3, num_col=6)),  # clamp + trunc
+        (blk, dict(batch_size=2, max_nnz=8, num_col=0)),  # b < size
+        (blk.slice(1, 3), dict(batch_size=4, max_nnz=2, num_col=10)),
+        (empty.get_block(), dict(batch_size=3, max_nnz=2, num_col=4)),
+        (nan.get_block(), dict(batch_size=3, max_nnz=3, num_col=0)),
+    ]:
+        nat = run(b, **kw)
+        monkeypatch.setattr(native_mod, "_lib", None)
+        monkeypatch.setattr(native_mod, "_tried", True)
+        py = run(b, **kw)
+        monkeypatch.undo()
+        for k in ("label", "value", "index", "mask"):
+            assert np.array_equal(nat[k], py[k], equal_nan=True), (k, kw)
+            assert nat[k].dtype == py[k].dtype
+        # masked padding cells are EXACT zeros on both paths, even when
+        # real cells hold NaN/Inf (the clamped-gather leak regression)
+        for out in (nat, py):
+            masked = out["mask"] == 0.0
+            assert (out["value"][masked] == 0.0).all(), kw
+
+
+def _write_rec_file(tmp_path, recs, name="data.rec", checksum=False):
+    path = str(tmp_path / name)
+    with Stream.create(path, "w") as s:
+        w = RecordIOWriter(s, checksum=checksum)
+        for r in recs:
+            w.write_record(r)
+    return path
+
+
+def test_padded_packed_transport_parity(tmp_path):
+    """recordio_feed(pack_bytes=...) must deliver the exact record
+    stream of the classic padded staging — the on-device expansion is a
+    transport optimization, not a contract change."""
+    from dmlc_tpu.feed import recordio_feed
+    from dmlc_tpu.parallel import build_mesh
+
+    rng = np.random.default_rng(5)
+    recs = []
+    for i in range(90):
+        if i % 9 == 4:
+            recs.append(b"x" * 4 + MAGIC + b"y" * 8)  # escaped magic
+        else:
+            recs.append(bytes(rng.integers(0, 256, 10 + i % 70,
+                                           dtype=np.uint8)))
+    path = _write_rec_file(tmp_path, recs, checksum=True)
+    mesh1 = build_mesh(1, dp=1, sp=1, tp=1, pp=1, ep=1)
+
+    def collect(**kw):
+        out = []
+        feed = recordio_feed(path, mesh1, batch_records=8, max_bytes=48,
+                             **kw)
+        for b in feed:
+            data = np.asarray(b["data"])
+            lens = np.asarray(b["length"])
+            assert data.shape == (8, 48)
+            for row, n in zip(data, lens):
+                if n > 0:
+                    out.append(bytes(row[:n]))
+                # padded tail beyond length must be zero
+                assert not row[n:].any()
+        return out
+
+    want = [r[:48] for r in recs if r]
+    got_legacy = [r for r in collect()]
+    got_packed = [r for r in collect(pack_bytes=512)]
+    assert [r for r in got_legacy if r] == want
+    assert [r for r in got_packed if r] == want
+
+
+def test_padded_packed_transport_epoch_tail_masking(tmp_path):
+    """Epoch-tail parts_alive masking on the 8-part mesh: drained
+    partitions pad with zero rows and parts_alive=0, same as the
+    classic path; empty partitions work."""
+    from dmlc_tpu.feed import recordio_feed
+    from dmlc_tpu.parallel import build_mesh
+
+    # few records: several of the 8 partitions end up EMPTY
+    recs = [bytes([i]) * (6 + i) for i in range(5)]
+    path = _write_rec_file(tmp_path, recs)
+    mesh = build_mesh(8, dp=4, sp=2, tp=1, pp=1, ep=1)
+    feed = recordio_feed(path, mesh, batch_records=2, max_bytes=16,
+                         pack_bytes=64)
+    total = 0
+    for b in feed:
+        alive = np.asarray(b["parts_alive"])
+        assert alive.shape == (8,)
+        data = np.asarray(b["data"]).reshape(8, 2, 16)
+        lens = np.asarray(b["length"]).reshape(8, 2)
+        for p in range(8):
+            if alive[p] == 0.0:
+                assert not data[p].any() and not lens[p].any()
+        total += int((lens > 0).sum())
+    assert total == len(recs)
+    # multi-epoch: the expander and staging survive a second epoch
+    total2 = sum(int((np.asarray(b["length"]) > 0).sum()) for b in feed)
+    assert total2 == len(recs)
+
+
+def test_libsvm_fused_parity_with_classic(tmp_path, monkeypatch):
+    """The fused native libsvm path (dmlc_parse_libsvm_into) and the
+    classic parser+pack_rowblock path emit IDENTICAL batch streams,
+    incl. the zero-padded epoch tail."""
+    if not native_mod.available():
+        pytest.skip("native library unavailable")
+    lines = []
+    for i in range(43):
+        # float-exact values so both float parsers agree bit-for-bit
+        lines.append(f"{i % 2} 0:{i}.5 3:{i} 7:0.25 11:1")
+    lines.append("")  # blank line ignored
+    p = tmp_path / "t.libsvm"
+    p.write_text("\n".join(lines) + "\n")
+    from dmlc_tpu.feed import libsvm_feed
+    from dmlc_tpu.parallel import build_mesh
+
+    mesh1 = build_mesh(1, dp=1, sp=1, tp=1, pp=1, ep=1)
+
+    def collect(disable):
+        _force_fallback(monkeypatch, disable)
+        out = []
+        for b in libsvm_feed(str(p), mesh1, batch_size=8, max_nnz=3):
+            out.append(tuple(np.asarray(b[k]).tobytes()
+                             for k in ("label", "value", "index", "mask")))
+        return out
+
+    assert collect(False) == collect(True)
+
+
+def test_pack_rowblock_foreign_dtype_out_uses_numpy_path():
+    """A caller-provided out dict with non-canonical dtypes (legal on
+    the pre-PR numpy path, which casts on assignment) must NOT take the
+    native branch — float64/int64 buffers reinterpreted as f32/i32
+    would be silent data corruption."""
+    from dmlc_tpu.data.row_block import RowBlockContainer
+
+    c = RowBlockContainer()
+    c.push_arrays(labels=np.array([1.0, 0.0], np.float32),
+                  offsets=np.array([0, 2, 3], np.uint64),
+                  index=np.array([0, 3, 1], np.uint32),
+                  value=np.array([1, 2, 3], np.float32))
+    blk = c.get_block()
+    out64 = {"label": np.empty(4, np.float64),
+             "value": np.empty((4, 2), np.float64),
+             "index": np.empty((4, 2), np.int64),
+             "mask": np.empty((4, 2), np.float64)}
+    got = pack_rowblock(blk, 4, 2, 5, out=out64)
+    ref = pack_rowblock(blk, 4, 2, 5)  # canonical dtypes
+    for k in ("label", "value", "index", "mask"):
+        assert got[k] is out64[k]
+        np.testing.assert_array_equal(got[k], ref[k].astype(got[k].dtype))
+    # a WRONG-SHAPED out dict must never reach the native writer (the
+    # numpy path raises a clean broadcast error; heap corruption is not
+    # an acceptable alternative)
+    small = {k: np.empty(v.shape, v.dtype) for k, v in ref.items()}
+    with pytest.raises(ValueError):
+        pack_rowblock(blk, 64, 8, out=small)
+
+
+def test_pad_pack_csr_non_monotone_offsets_zero_fill():
+    """Corrupt (non-monotone) CSR offsets wrap the row-length math; the
+    native path must zero-fill such rows like the numpy twin instead of
+    writing out of bounds."""
+    from dmlc_tpu.data.row_block import RowBlock
+
+    blk = RowBlock(offset=np.array([2, 1, 3], np.uint64),  # 2 -> 1 !
+                   label=np.array([1.0, 0.0], np.float32),
+                   weight=None, qid=None, field=None,
+                   index=np.array([0, 1, 2], np.uint32),
+                   value=np.array([5, 6, 7], np.float32))
+    nat = pack_rowblock(blk, 3, 2, 0)
+    assert (nat["value"][0] == 0).all() and (nat["mask"][0] == 0).all()
+    assert nat["label"][0] == 1.0  # labels untouched by the bad row
+
+
+def test_padded_packed_transport_rejects_small_pack_bytes(tmp_path):
+    """pack_bytes < max_bytes would silently truncate records below the
+    padded contract — refused at construction."""
+    from dmlc_tpu.base import DMLCError
+    from dmlc_tpu.feed import recordio_feed
+    from dmlc_tpu.parallel import build_mesh
+
+    path = _write_rec_file(tmp_path, [b"x" * 8])
+    mesh1 = build_mesh(1, dp=1, sp=1, tp=1, pp=1, ep=1)
+    with pytest.raises(DMLCError, match="pack_bytes"):
+        recordio_feed(path, mesh1, batch_records=2, max_bytes=64,
+                      pack_bytes=32)
+
+
+def test_autotune_accumulates_across_short_epochs(tmp_path, monkeypatch):
+    """Epochs shorter than the decision window must ACCUMULATE ledger
+    evidence across boundaries, not discard it."""
+    from dmlc_tpu import telemetry
+    from dmlc_tpu.feed import recordio_feed
+    from dmlc_tpu.parallel import build_mesh
+
+    monkeypatch.setenv("DMLC_FEED_AUTOTUNE", "1")
+    monkeypatch.setenv("DMLC_FEED_WORKERS", "1")
+    monkeypatch.setenv("DMLC_FEED_WORKERS_MAX", "3")
+    path = _write_rec_file(tmp_path, [b"r" * 20] * 16)
+    mesh = build_mesh(8, dp=4, sp=2, tp=1, pp=1, ep=1)
+    feed = recordio_feed(path, mesh, batch_records=2, max_bytes=32)
+    telemetry.reset_steps()
+    led = telemetry.ledger()
+
+    def epoch_with_steps(n):
+        for _ in range(n):
+            led.step_begin()
+            led.step_end(tokens=1)
+        with led._lock:
+            for rec in led._records:
+                rec["wall_s"] = max(rec["wall_s"], 1e-3)
+                rec["feed_wait_s"] = 0.9 * rec["wall_s"]
+        for _ in feed:
+            pass
+
+    epoch_with_steps(2)  # below window: held, not discarded
+    assert feed._workers == 1
+    epoch_with_steps(2)  # still below
+    assert feed._workers == 1
+    epoch_with_steps(2)  # cumulative 6 >= window: applied
+    assert feed._workers == 2, feed._workers
+
+
+def test_feed_autotuner_converges_and_holds():
+    """Synthetic ledger trace: the controller grows until feed-wait
+    drops below the high-water mark, then HOLDS — and a punished shrink
+    raises the floor so it cannot oscillate."""
+    from dmlc_tpu.feed import FeedAutotuner
+
+    t = FeedAutotuner(workers=1, depth=2, min_workers=1, max_workers=6,
+                      max_depth=4)
+    trace = []
+    for _ in range(30):
+        fw = max(0.0, 0.6 - 0.12 * t.workers)  # more workers -> less wait
+        trace.append(t.observe(fw))
+    assert trace[-1] == trace[-2] == trace[-3], trace[-6:]
+    w, d = trace[-1]
+    assert 1 <= w <= 6 and 2 <= d <= 4
+    assert max(0.0, 0.6 - 0.12 * w) <= t.high  # converged under the mark
+
+    # oscillation guard: a shrink that starves the device is undone and
+    # never retried
+    t2 = FeedAutotuner(workers=4, depth=2, min_workers=1, max_workers=6,
+                       max_depth=4)
+    hist = []
+    for _ in range(20):
+        fw = 0.0 if t2.workers >= 4 else 0.5
+        hist.append(t2.observe(fw))
+    tail = hist[-8:]
+    assert all(x == (4, 2) for x in tail), (
+        f"controller kept oscillating: {hist}")
+
+    # a punished DEPTH shrink must undo depth (not grow workers): the
+    # device starves whenever depth < 3 here, regardless of workers
+    t3 = FeedAutotuner(workers=2, depth=2, min_workers=1, max_workers=6,
+                       max_depth=4)
+    t3.depth = 4  # as if earlier traffic grew depth
+    hist3 = []
+    for _ in range(24):
+        fw = 0.0 if t3.depth >= 3 else 0.5
+        hist3.append(t3.observe(fw))
+    w3, d3 = hist3[-1]
+    assert d3 >= 3, f"depth shrink not undone: {hist3}"
+    assert all(x == hist3[-1] for x in hist3[-6:]), hist3
+    assert w3 <= 3, f"punished depth shrink ratcheted workers: {hist3}"
+
+
+def test_feed_autotune_applies_between_epochs(tmp_path, monkeypatch):
+    """DMLC_FEED_AUTOTUNE=1: a high feed-wait fraction in the step
+    ledger grows the worker count at the next epoch boundary, within
+    the registered bounds."""
+    from dmlc_tpu import telemetry
+    from dmlc_tpu.feed import recordio_feed
+    from dmlc_tpu.parallel import build_mesh
+
+    monkeypatch.setenv("DMLC_FEED_AUTOTUNE", "1")
+    monkeypatch.setenv("DMLC_FEED_WORKERS", "1")
+    monkeypatch.setenv("DMLC_FEED_WORKERS_MAX", "3")
+    path = _write_rec_file(tmp_path, [b"r" * 20] * 40)
+    mesh = build_mesh(8, dp=4, sp=2, tp=1, pp=1, ep=1)
+    feed = recordio_feed(path, mesh, batch_records=2, max_bytes=32)
+    assert feed._autotuner is not None
+    telemetry.reset_steps()
+    for _ in feed:  # epoch 1: no ledger evidence -> no change
+        pass
+    assert feed._workers == 1
+    led = telemetry.ledger()
+    for _ in range(6):
+        led.step_begin()
+        led.step_end(tokens=1)
+    for rec in led.records():
+        pass
+    with led._lock:
+        for rec in led._records:  # synthetic: 90% feed-wait steps
+            rec["wall_s"] = max(rec["wall_s"], 1e-3)
+            rec["feed_wait_s"] = 0.9 * rec["wall_s"]
+    for _ in feed:  # epoch 2 applies the controller
+        pass
+    assert feed._workers == 2, feed._workers
