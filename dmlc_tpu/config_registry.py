@@ -389,6 +389,30 @@ KNOBS: Tuple[Knob, ...] = (
     _k("DMLC_SERVE_DRAIN_S", float, 30.0,
        "graceful drain bound: finish in-flight decodes within this",
        group="serving"),
+    _k("DMLC_SERVE_REQUEST_LEDGER_MAX", int, 2048,
+       "finished requests retained in the request ledger ring",
+       group="serving"),
+    _k("DMLC_SERVE_TRACE_REQUESTS", bool, True,
+       "draw per-request lifecycle rows on the Chrome /trace",
+       group="serving"),
+
+    # ---- serving SLOs (telemetry.slo) ---------------------------------
+    _k("DMLC_SLO_TTFT_P99_S", float, None,
+       "TTFT p99 objective in seconds (unset = objective disabled)",
+       group="slo"),
+    _k("DMLC_SLO_TBT_P99_S", float, None,
+       "time-between-tokens p99 objective in seconds (unset = off)",
+       group="slo"),
+    _k("DMLC_SLO_ERROR_RATE", float, None,
+       "request error-rate objective, 0..1 (unset = off)", group="slo"),
+    _k("DMLC_SLO_FAST_WINDOW_S", float, 60.0,
+       "fast burn-rate window (detection latency)", group="slo"),
+    _k("DMLC_SLO_SLOW_WINDOW_S", float, 300.0,
+       "slow burn-rate window (blip suppression)", group="slo"),
+    _k("DMLC_SLO_FAST_BURN", float, 14.4,
+       "burn-rate threshold over the fast window", group="slo"),
+    _k("DMLC_SLO_SLOW_BURN", float, 6.0,
+       "burn-rate threshold over the slow window", group="slo"),
 )
 
 #: ``DMLC_``-prefixed names that are NOT environment knobs — reference
@@ -419,6 +443,7 @@ _GROUP_TITLES = (
     ("lockcheck", "Lock-order watchdog"),
     ("kernel", "Kernels"),
     ("serving", "Serving"),
+    ("slo", "Serving SLOs"),
     ("misc", "Misc"),
 )
 

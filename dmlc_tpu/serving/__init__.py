@@ -12,9 +12,16 @@ the repo already trusts —
                    greedy sampling, BufferPool admission backpressure,
                    and one StepLedger step per decode iteration (p50/
                    p99 step time, goodput, decode MFU on /metrics)
-  * ``server``     POST /generate + /metrics + /healthz HTTP surface
-                   (TelemetryHTTPServer pattern; 429 on a full queue)
+  * ``server``     POST /generate + /metrics /healthz /requests /slo
+                   /trace HTTP surface (TelemetryHTTPServer pattern;
+                   429 on a full queue, per-status-code counters)
   * ``loadgen``    N-stream closed-loop load + BENCH_serving.json
+                   (joined with the server-side request ledger)
+
+Request-scoped observability rides telemetry.requests (per-request
+lifecycle ledger: TTFT ≡ queue + prefill, TBT, preempt/resume
+episodes, per-request /trace rows) and telemetry.slo (DMLC_SLO_*
+burn-rate objectives; violations flow into the anomaly surface).
 
 Launch with ``bin/dmlc-serve``; knobs are the ``DMLC_SERVE_*`` family
 (README "Serving"); the CI smoke is ``scripts/serving_smoke.py``.

@@ -15,6 +15,17 @@ slots: ``submit`` must acquire one within ``admit_timeout_s`` or the
 request is rejected (the HTTP layer maps that to 429) — the pool's
 kill-wakes semantics double as clean shutdown for blocked submitters.
 
+Request-scoped observability: every admitted request is tracked by a
+:class:`telemetry.RequestLedger` (``engine.requests``) through submit →
+queue wait → prefill → first token → per-token decode → preempt/resume
+→ finish/fail-with-reason, so server-side TTFT decomposes exactly into
+``queue_s + prefill_s`` and TBT p50/p99 is measurable; each request
+draws its own row on the Chrome ``/trace``.  The decode loop also
+records a per-iteration batch/KV-pressure record (the fleet router's
+load signal) and streams TTFT/TBT/outcomes into the
+:class:`telemetry.SLOMonitor` (``engine.slo``, the ``DMLC_SLO_*``
+burn-rate objectives behind ``/slo``).
+
 Shape discipline (XLA recompiles per shape, so both are bucketed):
 prefill pads prompts up to a whole number of KV blocks (safe under
 causal attention), and decode always runs the full ``max_active``-row
@@ -90,7 +101,8 @@ class InferenceEngine:
                  queue_depth: Optional[int] = None,
                  admit_timeout_s: Optional[float] = None,
                  max_new_tokens: Optional[int] = None,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None,
+                 slo_monitor=None):
         self.params = params
         self.cfg = cfg
         self.mesh = mesh
@@ -115,6 +127,13 @@ class InferenceEngine:
         depth = (queue_depth if queue_depth is not None
                  else get_env("DMLC_SERVE_QUEUE_DEPTH", 64))
         self._slots: BufferPool = BufferPool(object, capacity=depth)
+        # request-scoped observability: per-request lifecycle ledger
+        # (+ /requests endpoint) feeding the SLO burn-rate monitor
+        # (+ /slo endpoint); the default monitor is process-wide so
+        # heartbeats ship ONE slo sub-doc per replica process
+        self.slo = (slo_monitor if slo_monitor is not None
+                    else telemetry.slo.monitor())
+        self.requests = telemetry.RequestLedger(slo=self.slo)
         self._prefill, self._decode = _jitted_programs()
         self._stop = threading.Event()
         self._draining = threading.Event()
@@ -130,6 +149,7 @@ class InferenceEngine:
         queue slot frees up within ``timeout`` (default
         ``admit_timeout_s``), ``ValueError`` when the request could
         never be served (bad ids, context beyond total cache)."""
+        t_submit = time.perf_counter()
         if self._draining.is_set():
             raise EngineDraining(
                 "engine is draining (shutdown notice); retry against "
@@ -153,13 +173,17 @@ class InferenceEngine:
                 f"active); retry later")
         req.slot = slot
         telemetry.inc("serving", "requests")
+        # ledger entry opens at the submit stamp, so queue_s includes
+        # the admission-slot wait a saturated server imposes
+        self.requests.on_submit(req.id, req.n_prompt, mnt, t=t_submit)
         self.scheduler.enqueue(req)
         if self._stop.is_set():
             # close() can finish its sweep between our slot acquire and
             # the enqueue above; nobody would ever fail this request,
             # so do it here rather than hang the waiter
             try:
-                self._finish(req, error="engine shut down")
+                self._finish(req, error="engine shut down",
+                             reason="shutdown")
             except AlreadyFinished:
                 pass
             raise DMLCError("engine shut down")
@@ -256,7 +280,8 @@ class InferenceEngine:
             self._thread = None
         for req in self.scheduler.all_pending():
             try:
-                self._finish(req, error="engine shut down")
+                self._finish(req, error="engine shut down",
+                             reason="shutdown")
             except AlreadyFinished:
                 pass  # racing terminal transition already happened
 
@@ -272,13 +297,19 @@ class InferenceEngine:
                 for req in self.scheduler.active_requests():
                     try:
                         self._finish(
-                            req, error=f"engine iteration failed: {e!r}")
+                            req, error=f"engine iteration failed: {e!r}",
+                            reason="crash")
                     except AlreadyFinished:
                         pass
                 logger.error("serving iteration failed: %r", e)
                 did = False
             if not did:
-                time.sleep(0.002)  # idle: nothing waiting, nothing active
+                # idle: nothing waiting, nothing active — but the SLO
+                # windows keep aging, so evaluation must keep running
+                # (a violation flips back when its burst expires even
+                # if no request ever arrives again; throttled inside)
+                self.slo.maybe_evaluate()
+                time.sleep(0.002)
 
     # ---- one iteration --------------------------------------------------
     def step(self) -> bool:
@@ -301,8 +332,13 @@ class InferenceEngine:
         finally:
             self._stepping = False
 
-    def _finish(self, req: Request, error: Optional[str] = None) -> None:
+    def _finish(self, req: Request, error: Optional[str] = None,
+                reason: Optional[str] = None) -> None:
         self.scheduler.finish(req, error=error)
+        # scheduler.finish raising AlreadyFinished above is the
+        # exactly-once guard for the ledger too: a swept request can
+        # never be recorded twice
+        self.requests.on_finish(req.id, error=error, reason=reason)
         if req.latency_s is not None:
             telemetry.observe_duration("serving", "latency", req.latency_s)
         tps = req.decode_tokens_per_s
@@ -328,13 +364,15 @@ class InferenceEngine:
             # iteration window can race it; retry next iteration
             self.scheduler.requeue_front(req)
             return
+        resume = bool(req.generated)
         try:
             padded = n + (-n % bs)
             ids = np.zeros((1, padded), np.int32)
             ids[0, :n] = ctx
             t0 = time.perf_counter()
-            with telemetry.span("serving.prefill",
-                                stage="serving", args={"tokens": n}):
+            self.requests.on_prefill_begin(req.id, t=t0, resume=resume)
+            with telemetry.span("serving.prefill", stage="serving",
+                                args={"tokens": n, "req": req.id}):
                 logits, k, v = self._prefill(
                     self.params, ids, np.array([n - 1], np.int32),
                     self.cfg)
@@ -347,40 +385,53 @@ class InferenceEngine:
             self.cache.write(req.id, k, v, start=0)
         except Exception as e:  # noqa: BLE001 - fail THIS request only
             logger.error("prefill of request %d failed: %r", req.id, e)
-            self._finish(req, error=f"prefill failed: {e!r}")
+            self._finish(req, error=f"prefill failed: {e!r}",
+                         reason="prefill")
             return
-        if not req.generated:
+        if not resume:
             if not np.isfinite(logits).all():
                 # same guard at the prefill sample point: the first
                 # token must not come from a non-finite row either
                 telemetry.inc("serving", "nonfinite_failures")
                 self._finish(req, error="non-finite logits during "
                              "prefill (numeric corruption); retry the "
-                             "request")
+                             "request", reason="nonfinite")
                 return
             next_id = int(np.argmax(logits))
             req.generated.append(next_id)
             telemetry.inc("serving", "tokens_generated")
             req.ttft_s = time.monotonic() - req.submit_t
             telemetry.observe_duration("serving", "ttft", req.ttft_s)
+            # the ledger's TTFT moment: stamps ttft_s = queue_s +
+            # prefill_s exactly (all from one clock)
+            self.requests.on_first_token(req.id)
             if req.is_finished_by(next_id):
                 self._finish(req)
                 return
+        else:
+            # resume prefill re-cached context without sampling; decode
+            # resumes from generated[-1] next iteration
+            self.requests.on_prefill_end(req.id)
         self.scheduler.activate(req)
 
-    def _ensure_decode_capacity(self,
-                                active: List[Request]) -> List[Request]:
+    def _ensure_decode_capacity(self, active: List[Request]) -> tuple:
         """Reserve one more cache slot per active request, preempting
-        youngest-first under pressure; returns the surviving batch."""
+        youngest-first under pressure; returns ``(survivors,
+        n_preempted)`` — the count feeds the iteration record."""
         alive = []
+        n_preempted = 0
         for req in active:
             if req.state != ACTIVE:
                 continue  # a preemption below already took it out
             while not self.cache.extend(req.id, 1):
                 victim = self.scheduler.preempt_youngest()
+                if victim is not None:
+                    n_preempted += 1
+                    self.requests.on_preempt(victim.id)
                 if victim is None:
                     self._finish(req, error="kv cache exhausted with "
-                                 "nothing left to evict")
+                                 "nothing left to evict",
+                                 reason="kv_exhausted")
                     break
                 if victim is req:
                     break  # preempted itself; resumes via re-prefill
@@ -389,11 +440,15 @@ class InferenceEngine:
         # a LATER request's eviction can preempt an EARLIER survivor
         # (activation order is not age order once resumes re-append):
         # only still-active requests may decode
-        return [r for r in alive if r.state == ACTIVE]
+        return [r for r in alive if r.state == ACTIVE], n_preempted
 
     def _run_decode(self, active: List[Request]) -> None:
-        active = self._ensure_decode_capacity(active)
+        active, n_preempted = self._ensure_decode_capacity(active)
         if not active:
+            if n_preempted:
+                self.requests.on_iteration(
+                    active=0, waiting=self.scheduler.n_waiting,
+                    preempted=n_preempted, kv_stats=self.cache.stats())
             return
         b = len(active)
         pad_b = self.max_active
@@ -431,6 +486,7 @@ class InferenceEngine:
         # keeps the guard O(1) per row instead of O(vocab) on the decode
         # hot path.  Fail exactly that request with a clear error; the
         # rest of the batch (and the engine) keep serving.
+        n_tokens = 0
         for i, req in enumerate(active):
             next_id = int(np.argmax(logits[i]))
             if not np.isfinite(logits[i, next_id]):
@@ -440,13 +496,25 @@ class InferenceEngine:
                              int(lengths[i]))
                 self._finish(req, error="non-finite logits during "
                              "decode (numeric corruption); retry the "
-                             "request")
+                             "request", reason="nonfinite")
                 continue
             self.cache.append(req.id, k_new[:, i], v_new[:, i])
             req.generated.append(next_id)
+            n_tokens += 1
             telemetry.inc("serving", "tokens_generated")
+            self.requests.on_token(req.id)
             if req.is_finished_by(next_id):
                 self._finish(req)
+        # the decode ledger's per-iteration record: batch composition +
+        # admission queue depth + KV pressure — the /requests load
+        # signal a router/autoscaler consumes — then a throttled SLO
+        # burn-rate evaluation on fresh evidence.  tokens counts what
+        # actually landed (a nonfinite-guarded row produced none)
+        self.requests.on_iteration(
+            active=b, waiting=self.scheduler.n_waiting,
+            preempted=n_preempted, tokens=n_tokens,
+            kv_stats=self.cache.stats())
+        self.slo.maybe_evaluate()
 
     # ---- observability --------------------------------------------------
     def stats(self) -> dict:
@@ -456,4 +524,6 @@ class InferenceEngine:
             "max_active": self.max_active,
             "kv": self.cache.stats(),
             "ledger": telemetry.ledger().summary(),
+            "requests": self.requests.summary(),
+            "slo_active": self.slo.active(),
         }

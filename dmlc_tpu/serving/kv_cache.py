@@ -154,6 +154,11 @@ class PagedKVCache:
         self.v_pool = np.zeros(shape, dtype)
         self._alloc = BlockAllocator(self.n_blocks)
         self._seqs: Dict[int, _SeqEntry] = {}
+        # running Σ length over live sequences: occupancy/waste gauges
+        # and stats() stay O(1) on the decode hot path (extend runs
+        # once per active request per iteration — re-summing all live
+        # sequences there measurably taxes the decode step)
+        self._cached_tokens = 0
         self._lock = make_lock("PagedKVCache._lock")
         telemetry.set_gauge("serving", "kv_blocks_total", self.n_blocks)
         self._publish_usage()
@@ -220,6 +225,7 @@ class PagedKVCache:
             ent = self._seqs.pop(seq_id, None)
             if ent is None:
                 return
+            self._cached_tokens -= ent.length
             self._alloc.free(ent.blocks)
         self._publish_usage()
 
@@ -260,7 +266,9 @@ class PagedKVCache:
                     f"write past reservation: seq {seq_id} end={end} "
                     f"blocks={len(ent.blocks)}×{self.block_size}")
             blocks = list(ent.blocks)
-            ent.length = max(ent.length, end)
+            new_len = max(ent.length, end)
+            self._cached_tokens += new_len - ent.length
+            ent.length = new_len
         bs = self.block_size
         off = 0
         while off < t:
@@ -341,19 +349,33 @@ class PagedKVCache:
         return jax.device_put(k, sh), jax.device_put(v, sh)
 
     # ---- observability --------------------------------------------------
-    def stats(self) -> Dict[str, int]:
+    def stats(self) -> Dict[str, float]:
         with self._lock:
             live = len(self._seqs)
-            tokens = sum(e.length for e in self._seqs.values())
+            tokens = self._cached_tokens
+            in_use = self._alloc.n_in_use
+        # occupancy: pool pressure the admission test acts on; waste:
+        # allocated-but-unfilled token slots (final partial blocks +
+        # reserve-ahead) — the paged layout's only fragmentation, so a
+        # drifting waste gauge means the block size is wrong for the
+        # workload
         return {
             "n_blocks": self.n_blocks,
             "block_size": self.block_size,
-            "blocks_in_use": self._alloc.n_in_use,
-            "blocks_free": self._alloc.n_free,
+            "blocks_in_use": in_use,
+            "blocks_free": self.n_blocks - in_use,
             "live_sequences": live,
             "cached_tokens": tokens,
+            "occupancy": in_use / self.n_blocks,
+            "waste_tokens": in_use * self.block_size - tokens,
         }
 
     def _publish_usage(self) -> None:
-        telemetry.set_gauge("serving", "kv_blocks_in_use",
-                            self._alloc.n_in_use)
+        with self._lock:
+            in_use = self._alloc.n_in_use
+            tokens = self._cached_tokens
+        telemetry.set_gauge("serving", "kv_blocks_in_use", in_use)
+        telemetry.set_gauge("serving", "kv_occupancy_pct",
+                            100.0 * in_use / self.n_blocks)
+        telemetry.set_gauge("serving", "kv_waste_tokens",
+                            in_use * self.block_size - tokens)

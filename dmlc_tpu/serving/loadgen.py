@@ -22,16 +22,12 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 from ..concurrency import make_lock
+# one shared nearest-rank percentile for client AND server summaries:
+# the smoke compares the two against each other, so they must never
+# drift onto different conventions
+from ..telemetry.requests import percentile  # noqa: F401 - re-export
 
 __all__ = ["LoadGenerator", "percentile"]
-
-
-def percentile(values: List[float], q: float) -> Optional[float]:
-    """Nearest-rank percentile (same convention as StepLedger.summary)."""
-    if not values:
-        return None
-    vs = sorted(values)
-    return vs[min(int(q / 100.0 * len(vs)), len(vs) - 1)]
 
 
 class LoadGenerator:
@@ -120,6 +116,16 @@ class LoadGenerator:
         tps = [r["decode_tokens_per_s"] for r in self.results
                if r.get("decode_tokens_per_s")]
         gen = sum(r.get("n_generated", 0) for r in self.results)
+        # client-vs-server corroboration: the client clock covers HTTP
+        # transport + handler queueing AROUND the server-side request
+        # lifetime, so per request (client latency - server latency)
+        # must be positive and small — a negative delta means the two
+        # timing paths disagree about what a request is, and a large
+        # one means the HTTP edge (not the engine) is the bottleneck
+        deltas = [r["client_latency_s"] - r["latency_s"]
+                  for r in self.results
+                  if r.get("latency_s") is not None
+                  and r.get("client_latency_s") is not None]
         out = {
             "n_streams": self.n_streams,
             "n_requests_ok": len(self.results),
@@ -136,19 +142,38 @@ class LoadGenerator:
                  if r.get("latency_s") is not None], 50),
             "preemptions": sum(r.get("preemptions", 0)
                                for r in self.results),
+            "client_server_delta_p50_s": percentile(deltas, 50),
+            "client_server_delta_p99_s": percentile(deltas, 99),
         }
         return out
 
     # ---- artifact -------------------------------------------------------
-    def healthz(self) -> Dict:
-        with urllib.request.urlopen(self.url + "/healthz",
-                                    timeout=30) as resp:
+    def fetch_json(self, path: str, timeout: float = 30.0) -> Dict:
+        with urllib.request.urlopen(self.url + path,
+                                    timeout=timeout) as resp:
             return json.loads(resp.read())
+
+    def _fetch_optional(self, path: str) -> Dict:
+        """A newer-endpoint fetch that degrades to {} against an older
+        replica (same policy as dmlc-top: the artifact loses the join
+        keys, never the whole measured run)."""
+        try:
+            return self.fetch_json(path)
+        except (urllib.error.HTTPError, urllib.error.URLError, OSError,
+                ValueError):
+            return {}
+
+    def healthz(self) -> Dict:
+        return self.fetch_json("/healthz")
 
     def emit_bench(self, path: str, summary: Dict,
                    extra: Optional[Dict] = None) -> Dict:
-        """Join the client summary with the engine ledger (/healthz) and
-        write the one-line BENCH_serving.json artifact."""
+        """Join the client summary with the server-side views — the
+        decode step ledger (/healthz) and the request ledger
+        (/requests: queue-wait/TBT percentiles, preemption rate, KV
+        occupancy) — and write the one-line BENCH_serving.json
+        artifact: the before/after surface serving optimisations are
+        judged on."""
         ledger = self.healthz().get("ledger", {}) or {}
         doc = dict(summary)
         doc["decode_mfu"] = ledger.get("mfu")
@@ -157,6 +182,18 @@ class LoadGenerator:
         doc["decode_goodput_tokens_per_s"] = ledger.get(
             "goodput_tokens_per_s")
         doc["decode_steps"] = ledger.get("steps")
+        reqs = self._fetch_optional("/requests").get("summary", {}) or {}
+        doc["queue_wait_p50_s"] = reqs.get("queue_wait_p50_s")
+        doc["queue_wait_p99_s"] = reqs.get("queue_wait_p99_s")
+        doc["prefill_p99_s"] = reqs.get("prefill_p99_s")
+        doc["server_ttft_p99_s"] = reqs.get("ttft_p99_s")
+        doc["tbt_p50_s"] = reqs.get("tbt_p50_s")
+        doc["tbt_p99_s"] = reqs.get("tbt_p99_s")
+        doc["preemption_rate"] = reqs.get("preemption_rate")
+        doc["kv_occupancy"] = reqs.get("kv_occupancy")
+        doc["kv_waste_tokens"] = reqs.get("kv_waste_tokens")
+        slo = self._fetch_optional("/slo")
+        doc["slo_active"] = slo.get("active", [])
         if extra:
             doc.update(extra)
         with open(path, "w") as f:

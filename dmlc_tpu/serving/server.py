@@ -23,9 +23,28 @@ generations are never dropped by the shutdown notice itself.
 Endpoints:
   POST /generate   {"prompt": [int, ...], "max_tokens": int?}
                    → request result document (scheduler.Request.result)
-  GET  /metrics    local Prometheus exposition (serving + step-ledger
-                   families ride the existing exporter)
-  GET  /healthz    engine stats: queues, KV pool, ledger summary
+  GET  /metrics    local Prometheus exposition (serving + step-ledger +
+                   hand-rendered dmlc_slo_* families)
+  GET  /healthz    engine stats: queues, KV pool, ledger + request
+                   summaries
+  GET  /requests   request ledger document: summary percentiles
+                   (TTFT = queue + prefill, TBT), live + recent
+                   requests, decode-iteration ring (router load signal)
+  GET  /slo        SLO burn-rate document (objectives, windows, active
+                   violations); the GET forces a fresh evaluation
+  GET  /trace      this replica's local Chrome trace — engine threads
+                   plus one labeled row per request and SLO-violation
+                   instant markers (tracker-launched replicas ALSO ship
+                   the same spans via heartbeats onto the merged
+                   cluster /trace)
+
+Every ``/generate`` response increments a per-status-code counter
+(``dmlc_serving_http_<code>``), so admission pressure (429), oversize
+rejections (413), and crash-guard failures (503) are visible on
+/metrics without log scraping; a POST to an unknown path counts as
+``http_404`` (a misrouted client).  GET 404s are deliberately NOT
+counted — monitoring tools probe optional endpoints by design, and a
+watcher must never fabricate the signal it renders.
 """
 
 from __future__ import annotations
@@ -38,6 +57,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from .. import telemetry
+from ..telemetry import core as _tcore
+from ..telemetry.exporters import to_chrome_trace
 from .engine import (AdmissionFull, EngineDraining, InferenceEngine,
                      RequestTooLarge)
 
@@ -46,6 +67,27 @@ __all__ = ["ServingHTTPServer"]
 logger = logging.getLogger("dmlc_tpu.serving")
 
 MAX_BODY_BYTES = 1 << 20  # a prompt is ids, not a payload dump
+
+#: the status codes /generate can answer with, each its own registered
+#: counter family (a dynamic f-string name would mint unregistered
+#: families); anything else folds to http_other
+_STATUS_COUNTERS = {200: "http_200", 400: "http_400", 404: "http_404",
+                    413: "http_413", 429: "http_429", 503: "http_503"}
+
+
+def _local_trace(engine: InferenceEngine) -> dict:
+    """The standalone replica's /trace document: the local span ring
+    (engine threads + per-request ledger rows) with SLO violations as
+    instant markers on the same span timebase."""
+    doc = to_chrome_trace()
+    anchor = _tcore.anchor_epoch()
+    for m in engine.slo.trace_markers():
+        doc["traceEvents"].append({
+            "name": str(m["name"]), "cat": "slo", "ph": "i", "s": "g",
+            "ts": round(max((float(m["t"]) - anchor) * 1e6, 0.0), 3),
+            "pid": 0, "tid": 0,
+        })
+    return doc
 
 
 class ServingHTTPServer:
@@ -72,33 +114,62 @@ class ServingHTTPServer:
                            json.dumps(doc).encode(),
                            extra_headers=extra_headers)
 
+            def _answer(self, code: int, doc, extra_headers=None) -> None:
+                """A /generate response: counted per status code so the
+                admission/failure mix is a /metrics query, then sent."""
+                telemetry.inc("serving",
+                              _STATUS_COUNTERS.get(code, "http_other"))
+                self._send_json(code, doc, extra_headers=extra_headers)
+
             def do_GET(self):  # noqa: N802 - http.server API
                 path = self.path.split("?", 1)[0]
                 if path == "/metrics":
+                    text = (telemetry.to_prometheus_text()
+                            + eng.slo.prometheus_text())
                     self._send(200,
                                "text/plain; version=0.0.4; charset=utf-8",
-                               telemetry.to_prometheus_text().encode())
+                               text.encode())
                 elif path == "/healthz":
                     self._send_json(200, {"status": "ok", **eng.stats()})
+                elif path == "/requests":
+                    self._send_json(200, eng.requests.report())
+                elif path == "/slo":
+                    eng.slo.evaluate()
+                    self._send_json(200, eng.slo.report())
+                elif path == "/trace":
+                    try:
+                        body = json.dumps(_local_trace(eng)).encode()
+                    except (TypeError, ValueError) as e:
+                        logger.warning("/trace render failed: %r", e)
+                        self._send(503, "text/plain",
+                                   b"trace render failed\n")
+                        return
+                    self._send(200, "application/json", body)
                 else:
+                    # GET 404s are NOT counted: monitoring tools probe
+                    # optional endpoints by design (dmlc-top polls
+                    # /anomalies on every target), and a watcher must
+                    # never fabricate the counter it renders
                     self._send(404, "text/plain", b"not found\n")
 
             def do_POST(self):  # noqa: N802 - http.server API
                 path = self.path.split("?", 1)[0]
                 if path != "/generate":
+                    # a POST to a wrong path IS a misrouted request
+                    telemetry.inc("serving", "http_404")
                     self._send(404, "text/plain", b"not found\n")
                     return
                 if eng.draining:
                     # shutting down on a preemption notice: point the
                     # client (or its load balancer) elsewhere while the
                     # in-flight generations finish
-                    self._send_json(503, {"error": "server draining"},
-                                    extra_headers={"Retry-After": "5"})
+                    self._answer(503, {"error": "server draining"},
+                                 extra_headers={"Retry-After": "5"})
                     return
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     if n > MAX_BODY_BYTES:
-                        self._send_json(413, {"error": "body too large"})
+                        self._answer(413, {"error": "body too large"})
                         return
                     doc = json.loads(self.rfile.read(n) or b"{}")
                     prompt = doc["prompt"]
@@ -110,35 +181,35 @@ class ServingHTTPServer:
                         max_tokens = int(max_tokens)
                 except (KeyError, ValueError, TypeError,
                         json.JSONDecodeError) as e:
-                    self._send_json(400, {"error": f"bad request: {e}"})
+                    self._answer(400, {"error": f"bad request: {e}"})
                     return
                 try:
                     req = eng.submit(prompt, max_new_tokens=max_tokens)
                 except AdmissionFull as e:
-                    self._send_json(429, {"error": str(e)},
-                                    extra_headers={"Retry-After": "1"})
+                    self._answer(429, {"error": str(e)},
+                                 extra_headers={"Retry-After": "1"})
                     return
                 except RequestTooLarge as e:
-                    self._send_json(413, {"error": str(e)})
+                    self._answer(413, {"error": str(e)})
                     return
                 except EngineDraining as e:
-                    self._send_json(503, {"error": str(e)},
-                                    extra_headers={"Retry-After": "5"})
+                    self._answer(503, {"error": str(e)},
+                                 extra_headers={"Retry-After": "5"})
                     return
                 except ValueError as e:
                     # content errors (out-of-vocab ids, bad bounds) are
                     # the client's 400, not a size problem
-                    self._send_json(400, {"error": str(e)})
+                    self._answer(400, {"error": str(e)})
                     return
                 if not req.wait(wait_s):
-                    self._send_json(503, {"error": "generation timed out",
-                                          "id": req.id})
+                    self._answer(503, {"error": "generation timed out",
+                                       "id": req.id})
                     return
                 doc = req.result()
                 if req.error:
-                    self._send_json(503, doc)
+                    self._answer(503, doc)
                 else:
-                    self._send_json(200, doc)
+                    self._answer(200, doc)
 
             def log_message(self, fmt, *args):
                 logger.debug("serving http: " + fmt, *args)

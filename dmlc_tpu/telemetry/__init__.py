@@ -26,6 +26,13 @@ counters, which remains as a compatible shim over this package):
   * ``anomaly``    tracker-side online watchdog over shipped step
                    records (stragglers, regressions, feed-stall
                    dominance, goodput collapse) behind /anomalies
+  * ``requests``   serving request ledger: per-request lifecycle
+                   (queue/prefill/TTFT/TBT/preempt/finish-with-reason)
+                   with per-request /trace rows and a decode-iteration
+                   ring behind the serving /requests endpoint
+  * ``slo``        declarative serving SLOs (DMLC_SLO_*) evaluated as
+                   multi-window burn rates behind /slo; violations
+                   flow into the watchdog's anomaly surface
   * ``metric_names`` the checked-in metric-name contract registry
                    (scripts/lint.py enforces it)
 
@@ -50,6 +57,8 @@ from . import (  # noqa: F401
     heartbeat,
     metric_names,
     postmortem,
+    requests,
+    slo,
     steps,
 )
 from .anomaly import Watchdog  # noqa: F401
@@ -64,6 +73,7 @@ from .core import (  # noqa: F401
     observe,
     observe_duration,
     open_spans,
+    record_span,
     reset,
     set_gauge,
     snapshot,
@@ -79,6 +89,8 @@ from .events import (  # noqa: F401
     reset_events,
 )
 from .flight import FlightRecorder  # noqa: F401
+from .requests import RequestLedger  # noqa: F401
+from .slo import SLOMonitor  # noqa: F401
 from .exporters import (  # noqa: F401
     export_json,
     to_chrome_trace,
@@ -109,6 +121,8 @@ __all__ = [
     "FlightRecorder",
     "Histogram",
     "HeartbeatSender",
+    "RequestLedger",
+    "SLOMonitor",
     "StepLedger",
     "TelemetryAggregator",
     "TelemetryHTTPServer",
@@ -127,6 +141,7 @@ __all__ = [
     "observe_duration",
     "open_spans",
     "record_event",
+    "record_span",
     "reset",
     "reset_events",
     "reset_steps",

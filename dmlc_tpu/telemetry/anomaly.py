@@ -42,6 +42,7 @@ from typing import Dict, List, Optional
 
 from ..base import get_env
 from ..concurrency import make_lock
+from .slo import SLO_KINDS
 
 __all__ = ["Watchdog", "ANOMALY_KINDS"]
 
@@ -128,6 +129,9 @@ class Watchdog:
             sh = doc.get("selfheal")
             if isinstance(sh, dict):
                 self.ingest_remediation(rank, sh)
+            slo = doc.get("slo")
+            if isinstance(slo, dict):
+                self.ingest_slo(rank, slo)
             trace = doc.get("trace")
             if not isinstance(trace, dict):
                 return
@@ -156,6 +160,38 @@ class Watchdog:
         with self._lock:
             st = self._ranks.setdefault(rank, _RankState())
             st.remediation = clean
+
+    def ingest_slo(self, rank: int, doc: Dict) -> None:
+        """Mirror a serving replica's shipped SLO status (the heartbeat
+        ``slo`` sub-doc from telemetry.slo) into this rank's anomaly
+        flags under :data:`SLO_KINDS`.  The burn-rate windows already
+        hysterize on the worker side, so flags apply/clear directly —
+        no consecutive-step gating — and step-record ingestion never
+        touches them (its clear loop covers ANOMALY_KINDS only)."""
+        if rank < 0 or not isinstance(doc, dict):
+            return
+        active = doc.get("active")
+        if not isinstance(active, list):
+            return
+        active_set = {k for k in active if k in SLO_KINDS}
+        burn = doc.get("burn") if isinstance(doc.get("burn"), dict) else {}
+        fresh = []
+        with self._lock:
+            st = self._ranks.setdefault(rank, _RankState())
+            for kind in SLO_KINDS:
+                if kind in active_set and kind not in st.active:
+                    st.active.add(kind)
+                    st.active_since[kind] = time.time()
+                    fresh.append((kind,
+                                  f"replica-reported SLO violation "
+                                  f"(burn {burn})"))
+                elif kind not in active_set and kind in st.active:
+                    st.active.discard(kind)
+                    st.active_since.pop(kind, None)
+                    self._log.info("anomaly cleared: rank %d %s",
+                                   rank, kind)
+        for kind, detail in fresh:
+            self._flag(rank, kind, detail, {}, step_gated=False)
 
     def ingest(self, rank: int, records: List[Dict],
                anchor: Optional[float] = None) -> None:
@@ -282,7 +318,8 @@ class Watchdog:
         mad = _lower_median([abs(x - med) for x in samples])
         return med, mad
 
-    def _flag(self, rank: int, kind: str, detail: str, rec: Dict) -> None:
+    def _flag(self, rank: int, kind: str, detail: str, rec: Dict,
+              step_gated: bool = True) -> None:
         from . import core, events
 
         core.inc("anomaly", f"{kind}_flags")
@@ -293,9 +330,16 @@ class Watchdog:
             self._verdicts.append(v)
         events.record_event("anomaly", rank=rank, anomaly=kind,
                             detail=detail)
-        self._log.warning(
-            "anomaly: rank %d %s for %d consecutive steps (%s)",
-            rank, kind, self.window, detail)
+        if step_gated:
+            self._log.warning(
+                "anomaly: rank %d %s for %d consecutive steps (%s)",
+                rank, kind, self.window, detail)
+        else:
+            # SLO kinds fire on one shipped heartbeat (the replica's
+            # burn-rate windows already hysterize) — a step count here
+            # would be fabricated
+            self._log.warning("anomaly: rank %d %s (%s)",
+                              rank, kind, detail)
 
     def drop(self, rank: int) -> None:
         """Forget a rank (declared dead): the replacement's baselines
@@ -362,7 +406,7 @@ class Watchdog:
             items = [(r, sorted(st.active))
                      for r, st in sorted(self._ranks.items())]
         for r, kinds in items:
-            for kind in ANOMALY_KINDS:
+            for kind in ANOMALY_KINDS + SLO_KINDS:
                 val = 1 if kind in kinds else 0
                 lines.append(
                     f'dmlc_anomaly_active{{rank="{r}",kind="{kind}"}} '

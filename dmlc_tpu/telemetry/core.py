@@ -44,6 +44,7 @@ __all__ = [
     "observe",
     "observe_duration",
     "timed",
+    "record_span",
     "span",
     "spans",
     "spans_since",
@@ -274,6 +275,40 @@ def span(name: str, stage: str = "dmlc", args: Optional[Dict] = None):
             _span_seq += 1
             rec["seq"] = _span_seq
             _spans.append(rec)
+
+
+def record_span(name: str, stage: str = "dmlc", *, t0: float, t1: float,
+                tid=None, thread: Optional[str] = None,
+                args: Optional[Dict] = None) -> Dict:
+    """Record an ALREADY-COMPLETED span into the ring.
+
+    ``t0``/``t1`` are ``time.perf_counter()`` stamps (the span clock's
+    timebase).  Unlike :func:`span`, the caller may assign a synthetic
+    ``tid``/``thread`` — the request ledger (telemetry.requests) draws
+    each request's lifecycle (queue → prefill → decode slices) on its
+    own per-request row of the Chrome trace this way, and because the
+    record lands in the ordinary ring it ships through the heartbeat
+    ``trace`` path onto the tracker's merged ``/trace`` with no extra
+    plumbing.  Synthetic spans do not touch the per-thread open-span
+    stacks (they are closed by construction)."""
+    global _span_seq
+    th = threading.current_thread()
+    rec: Dict = {
+        "name": name,
+        "cat": stage,
+        "ts": (t0 - _T0) * 1e6,
+        "dur": max(t1 - t0, 0.0) * 1e6,
+        "tid": th.ident if tid is None else tid,
+        "thread": th.name if thread is None else str(thread),
+        "depth": 0,
+    }
+    if args:
+        rec["args"] = dict(args)
+    with _lock:
+        _span_seq += 1
+        rec["seq"] = _span_seq
+        _spans.append(rec)
+    return rec
 
 
 def spans() -> List[Dict]:

@@ -528,6 +528,15 @@ class HeartbeatSender:
         sh = selfheal.status()
         if sh:
             doc["selfheal"] = sh
+        # serving SLO status (telemetry.slo): a serving replica's
+        # active violations + burn rates ride every beat, so the
+        # tracker watchdog surfaces them on /anomalies next to the
+        # step-health flags (training processes ship nothing here)
+        from . import slo as slo_mod
+
+        slo_doc = slo_mod.status()
+        if slo_doc:
+            doc["slo"] = slo_doc
         if self.ship_trace:
             doc["trace"] = self._trace_doc()
             payload = self._capped_payload(doc)

@@ -23,12 +23,16 @@ __all__ = ["METRIC_NAMES", "SPAN_ANNOTATIONS", "NON_METRIC_TOKENS"]
 #: every exported metric family (base name: the exposition-format
 #: ``_bucket``/``_sum``/``_count`` suffixes of histograms are implied)
 METRIC_NAMES = frozenset({
-    # anomaly watchdog (tracker side)
+    # anomaly watchdog (tracker side; slo_* kinds are replica-shipped
+    # SLO violations mirrored by Watchdog.ingest_slo)
     "dmlc_anomaly_active",
     "dmlc_anomaly_straggler_flags",
     "dmlc_anomaly_regression_flags",
     "dmlc_anomaly_feed_stall_flags",
     "dmlc_anomaly_goodput_collapse_flags",
+    "dmlc_anomaly_slo_ttft_flags",
+    "dmlc_anomaly_slo_tbt_flags",
+    "dmlc_anomaly_slo_error_rate_flags",
     # elastic world resize (tracker generations + client + launcher)
     "dmlc_elastic_resizes_total",
     "dmlc_elastic_shrinks_total",
@@ -142,17 +146,45 @@ METRIC_NAMES = frozenset({
     "dmlc_serving_kv_alloc_failures",
     "dmlc_serving_kv_blocks_in_use",
     "dmlc_serving_kv_blocks_total",
+    "dmlc_serving_kv_occupancy_pct",
+    "dmlc_serving_kv_waste_tokens",
     "dmlc_serving_latency_secs",
     "dmlc_serving_nonfinite_failures",
     "dmlc_serving_preemptions",
     "dmlc_serving_prefill_secs",
     "dmlc_serving_prefill_tokens",
     "dmlc_serving_queue_depth",
+    "dmlc_serving_queue_wait_secs",
     "dmlc_serving_rejected",
     "dmlc_serving_requests",
+    "dmlc_serving_resumes",
+    "dmlc_serving_tbt_secs",
     "dmlc_serving_tokens_generated",
     "dmlc_serving_tokens_per_s_per_user",
     "dmlc_serving_ttft_secs",
+    # serving HTTP edge: per-status-code /generate response counters
+    # (serving/server.py _STATUS_COUNTERS)
+    "dmlc_serving_http_200",
+    "dmlc_serving_http_400",
+    "dmlc_serving_http_404",
+    "dmlc_serving_http_413",
+    "dmlc_serving_http_429",
+    "dmlc_serving_http_503",
+    "dmlc_serving_http_other",
+    # serving per-reason failure counters (telemetry.requests
+    # FAIL_REASONS; "dmlc_serving_failed_" + slug)
+    "dmlc_serving_failed_shutdown",
+    "dmlc_serving_failed_crash",
+    "dmlc_serving_failed_prefill",
+    "dmlc_serving_failed_nonfinite",
+    "dmlc_serving_failed_kv_exhausted",
+    "dmlc_serving_failed_other",
+    # serving SLO monitor (telemetry.slo): counter + hand-rendered
+    # labeled gauge families on the serving /metrics
+    "dmlc_slo_violations",
+    "dmlc_slo_burn_rate",
+    "dmlc_slo_violation_active",
+    "dmlc_slo_objective_threshold",
     # step ledger
     "dmlc_step_collective_secs",
     "dmlc_step_collective_overlapped_secs",
@@ -200,6 +232,8 @@ NON_METRIC_TOKENS = frozenset({
     "dmlc_selfheal",      # prose prefix for the dmlc_selfheal_* family
     "dmlc_serving",       # prose prefix for the dmlc_serving_* family
     "dmlc_serve",         # bin/dmlc-serve launcher name in prose
+    "dmlc_slo",           # prose prefix for the dmlc_slo_* family
+    "dmlc_serving_http",  # prose prefix: dmlc_serving_http_<code>
     "dmlc_recordio_spans",  # native ABI symbol (dmlc_native.cc)
     "dmlc_recordio_spans_verify",  # native ABI symbol (fused scan+verify)
     "dmlc_pack_spans",      # native ABI symbol

@@ -15,6 +15,15 @@ self-heal remediation (``skip@<step>``, ``rollback@<step>`` — what the
 worker DID about a poisoned step), and ``HB AGE`` is heartbeat
 staleness from /healthz (dead ranks render as ``DEAD``).
 
+Pointed at a serving replica (``dmlc-serve``'s port) instead of a
+tracker, the same poll picks up ``/requests`` + ``/slo`` and renders a
+**serving pane** under the rank table: request throughput and failure
+mix, server-side TTFT decomposition (queue/prefill) and TBT p99,
+preemption rate, KV occupancy, and per-objective SLO burn rates with
+active violations highlighted.  Against a tracker, serving replicas'
+SLO flags (``slo_ttft``/``slo_tbt``/``slo_error_rate``) appear in the
+per-rank FLAGS column via the heartbeat-shipped status.
+
 Runs full-screen (curses) when stdout is a TTY; ``--plain`` prints one
 table per refresh instead (pipe-friendly, and what the CI smoke
 drives).  ``--once`` renders a single refresh and exits.
@@ -30,7 +39,7 @@ import sys
 import time
 import urllib.request
 
-__all__ = ["fetch", "render_table", "main"]
+__all__ = ["fetch", "render_table", "render_serving_pane", "main"]
 
 COLUMNS = ("RANK", "STEP ms", "EWMA ms", "GOODPUT", "MFU%", "FEED%",
            "HB AGE", "FLAGS", "REMED")
@@ -55,10 +64,13 @@ def _remed(st: dict) -> str:
 
 
 def fetch(base_url: str, timeout: float = 5.0) -> dict:
-    """One poll: {"anomalies": ..., "healthz": ...} (missing endpoint →
-    empty dict, so the view degrades instead of dying mid-watch)."""
+    """One poll: anomalies/healthz (tracker) + requests/slo (serving
+    replica) — a missing endpoint yields an empty dict, so the view
+    degrades to whatever the target actually serves instead of dying
+    mid-watch."""
     out = {}
-    for key, path in (("anomalies", "/anomalies"), ("healthz", "/healthz")):
+    for key, path in (("anomalies", "/anomalies"), ("healthz", "/healthz"),
+                      ("requests", "/requests"), ("slo", "/slo")):
         try:
             with urllib.request.urlopen(base_url + path,
                                         timeout=timeout) as r:
@@ -74,6 +86,43 @@ def _ms(v) -> str:
 
 def _num(v, fmt="{:.0f}") -> str:
     return fmt.format(v) if isinstance(v, (int, float)) else "-"
+
+
+def render_serving_pane(doc: dict) -> list:
+    """The serving pane lines (empty when the target serves no
+    /requests — i.e. it is a tracker, not a replica)."""
+    summ = (doc.get("requests") or {}).get("summary") or {}
+    if not summ:
+        return []
+
+    def ms(key):
+        v = summ.get(key)
+        return f"{v * 1e3:.1f}ms" if isinstance(v, (int, float)) else "-"
+
+    fails = summ.get("fail_reasons") or {}
+    fail_txt = (" (" + ",".join(f"{k}:{v}" for k, v in sorted(fails.items()))
+                + ")") if fails else ""
+    occ = summ.get("kv_occupancy")
+    lines = [
+        "serving  ok={} failed={}{} live={} queue={} "
+        "ttft_p99={} (q_p99={} prefill_p99={}) tbt_p99={} "
+        "preempt_rate={:.2f} kv_occ={}".format(
+            summ.get("requests_done", 0), summ.get("requests_failed", 0),
+            fail_txt, summ.get("live_requests", 0),
+            summ.get("decode_queue_depth", 0),
+            ms("ttft_p99_s"), ms("queue_wait_p99_s"), ms("prefill_p99_s"),
+            ms("tbt_p99_s"), summ.get("preemption_rate") or 0.0,
+            f"{occ * 100:.0f}%" if isinstance(occ, (int, float)) else "-")]
+    slo = doc.get("slo") or {}
+    objs = slo.get("objectives") or {}
+    if objs:
+        parts = []
+        for name, o in sorted(objs.items()):
+            mark = " VIOLATION" if o.get("violating") else ""
+            parts.append(f"{name} {o.get('burn_fast', 0):.1f}x/"
+                         f"{o.get('burn_slow', 0):.1f}x{mark}")
+        lines.append("slo      burn fast/slow: " + "  ".join(parts))
+    return lines
 
 
 def render_table(doc: dict, base_url: str = "") -> str:
@@ -117,6 +166,7 @@ def render_table(doc: dict, base_url: str = "") -> str:
     for v in verdicts:
         lines.append(f"  ! rank {v.get('rank')} {v.get('kind')}: "
                      f"{v.get('detail', '')}")
+    lines.extend(render_serving_pane(doc))
     return "\n".join(lines)
 
 

@@ -14,7 +14,14 @@ generator, and asserts the acceptance contract:
     text next to the step-ledger families the decode loop drives,
   * BENCH_serving.json is emitted with p50/p99 TTFT, tokens/s/user,
     and decode-step MFU keys (DMLC_PEAK_FLOPS pins a CPU peak so MFU
-    is a real number here, not null).
+    is a real number here, not null),
+  * request-scoped observability (PR 12): /requests decomposes TTFT
+    exactly into queue + prefill per request and carries the
+    decode-iteration/KV load signal, client-vs-server latency deltas
+    are positive and bounded, per-status HTTP counters land on
+    /metrics, each request draws its own row on the Chrome /trace,
+    and an injected-delay burst trips EXACTLY one SLO anomaly kind
+    (slo_ttft) through the burn-rate monitor behind /slo.
 
 Runs in ~1 min on 2 CPU cores.  Usage: python scripts/serving_smoke.py
 """
@@ -22,6 +29,7 @@ Runs in ~1 min on 2 CPU cores.  Usage: python scripts/serving_smoke.py
 import json
 import os
 import sys
+import time
 import urllib.request
 
 # MFU needs a peak-FLOPs figure; no table entry exists for CPU, so pin
@@ -29,6 +37,11 @@ import urllib.request
 # win).  A real deployment sets this to the accelerator's datasheet.
 os.environ.setdefault("DMLC_PEAK_FLOPS", "5e10")
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# generous SLOs for the main load phase (nothing should trip); the
+# injected-delay phase below builds its OWN tight monitor
+os.environ.setdefault("DMLC_SLO_TTFT_P99_S", "10.0")
+os.environ.setdefault("DMLC_SLO_TBT_P99_S", "10.0")
+os.environ.setdefault("DMLC_SLO_ERROR_RATE", "0.5")
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -72,6 +85,11 @@ def main():
                          vocab=cfg.vocab, seed=99)
     warm.run()
     assert not warm.failures, f"warmup failed: {warm.failures[:2]}"
+    # the request ledger must cover the SAME population as the client
+    # summary it is joined with in BENCH_serving.json — drop the
+    # warmup/compile requests, or the server-side percentiles would
+    # exceed the client-side ones they decompose
+    engine.requests.reset()
 
     gen = LoadGenerator(server.url, n_streams=N_STREAMS,
                         requests_per_stream=REQS_PER_STREAM,
@@ -92,6 +110,67 @@ def main():
     assert summary["tokens_per_s_per_user"], (
         "per-user decode tokens/s missing or zero")
 
+    # client-vs-server timing corroboration: the client clock wraps
+    # HTTP transport + handler queueing around the server-side request
+    # lifetime, so the delta must be positive (the two paths agree on
+    # what a request is) and bounded (the HTTP edge is not the
+    # bottleneck on localhost)
+    delta50 = summary["client_server_delta_p50_s"]
+    delta99 = summary["client_server_delta_p99_s"]
+    assert delta50 is not None and delta50 > 0, (
+        f"client latency below server latency (delta p50 {delta50}) — "
+        "the timing paths disagree")
+    assert delta99 < 5.0, (
+        f"HTTP+queueing overhead p99 {delta99:.3f}s unbounded")
+
+    # server-side request ledger: TTFT decomposes exactly
+    reqdoc = json.loads(urllib.request.urlopen(
+        server.url + "/requests", timeout=30).read())
+    recent = reqdoc["recent"]
+    assert len(recent) >= want, f"only {len(recent)} ledger records"
+    for rec in recent:
+        if rec["state"] != "done":
+            continue
+        assert abs(rec["ttft_s"] - (rec["queue_s"] + rec["prefill_s"])) \
+            < 1e-6, f"TTFT identity broken: {rec}"
+    rsum = reqdoc["summary"]
+    for key in ("queue_wait_p99_s", "prefill_p99_s", "ttft_p99_s",
+                "tbt_p50_s", "tbt_p99_s"):
+        assert rsum.get(key) is not None, f"/requests summary {key} null"
+    assert rsum["requests_done"] >= want
+    iters = reqdoc["iterations"]
+    assert iters and "kv_occupancy" in iters[-1] \
+        and "waiting" in iters[-1], "decode-iteration ring missing"
+
+    # /slo: objectives configured, evaluated, nothing tripping under
+    # the generous main-phase targets
+    slodoc = json.loads(urllib.request.urlopen(
+        server.url + "/slo", timeout=30).read())
+    assert slodoc["enabled"]
+    assert set(slodoc["objectives"]) == {"ttft_p99", "tbt_p99",
+                                         "error_rate"}
+    assert slodoc["objectives"]["ttft_p99"]["events_slow"] >= want
+    assert slodoc["active"] == [], (
+        f"SLO tripped under generous targets: {slodoc['active']}")
+
+    # request rows on the Chrome /trace: every lifecycle stage present
+    # on a per-request row
+    trace = json.loads(urllib.request.urlopen(
+        server.url + "/trace", timeout=30).read())
+    row_tids = {e["tid"] for e in trace["traceEvents"]
+                if e.get("ph") == "M" and e["name"] == "thread_name"
+                and str(e["args"].get("name", "")).startswith("req ")}
+    assert len(row_tids) >= want, (
+        f"only {len(row_tids)} request rows on /trace")
+    row_spans = {}
+    for e in trace["traceEvents"]:
+        if e.get("ph") == "X" and e["tid"] in row_tids:
+            row_spans.setdefault(e["tid"], set()).add(e["name"])
+    full = [t for t, names in row_spans.items()
+            if {"serving.queue", "serving.prefill",
+                "serving.decode"} <= names]
+    assert full, "no request row carries queue+prefill+decode spans"
+
     # continuous batching actually batched: with 8 streams in flight
     # the decode batch must have exceeded 1 at least once
     text = urllib.request.urlopen(server.url + "/metrics",
@@ -102,7 +181,13 @@ def main():
                 "dmlc_serving_decode_batch", "dmlc_serving_prefill_secs",
                 "dmlc_serving_kv_blocks_in_use",
                 "dmlc_serving_kv_blocks_total", "dmlc_step_count",
-                "dmlc_step_mfu_pct"):
+                "dmlc_step_mfu_pct",
+                # PR 12 families: request ledger + HTTP edge + SLO
+                "dmlc_serving_queue_wait_secs", "dmlc_serving_tbt_secs",
+                "dmlc_serving_http_200", "dmlc_serving_kv_occupancy_pct",
+                "dmlc_serving_kv_waste_tokens", "dmlc_slo_burn_rate",
+                "dmlc_slo_violation_active",
+                "dmlc_slo_objective_threshold"):
         assert fam in text, f"{fam} missing from /metrics"
     def scalar(name):
         for line in text.splitlines():
@@ -124,16 +209,106 @@ def main():
         "n_metric_samples": n_samples,
     })
     for key in ("p50_ttft_s", "p99_ttft_s", "tokens_per_s_per_user",
-                "decode_mfu", "decode_step_p50_s", "decode_step_p99_s"):
+                "decode_mfu", "decode_step_p50_s", "decode_step_p99_s",
+                # PR 12: the server-side ledger join — the before/after
+                # surface serving optimisations are judged on
+                "queue_wait_p99_s", "server_ttft_p99_s", "tbt_p50_s",
+                "tbt_p99_s", "preemption_rate", "kv_occupancy",
+                "kv_waste_tokens", "client_server_delta_p50_s"):
         assert doc.get(key) is not None, f"BENCH key {key} missing/null"
+    # both TTFT p99s now cover the same 24-request population (the
+    # ledger was reset after warmup), measured by two independent
+    # clocks — they must agree
+    assert abs(doc["server_ttft_p99_s"] - doc["p99_ttft_s"]) < 0.1, (
+        f"server ttft p99 {doc['server_ttft_p99_s']:.3f}s disagrees "
+        f"with client {doc['p99_ttft_s']:.3f}s")
     print(f"serving_smoke: BENCH_serving.json written "
           f"(decode_mfu={doc['decode_mfu']:.2e}, "
           f"p99_ttft={doc['p99_ttft_s']:.3f}s, "
+          f"queue_p99={doc['queue_wait_p99_s'] * 1e3:.1f}ms, "
+          f"tbt_p99={doc['tbt_p99_s'] * 1e3:.1f}ms, "
           f"tokens/s/user={doc['tokens_per_s_per_user']:.2f})")
+
+    # dmlc-top's serving pane renders from the same endpoints
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import dmlc_top
+
+    pane = dmlc_top.render_table(dmlc_top.fetch(server.url), server.url)
+    assert "serving " in pane and "slo " in pane, (
+        f"dmlc-top serving pane missing:\n{pane}")
+    print("serving_smoke: dmlc-top pane:\n"
+          + "\n".join(pane.splitlines()[-2:]))
 
     server.close()
     engine.close()
+
+    slo_injected_delay_phase(params, cfg)
     print("serving_smoke: OK")
+
+
+def slo_injected_delay_phase(params, cfg):
+    """Delay injection → exactly one SLO anomaly kind.
+
+    A fresh engine gets a tight 250 ms TTFT objective but is NOT
+    started until a burst of requests has sat queued for ~3x the
+    objective; every one of their TTFTs then blows the target through
+    pure queue wait (prefill is unchanged), the burn-rate monitor
+    trips ``slo_ttft`` — and ONLY ``slo_ttft``: TBT and the error rate
+    stay clean, proving one injected symptom maps to one verdict kind.
+    """
+    from dmlc_tpu import telemetry
+    from dmlc_tpu.serving import InferenceEngine, ServingHTTPServer
+    from dmlc_tpu.telemetry.slo import SLOMonitor
+
+    mon = SLOMonitor(ttft_p99_s=0.25, tbt_p99_s=10.0, error_rate=0.5)
+    engine = InferenceEngine(
+        params, cfg, n_blocks=128, block_size=8, max_active=N_STREAMS,
+        queue_depth=4 * N_STREAMS, admit_timeout_s=5.0, slo_monitor=mon)
+    server = ServingHTTPServer(engine, port=0)
+    reqs = [engine.submit([3, 1, 4, 1, 5], max_new_tokens=4)
+            for _ in range(8)]
+    time.sleep(0.7)      # the injected delay: ~3x the TTFT objective
+    engine.start()       # queue drains; every TTFT carries the delay
+    for r in reqs:
+        assert r.wait(120) and r.error is None, f"request {r.id} failed"
+    mon.evaluate()
+    active = mon.active()
+    assert active == ["slo_ttft"], (
+        f"injected delay must trip exactly slo_ttft, got {active}")
+
+    slodoc = json.loads(urllib.request.urlopen(
+        server.url + "/slo", timeout=30).read())
+    assert slodoc["active"] == ["slo_ttft"]
+    assert slodoc["objectives"]["ttft_p99"]["violating"]
+    assert not slodoc["objectives"]["tbt_p99"]["violating"]
+    assert not slodoc["objectives"]["error_rate"]["violating"]
+
+    # the violation reached the anomaly surfaces: event ring + an
+    # instant marker on the local Chrome /trace
+    anomalies = [e for e in telemetry.events_tail()
+                 if e["kind"] == "anomaly"
+                 and str(e.get("anomaly", "")).startswith("slo_")]
+    assert len(anomalies) == 1 and anomalies[0]["anomaly"] == "slo_ttft", (
+        f"expected exactly one slo anomaly event, got {anomalies}")
+    trace = json.loads(urllib.request.urlopen(
+        server.url + "/trace", timeout=30).read())
+    markers = [e for e in trace["traceEvents"]
+               if e.get("ph") == "i" and e.get("cat") == "slo"]
+    assert markers and markers[-1]["name"] == "slo:slo_ttft", (
+        "SLO violation marker missing from /trace")
+
+    # the metrics surface shows the trip, still strict-Prometheus
+    from dmlc_tpu.telemetry.exporters import validate_exposition_text
+
+    text = urllib.request.urlopen(server.url + "/metrics",
+                                  timeout=30).read().decode()
+    validate_exposition_text(text)
+    assert 'dmlc_slo_violation_active{objective="ttft_p99"} 1' in text
+    print(f"serving_smoke: injected 0.7s queue delay tripped slo_ttft "
+          f"(burn {slodoc['objectives']['ttft_p99']['burn_fast']:.0f}x) "
+          f"and nothing else")
+    server.close()
+    engine.close()
 
 
 if __name__ == "__main__":

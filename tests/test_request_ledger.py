@@ -1,0 +1,331 @@
+"""RequestLedger lifecycle accounting (telemetry.requests).
+
+The unit tests drive explicit clocks through every hook, so the
+accounting identities are checked EXACTLY: TTFT ≡ queue + prefill,
+token counts survive preempt/resume episodes, failures carry their
+reason.  One integration test runs the real engine + HTTP surface and
+re-checks the identity and the new endpoints end to end.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from dmlc_tpu import telemetry
+from dmlc_tpu.telemetry.requests import (FAIL_REASONS,
+                                         REQUEST_ROW_TID_BASE,
+                                         RequestLedger)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    telemetry.reset()
+    telemetry.reset_steps()
+    yield
+    telemetry.reset()
+    telemetry.reset_steps()
+
+
+def _full_lifecycle(led, rid=1, t0=100.0):
+    led.on_submit(rid, n_prompt=5, max_new_tokens=8, t=t0)
+    led.on_prefill_begin(rid, t=t0 + 0.4)
+    led.on_first_token(rid, t=t0 + 0.7)
+    led.on_token(rid, t=t0 + 0.8)
+    led.on_token(rid, t=t0 + 0.95)
+    return led.on_finish(rid, t=t0 + 1.0)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle accounting
+# ---------------------------------------------------------------------------
+
+def test_ttft_decomposes_exactly_into_queue_plus_prefill():
+    led = RequestLedger(capacity=16, trace_rows=False)
+    rec = _full_lifecycle(led)
+    assert rec["queue_s"] == pytest.approx(0.4, abs=1e-12)
+    assert rec["prefill_s"] == pytest.approx(0.3, abs=1e-12)
+    # the identity is by construction, not within a tolerance: all
+    # three derive from the same three stamps
+    assert rec["ttft_s"] == rec["queue_s"] + rec["prefill_s"]
+    assert rec["state"] == "done" and rec["reason"] is None
+    assert rec["n_generated"] == 3
+    assert rec["latency_s"] == pytest.approx(1.0, abs=1e-12)
+
+
+def test_tbt_gaps_recorded_per_token():
+    led = RequestLedger(capacity=16, trace_rows=False)
+    rec = _full_lifecycle(led)
+    # gaps: 0.1 (first->second), 0.15 (second->third)
+    assert rec["tbt_max_s"] == pytest.approx(0.15, abs=1e-9)
+    assert rec["tbt_mean_s"] == pytest.approx(0.125, abs=1e-9)
+    summ = led.summary()
+    assert summ["tbt_p99_s"] == pytest.approx(0.15, abs=1e-9)
+    # the registry histogram rode along
+    snap = telemetry.snapshot()
+    assert snap["histograms"]["serving"]["tbt_secs"]["count"] == 2
+
+
+def test_preempt_resume_keeps_token_counts_exact():
+    led = RequestLedger(capacity=16, trace_rows=False)
+    led.on_submit(1, n_prompt=4, t=10.0)
+    led.on_prefill_begin(1, t=10.2)
+    led.on_first_token(1, t=10.5)
+    led.on_token(1, t=10.6)
+    led.on_token(1, t=10.7)          # 3 tokens so far
+    led.on_preempt(1, t=10.75)
+    # resume: re-prefill recomputes context, NO new first token
+    led.on_prefill_begin(1, t=11.0, resume=True)
+    led.on_prefill_end(1, t=11.2)
+    led.on_token(1, t=11.3)          # 4th token
+    led.on_token(1, t=11.4)          # 5th
+    rec = led.on_finish(1, t=11.45)
+    assert rec["n_generated"] == 5
+    assert rec["preemptions"] == 1
+    assert rec["resumes"] == 1
+    # ttft is from the FIRST episode only (resume must not reset it)
+    assert rec["ttft_s"] == pytest.approx(0.5, abs=1e-12)
+    assert rec["ttft_s"] == rec["queue_s"] + rec["prefill_s"]
+    # the cross-preemption gap (10.7 -> 11.3) IS a TBT observation:
+    # that stall is what a streaming user experiences
+    assert rec["tbt_max_s"] == pytest.approx(0.6, abs=1e-9)
+    snap = telemetry.snapshot()
+    assert snap["counters"]["serving"]["resumes"] == 1
+
+
+def test_failed_request_records_reason_and_counter():
+    led = RequestLedger(capacity=16, trace_rows=False)
+    led.on_submit(1, n_prompt=4, t=0.0)
+    led.on_prefill_begin(1, t=0.1)
+    rec = led.on_finish(1, error="prefill failed: boom",
+                        reason="prefill", t=0.2)
+    assert rec["state"] == "failed"
+    assert rec["reason"] == "prefill"
+    assert rec["error"] == "prefill failed: boom"
+    assert rec["ttft_s"] is None  # never produced a token
+    snap = telemetry.snapshot()
+    assert snap["counters"]["serving"]["failed_prefill"] == 1
+    assert led.summary()["fail_reasons"] == {"prefill": 1}
+
+
+def test_draining_shutdown_reason_and_unknown_reason_folds_to_other():
+    led = RequestLedger(capacity=16, trace_rows=False)
+    led.on_submit(1, n_prompt=2, t=0.0)
+    rec = led.on_finish(1, error="engine shut down",
+                        reason="shutdown", t=0.5)
+    assert rec["reason"] == "shutdown" and "shutdown" in FAIL_REASONS
+    led.on_submit(2, n_prompt=2, t=1.0)
+    rec2 = led.on_finish(2, error="weird", reason="not-a-slug", t=1.5)
+    assert rec2["reason"] == "other"
+    assert led.summary()["fail_reasons"] == {"shutdown": 1, "other": 1}
+
+
+def test_unknown_and_double_finish_are_noops():
+    led = RequestLedger(capacity=16, trace_rows=False)
+    assert led.on_finish(99) is None
+    led.on_prefill_begin(98)      # never submitted: ignored
+    led.on_token(97)
+    led.on_preempt(96)
+    rec = _full_lifecycle(led, rid=1)
+    assert rec is not None
+    assert led.on_finish(1) is None  # already moved to the ring
+    assert led.summary()["requests_done"] == 1
+
+
+def test_ring_bounded_and_records_since_contract():
+    led = RequestLedger(capacity=4, trace_rows=False)
+    for i in range(1, 8):
+        _full_lifecycle(led, rid=i, t0=float(i) * 10)
+    assert len(led.records()) == 4  # ring evicted the oldest
+    recs, last = led.records_since(0)
+    assert [r["seq"] for r in recs] == [4, 5, 6, 7]
+    assert last == 7  # high-water mark includes evicted records
+    # truncation: last returned seq so the remainder ships next beat
+    recs, last = led.records_since(4, limit=2)
+    assert [r["seq"] for r in recs] == [5, 6] and last == 6
+    recs, last = led.records_since(7)
+    assert recs == [] and last == 7
+
+
+def test_live_view_tracks_states():
+    led = RequestLedger(capacity=16, trace_rows=False)
+    led.on_submit(1, n_prompt=3, t=0.0)
+    assert led.live()[0]["state"] == "queued"
+    led.on_prefill_begin(1, t=0.1)
+    led.on_first_token(1, t=0.2)
+    view = led.live()[0]
+    assert view["state"] == "active" and view["n_generated"] == 1
+    assert led.summary()["live_requests"] == 1
+    led.on_finish(1, t=0.3)
+    assert led.live() == []
+
+
+def test_iteration_ring_carries_kv_pressure():
+    led = RequestLedger(capacity=16, trace_rows=False)
+    for i in range(5):
+        led.on_iteration(active=3, waiting=i, preempted=i % 2, tokens=3,
+                         kv_stats={"blocks_in_use": 10, "n_blocks": 32,
+                                   "occupancy": 10 / 32,
+                                   "waste_tokens": 7,
+                                   "cached_tokens": 153})
+    its = led.iterations()
+    assert len(its) == 5
+    assert its[-1]["kv_occupancy"] == pytest.approx(10 / 32)
+    assert its[-1]["kv_waste_tokens"] == 7
+    assert its[-1]["waiting"] == 4
+    summ = led.summary()
+    assert summ["decode_queue_depth"] == 4
+    assert summ["kv_occupancy"] == pytest.approx(10 / 32)
+
+
+def test_trace_rows_land_in_span_ring_with_request_tids():
+    led = RequestLedger(capacity=16, trace_rows=True)
+    _full_lifecycle(led, rid=7)
+    spans = [s for s in telemetry.spans()
+             if s["tid"] == REQUEST_ROW_TID_BASE + 7]
+    names = [s["name"] for s in spans]
+    assert names == ["serving.queue", "serving.prefill", "serving.decode"]
+    assert all(s["thread"] == "req 7" for s in spans)
+    assert all(s["args"]["req"] == 7 for s in spans)
+    # queue span covers submit -> prefill begin (0.4s), prefill span
+    # prefill begin -> first token (0.3s)
+    assert spans[0]["dur"] == pytest.approx(0.4e6, rel=1e-9)
+    assert spans[1]["dur"] == pytest.approx(0.3e6, rel=1e-9)
+
+
+def test_queue_wait_histogram_published():
+    led = RequestLedger(capacity=16, trace_rows=False)
+    _full_lifecycle(led)
+    snap = telemetry.snapshot()
+    h = snap["histograms"]["serving"]["queue_wait_secs"]
+    assert h["count"] == 1
+    assert h["max"] == pytest.approx(0.4, abs=1e-9)
+
+
+def test_summary_percentiles_over_many_requests():
+    led = RequestLedger(capacity=64, trace_rows=False)
+    for i in range(1, 11):
+        t0 = i * 100.0
+        led.on_submit(i, n_prompt=4, t=t0)
+        led.on_prefill_begin(i, t=t0 + 0.01 * i)   # queue 0.01*i
+        led.on_first_token(i, t=t0 + 0.01 * i + 0.2)
+        led.on_finish(i, t=t0 + 1.0)
+    summ = led.summary()
+    assert summ["requests_done"] == 10
+    # nearest-rank percentiles (the StepLedger/loadgen convention):
+    # p50 of 10 ordered values is the 6th (index int(5.0))
+    assert summ["queue_wait_p50_s"] == pytest.approx(0.06, abs=1e-6)
+    assert summ["queue_wait_p99_s"] == pytest.approx(0.10, abs=1e-6)
+    assert summ["prefill_p99_s"] == pytest.approx(0.2, abs=1e-6)
+    assert summ["preemption_rate"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# engine + HTTP integration
+# ---------------------------------------------------------------------------
+
+def _tiny_model():
+    import jax
+
+    from dmlc_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=2, head_dim=8,
+                                d_ff=64, n_layers=2, n_experts=1,
+                                microbatches=1)
+    return tfm.init_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+def test_engine_request_ledger_end_to_end():
+    from dmlc_tpu.serving import InferenceEngine, ServingHTTPServer
+    from dmlc_tpu.telemetry.slo import SLOMonitor
+
+    params, cfg = _tiny_model()
+    mon = SLOMonitor(ttft_p99_s=60.0, error_rate=0.5)
+    eng = InferenceEngine(params, cfg, n_blocks=32, block_size=4,
+                          max_active=3, queue_depth=8,
+                          admit_timeout_s=2.0, slo_monitor=mon)
+    eng.start()
+    srv = ServingHTTPServer(eng, port=0)
+    try:
+        body = json.dumps({"prompt": [1, 2, 3], "max_tokens": 5}).encode()
+        req = urllib.request.Request(
+            srv.url + "/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req, timeout=120).read())
+        assert out["state"] == "done" and out["n_generated"] == 5
+
+        doc = json.loads(urllib.request.urlopen(
+            srv.url + "/requests", timeout=30).read())
+        rec = doc["recent"][-1]
+        assert rec["state"] == "done" and rec["n_generated"] == 5
+        # the headline identity, measured on the real engine
+        assert rec["ttft_s"] == pytest.approx(
+            rec["queue_s"] + rec["prefill_s"], abs=1e-9)
+        assert doc["summary"]["requests_done"] == 1
+        assert doc["iterations"], "decode iterations not recorded"
+        assert "kv_occupancy" in doc["iterations"][-1]
+
+        slo_doc = json.loads(urllib.request.urlopen(
+            srv.url + "/slo", timeout=30).read())
+        assert slo_doc["enabled"]
+        assert slo_doc["objectives"]["ttft_p99"]["events_slow"] >= 1
+        assert slo_doc["active"] == []
+
+        # per-status counter: exactly one 200 answered
+        snap = telemetry.snapshot()
+        assert snap["counters"]["serving"]["http_200"] == 1
+
+        # the request drew its own /trace row
+        tr = json.loads(urllib.request.urlopen(
+            srv.url + "/trace", timeout=30).read())
+        rows = [e for e in tr["traceEvents"]
+                if e.get("ph") == "M" and e["name"] == "thread_name"
+                and str(e["args"].get("name", "")).startswith("req ")]
+        assert rows, "no per-request trace rows on /trace"
+    finally:
+        srv.close()
+        eng.close()
+
+
+def test_engine_http_400_and_413_counted():
+    from dmlc_tpu.serving import InferenceEngine, ServingHTTPServer
+    from dmlc_tpu.telemetry.slo import SLOMonitor
+
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=8, block_size=4,
+                          max_active=2, queue_depth=4,
+                          slo_monitor=SLOMonitor())
+    eng.start()
+    srv = ServingHTTPServer(eng, port=0)
+    try:
+        def post(doc):
+            body = json.dumps(doc).encode()
+            req = urllib.request.Request(
+                srv.url + "/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                return 200
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        import urllib.error
+
+        assert post({"prompt": "nope"}) == 400
+        assert post({"prompt": [1] * 1000, "max_tokens": 4}) == 413
+        # a POST to an unknown path is a misrouted client → counted;
+        # a GET probe (monitoring tools poll optional endpoints) is not
+        for method, data in (("POST", b"{}"), ("GET", None)):
+            try:
+                urllib.request.urlopen(urllib.request.Request(
+                    srv.url + "/nope", data=data, method=method),
+                    timeout=10)
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        snap = telemetry.snapshot()
+        assert snap["counters"]["serving"]["http_400"] == 1
+        assert snap["counters"]["serving"]["http_413"] == 1
+        assert snap["counters"]["serving"]["http_404"] == 1
+    finally:
+        srv.close()
+        eng.close()

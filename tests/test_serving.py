@@ -145,6 +145,11 @@ def test_kv_fragmentation_bounded_under_mixed_length_churn():
         assert s["blocks_in_use"] + s["blocks_free"] == 16
         assert s["blocks_in_use"] == sum(
             cache.blocks_for(n) for n, _ in live.values())
+        # the O(1) running token counter matches the ground truth sum,
+        # and waste = allocated slots minus cached tokens
+        assert s["cached_tokens"] == sum(n for n, _ in live.values())
+        assert s["waste_tokens"] == (s["blocks_in_use"] * 4
+                                     - s["cached_tokens"])
     # every surviving sequence still reads back its own data
     for seq, (n, k) in live.items():
         gk, _, lens = cache.gather([seq])
@@ -353,8 +358,9 @@ def test_decode_capacity_eviction_of_already_checked_survivor():
     eng.cache.write(y.id, *_seq_kv_model(cfg, 4))
     eng.scheduler.activate(x)
     eng.scheduler.activate(y)
-    alive = eng._ensure_decode_capacity([x, y])
+    alive, n_preempted = eng._ensure_decode_capacity([x, y])
     assert alive == [y], "evicted survivor leaked into the decode batch"
+    assert n_preempted == 1
     assert x.state == WAITING and x.preemptions == 1
     assert x.id not in eng.cache.live_sequences()
     eng.close()
