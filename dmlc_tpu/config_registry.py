@@ -395,6 +395,45 @@ KNOBS: Tuple[Knob, ...] = (
     _k("DMLC_SERVE_TRACE_REQUESTS", bool, True,
        "draw per-request lifecycle rows on the Chrome /trace",
        group="serving"),
+    _k("DMLC_SERVE_DEDUPE_MAX", int, 512,
+       "finished request_ids retained in the idempotency dedupe ring",
+       group="serving"),
+    _k("DMLC_SERVE_CRASH_REQUEUE_MAX", int, 2,
+       "engine-iteration crashes a request may survive by requeue "
+       "(recompute-resume) before failing with reason crash",
+       group="serving"),
+
+    # ---- fleet router (serving/router.py) -----------------------------
+    _k("DMLC_ROUTER_HOST", str, "127.0.0.1",
+       "router endpoint bind host (bin/dmlc-router)", group="router"),
+    _k("DMLC_ROUTER_PORT", int, 8900,
+       "router endpoint bind port", group="router"),
+    _k("DMLC_ROUTER_REPLICAS", str, None,
+       "comma-separated replica base URLs (bin/dmlc-router default)",
+       group="router"),
+    _k("DMLC_ROUTER_HEALTH_INTERVAL_S", float, 1.0,
+       "seconds between health/load sweeps over the replica fleet",
+       group="router"),
+    _k("DMLC_ROUTER_PROBE_TIMEOUT_S", float, 2.0,
+       "per-replica /healthz probe timeout", group="router"),
+    _k("DMLC_ROUTER_PROBE_BASE_S", float, 0.5,
+       "circuit-breaker re-probe backoff base after a replica is "
+       "marked down (doubles per consecutive failure)", group="router"),
+    _k("DMLC_ROUTER_PROBE_MAX_S", float, 15.0,
+       "circuit-breaker re-probe backoff ceiling", group="router"),
+    _k("DMLC_ROUTER_RETRIES", int, 3,
+       "max re-dispatches per client request (each to a replica not "
+       "yet tried for it)", group="router"),
+    _k("DMLC_ROUTER_DISPATCH_TIMEOUT_S", float, 120.0,
+       "one dispatch's HTTP timeout (must exceed the longest "
+       "generation)", group="router"),
+    _k("DMLC_ROUTER_REQUEST_TIMEOUT_S", float, 300.0,
+       "total per-client-request deadline across retries and hedges",
+       group="router"),
+    _k("DMLC_ROUTER_HEDGE_AFTER_P99_MULT", float, 0.0,
+       "hedge a dispatch outliving this multiple of the router's "
+       "observed p99 latency on a second replica (0 = hedging off)",
+       group="router"),
 
     # ---- serving SLOs (telemetry.slo) ---------------------------------
     _k("DMLC_SLO_TTFT_P99_S", float, None,
@@ -443,6 +482,7 @@ _GROUP_TITLES = (
     ("lockcheck", "Lock-order watchdog"),
     ("kernel", "Kernels"),
     ("serving", "Serving"),
+    ("router", "Fleet router"),
     ("slo", "Serving SLOs"),
     ("misc", "Misc"),
 )

@@ -17,6 +17,10 @@ the repo already trusts —
                    429 on a full queue, per-status-code counters)
   * ``loadgen``    N-stream closed-loop load + BENCH_serving.json
                    (joined with the server-side request ledger)
+  * ``router``     fleet front door: health-checked least-loaded
+                   routing over N replicas with idempotent retry,
+                   tail-latency hedging, and zero-downtime failover
+                   (``bin/dmlc-router``; CI: scripts/fleet_smoke.py)
 
 Request-scoped observability rides telemetry.requests (per-request
 lifecycle ledger: TTFT ≡ queue + prefill, TBT, preempt/resume
@@ -29,11 +33,13 @@ Launch with ``bin/dmlc-serve``; knobs are the ``DMLC_SERVE_*`` family
 
 from .engine import (  # noqa: F401
     AdmissionFull,
+    EngineDraining,
     InferenceEngine,
     RequestTooLarge,
 )
 from .kv_cache import BlockAllocator, PagedKVCache  # noqa: F401
 from .loadgen import LoadGenerator  # noqa: F401
+from .router import Router, RouterHTTPServer  # noqa: F401
 from .scheduler import ContinuousBatchScheduler, Request  # noqa: F401
 from .server import ServingHTTPServer  # noqa: F401
 
@@ -41,10 +47,13 @@ __all__ = [
     "AdmissionFull",
     "BlockAllocator",
     "ContinuousBatchScheduler",
+    "EngineDraining",
     "InferenceEngine",
     "LoadGenerator",
     "PagedKVCache",
     "Request",
     "RequestTooLarge",
+    "Router",
+    "RouterHTTPServer",
     "ServingHTTPServer",
 ]

@@ -38,13 +38,14 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from typing import List, Optional
 
 import numpy as np
 
 from .. import telemetry
 from ..base import DMLCError, get_env
-from ..concurrency import BufferPool
+from ..concurrency import BufferPool, make_lock
 from ..models import transformer as tfm
 from .kv_cache import PagedKVCache
 from .scheduler import (ACTIVE, AlreadyFinished,
@@ -69,6 +70,59 @@ class EngineDraining(DMLCError):
 
 
 _JIT_CACHE: dict = {}
+
+
+class _DedupeTable:
+    """Idempotency-key table: client ``request_id`` → :class:`Request`.
+
+    The primitive router retry/hedging stands on: a duplicate
+    submission while the original is live returns the SAME request (the
+    second waiter parks on it), and a duplicate after a successful
+    finish returns the finished request from a bounded ring
+    (``DMLC_SERVE_DEDUPE_MAX``) instead of generating again.  FAILED
+    requests are deliberately dropped from the table — a retry of a
+    failed id is a fresh attempt, which is exactly what a router
+    failover wants.
+    """
+
+    def __init__(self, capacity: int):
+        self.capacity = max(1, int(capacity))
+        self._lock = make_lock("InferenceEngine._dedupe_lock")
+        self._live: dict = {}
+        self._done: dict = {}
+        self._order: "deque" = deque()
+
+    def get(self, key: str) -> Optional[Request]:
+        with self._lock:
+            return self._live.get(key) or self._done.get(key)
+
+    def claim(self, key: str, req: Request) -> Request:
+        """Publish ``req`` under ``key`` unless a concurrent submit got
+        there first; returns whichever request owns the key."""
+        with self._lock:
+            prior = self._live.get(key) or self._done.get(key)
+            if prior is not None:
+                return prior
+            self._live[key] = req
+            return req
+
+    def drop(self, key: str, req: Request) -> None:
+        """Un-publish after a failed admission/finish — only if the
+        mapping is still ours (a fresh retry may have re-claimed)."""
+        with self._lock:
+            if self._live.get(key) is req:
+                del self._live[key]
+
+    def finish(self, key: str, req: Request) -> None:
+        """Move a successfully finished request into the bounded ring."""
+        with self._lock:
+            if self._live.get(key) is not req:
+                return
+            del self._live[key]
+            self._done[key] = req
+            self._order.append(key)
+            while len(self._order) > self.capacity:
+                self._done.pop(self._order.popleft(), None)
 
 
 def _jitted_programs():
@@ -134,6 +188,13 @@ class InferenceEngine:
         self.slo = (slo_monitor if slo_monitor is not None
                     else telemetry.slo.monitor())
         self.requests = telemetry.RequestLedger(slo=self.slo)
+        # idempotency-key dedupe (router retry/hedge primitive) + the
+        # per-request crash-requeue budget (requeue-on-crash keeps an
+        # engine-iteration crash output-invisible, bounded so a
+        # deterministically poisonous request still fails)
+        self._dedupe = _DedupeTable(get_env("DMLC_SERVE_DEDUPE_MAX", 512))
+        self._crash_requeue_max = get_env(
+            "DMLC_SERVE_CRASH_REQUEUE_MAX", 2)
         self._prefill, self._decode = _jitted_programs()
         self._stop = threading.Event()
         self._draining = threading.Event()
@@ -144,12 +205,30 @@ class InferenceEngine:
     # ---- client surface -------------------------------------------------
     def submit(self, prompt_ids: List[int],
                max_new_tokens: Optional[int] = None,
-               timeout: Optional[float] = None) -> Request:
+               timeout: Optional[float] = None,
+               request_id: Optional[str] = None) -> Request:
         """Admit a request or raise: :class:`AdmissionFull` when no
         queue slot frees up within ``timeout`` (default
         ``admit_timeout_s``), ``ValueError`` when the request could
-        never be served (bad ids, context beyond total cache)."""
+        never be served (bad ids, context beyond total cache).
+
+        ``request_id`` is the client's idempotency key: a duplicate
+        submission while the original is live (or successfully finished
+        and still in the bounded dedupe ring) returns the ORIGINAL
+        request instead of starting a second generation — the
+        primitive the fleet router's retry and hedging rely on.  The
+        dedupe lookup runs before the drain gate, so a retry of
+        already-admitted work resolves even on a draining replica."""
         t_submit = time.perf_counter()
+        if request_id is not None:
+            if (not isinstance(request_id, str) or not request_id
+                    or len(request_id) > 128):
+                raise ValueError("request_id must be a non-empty string "
+                                 "of at most 128 chars")
+            prior = self._dedupe.get(request_id)
+            if prior is not None:
+                telemetry.inc("serving", "dedupe_hits")
+                return prior
         if self._draining.is_set():
             raise EngineDraining(
                 "engine is draining (shutdown notice); retry against "
@@ -157,6 +236,7 @@ class InferenceEngine:
         mnt = (max_new_tokens if max_new_tokens is not None
                else self.default_max_new_tokens)
         req = Request(prompt_ids, mnt, eos_id=self.eos_id)
+        req.client_id = request_id
         if any(t < 0 or t >= self.cfg.vocab for t in req.prompt_ids):
             raise ValueError(
                 f"prompt ids out of range for vocab {self.cfg.vocab}")
@@ -164,10 +244,24 @@ class InferenceEngine:
             raise RequestTooLarge(
                 f"request needs up to {req.n_prompt + mnt} cached tokens; "
                 f"cache holds {self.cache.n_blocks * self.cache.block_size}")
+        if request_id is not None:
+            # publish BEFORE the (possibly seconds-long) slot wait so a
+            # concurrent duplicate parks on this request instead of
+            # racing it into a second generation
+            claimed = self._dedupe.claim(request_id, req)
+            if claimed is not req:
+                telemetry.inc("serving", "dedupe_hits")
+                return claimed
         slot = self._slots.acquire(
             timeout=self.admit_timeout_s if timeout is None else timeout)
         if slot is None:
             telemetry.inc("serving", "rejected")
+            if request_id is not None:
+                # un-publish so a later retry is a fresh attempt, and
+                # wake any duplicate that parked during the slot wait
+                self._dedupe.drop(request_id, req)
+                req.rejected_busy = True
+                req.reject("admission queue full; retry later")
             raise AdmissionFull(
                 f"admission queue full (depth includes {self.max_active} "
                 f"active); retry later")
@@ -238,16 +332,22 @@ class InferenceEngine:
              else get_env("DMLC_SERVE_DRAIN_S", 30.0))
         self.begin_drain()
         deadline = time.monotonic() + t
-        # a request transits waiting -> stepping (popped, mid-prefill)
-        # -> active, only ever forward, and submits are already
-        # refused.  Reading the stages in FLOW ORDER (waiting first,
-        # active last) guarantees at least one read sees any in-flight
-        # request: whatever stage it occupied at the first read, by the
-        # time later reads happen it can only be in a stage not yet
-        # read — so "all three false" truly means drained, and close()
-        # can never sweep a live generation.
+        # a request usually transits waiting -> stepping (popped,
+        # mid-prefill) -> active, and submits are already refused.
+        # Reading the stages in FLOW ORDER (waiting first, active
+        # last) guarantees at least one read sees any forward-moving
+        # request: whatever stage it occupied at the first read, by
+        # the time later reads happen it can only be in a stage not
+        # yet read.  But two paths move BACKWARD (active -> waiting):
+        # self-preemption and crash requeue — a request that made that
+        # move entirely between the waiting read and the active read
+        # would be invisible to all three.  Both backward moves land
+        # the request in the wait queue atomically, so re-reading
+        # n_waiting LAST closes the gap: "all four false" truly means
+        # drained, and close() can never sweep a recoverable
+        # generation.
         while (self.scheduler.n_waiting or self._stepping
-               or self.scheduler.n_active):
+               or self.scheduler.n_active or self.scheduler.n_waiting):
             if time.monotonic() > deadline:
                 logger.warning(
                     "drain deadline (%.1fs) hit with %d active / %d "
@@ -291,10 +391,21 @@ class InferenceEngine:
                 did = self.step()
             except Exception as e:  # noqa: BLE001 - engine must not die
                 # a crashed decode leaves the ACTIVE set's cache state
-                # unknown, so those requests fail (waiters wake with
-                # the error) — but WAITING requests were never touched
-                # and the engine keeps serving them
+                # unknown — but the OUTPUT state is perfectly known
+                # (req.generated), and recompute-resume is free: each
+                # active request is requeued with its blocks freed so
+                # the re-prefill rebuilds its context, exactly like a
+                # preemption.  The per-request crash budget
+                # (DMLC_SERVE_CRASH_REQUEUE_MAX) bounds a
+                # deterministically poisonous request: past it, the
+                # request fails with reason "crash".  WAITING requests
+                # were never touched and keep serving either way.
                 for req in self.scheduler.active_requests():
+                    if (req.crash_requeues < self._crash_requeue_max
+                            and self.scheduler.requeue_active(req)):
+                        telemetry.inc("serving", "crash_requeues")
+                        self.requests.on_preempt(req.id)
+                        continue
                     try:
                         self._finish(
                             req, error=f"engine iteration failed: {e!r}",
@@ -339,6 +450,13 @@ class InferenceEngine:
         # exactly-once guard for the ledger too: a swept request can
         # never be recorded twice
         self.requests.on_finish(req.id, error=error, reason=reason)
+        if req.client_id is not None:
+            if error:
+                # failed ids leave the table: a retry of a FAILED
+                # request is a fresh attempt (router failover semantics)
+                self._dedupe.drop(req.client_id, req)
+            else:
+                self._dedupe.finish(req.client_id, req)
         if req.latency_s is not None:
             telemetry.observe_duration("serving", "latency", req.latency_s)
         tps = req.decode_tokens_per_s
@@ -522,6 +640,7 @@ class InferenceEngine:
             "active": self.scheduler.n_active,
             "waiting": self.scheduler.n_waiting,
             "max_active": self.max_active,
+            "draining": self.draining,
             "kv": self.cache.stats(),
             "ledger": telemetry.ledger().summary(),
             "requests": self.requests.summary(),

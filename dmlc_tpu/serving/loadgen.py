@@ -2,8 +2,18 @@
 
 Each stream is a closed-loop synthetic user: it POSTs a random-length
 prompt to ``/generate``, waits for the completion document, and
-immediately issues the next request.  429s back off and retry (they
-are the admission queue working as designed, counted but not failed).
+immediately issues the next request.  429s — and 503s that carry a
+``Retry-After`` header (drain / briefly headless endpoints; terminal
+per-request 503s carry none and fail with their error body) — back
+off for the server's ``Retry-After`` value and retry (the admission
+queue and the drain path working as designed, counted but not
+failed); requests that needed at least one retry before succeeding
+are reported separately (``n_requests_retried_ok``) so a run that
+survived on retries is tellable from one that never backpressured.
+Every logical request carries a fresh ``request_id`` idempotency key,
+so a retry against the same replica (or through the fleet router) can
+never double-generate.
+
 The summary aggregates the *server-reported* per-request timings —
 TTFT is measured where it is defined (submit → first token inside the
 engine), not smeared by client-side HTTP overhead — and joins them
@@ -20,6 +30,7 @@ import threading
 import time
 import urllib.error
 import urllib.request
+import uuid
 from typing import Dict, List, Optional
 from ..concurrency import make_lock
 # one shared nearest-rank percentile for client AND server summaries:
@@ -50,6 +61,8 @@ class LoadGenerator:
         self.results: List[Dict] = []
         self.failures: List[Dict] = []
         self.rejections = 0
+        self.backoffs_503 = 0
+        self.retried_ok = 0
         self._lock = make_lock("LoadGenerator._lock")
 
     # ---- one synthetic user --------------------------------------------
@@ -61,23 +74,57 @@ class LoadGenerator:
         with urllib.request.urlopen(req, timeout=600) as resp:
             return json.loads(resp.read())
 
+    def _backoff_s(self, e: "urllib.error.HTTPError") -> float:
+        """Backoff before retrying a 429/503: the server's Retry-After
+        header when it sent one (it computed that number from its own
+        queue depth — it KNOWS), the fixed fallback otherwise, clamped
+        so a confused server cannot park a stream for minutes."""
+        ra = e.headers.get("Retry-After")
+        if ra is not None:
+            try:
+                return min(max(float(ra), 0.0), 30.0)
+            except ValueError:
+                pass  # non-numeric Retry-After: fall back
+        return self.retry_429_s
+
     def _stream(self, sid: int) -> None:
         rng = random.Random(self.seed * 1000 + sid)
         for _ in range(self.requests_per_stream):
             n = rng.randint(*self.prompt_len)
             doc = {"prompt": [rng.randrange(self.vocab) for _ in range(n)],
-                   "max_tokens": self.max_tokens}
+                   "max_tokens": self.max_tokens,
+                   # one idempotency key per LOGICAL request: retries
+                   # reuse it, so a replica (or the router) that already
+                   # accepted the work returns it instead of repeating it
+                   "request_id": uuid.uuid4().hex}
             t0 = time.monotonic()
             out = None
+            retried = False
             for _attempt in range(self.max_retries):
                 try:
                     out = self._post(doc)
                     break
                 except urllib.error.HTTPError as e:
-                    if e.code == 429:
+                    retryable_503 = (
+                        e.code == 503
+                        and e.headers.get("Retry-After") is not None)
+                    if e.code == 429 or retryable_503:
+                        # backpressure (admission full) or a draining /
+                        # briefly headless endpoint: honor Retry-After
+                        # and try again — this is the server steering
+                        # load, not a failure.  A 503 WITHOUT
+                        # Retry-After is a terminal per-request verdict
+                        # (engine failure, generation timeout): record
+                        # its error body, do not amplify it with fresh
+                        # generation attempts
+                        retried = True
+                        delay = self._backoff_s(e)
                         with self._lock:
-                            self.rejections += 1
-                        time.sleep(self.retry_429_s)
+                            if e.code == 429:
+                                self.rejections += 1
+                            else:
+                                self.backoffs_503 += 1
+                        time.sleep(delay)
                         continue
                     out = {"error": f"HTTP {e.code}: "
                            f"{e.read()[:200].decode(errors='replace')}"}
@@ -88,7 +135,7 @@ class LoadGenerator:
                     out = {"error": f"connection failed: {e!r}"}
                     break
             if out is None:
-                out = {"error": "429 retry budget exhausted"}
+                out = {"error": "retry budget exhausted (429/503)"}
             out["stream"] = sid
             out["client_latency_s"] = time.monotonic() - t0
             with self._lock:
@@ -96,6 +143,8 @@ class LoadGenerator:
                     self.failures.append(out)
                 else:
                     self.results.append(out)
+                    if retried:
+                        self.retried_ok += 1
 
     # ---- the run --------------------------------------------------------
     def run(self) -> Dict:
@@ -130,7 +179,11 @@ class LoadGenerator:
             "n_streams": self.n_streams,
             "n_requests_ok": len(self.results),
             "n_requests_failed": len(self.failures),
+            # retried-then-succeeded ≠ failed: a request that rode out
+            # backpressure/drain on retries still completed
+            "n_requests_retried_ok": self.retried_ok,
             "n_rejections_429": self.rejections,
+            "n_backoffs_503": self.backoffs_503,
             "wall_s": wall_s,
             "total_generated_tokens": gen,
             "aggregate_tokens_per_s": gen / max(wall_s, 1e-9),

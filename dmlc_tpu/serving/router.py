@@ -1,0 +1,795 @@
+"""Fleet router: health-checked front door over N engine replicas.
+
+One ``InferenceEngine`` process is a single point of failure: the
+process dies and every in-flight and queued generation dies with it.
+The :class:`Router` makes a replica crash under live traffic
+**invisible to clients** — the same contract the storage layer gives
+for corruption (PR 8) and the training world for shrink (PR 7):
+
+  * **registry + health**: replicas come from a static URL list or the
+    tracker's job map (:func:`discover_replicas`).  A background
+    thread polls each replica's ``/healthz`` (liveness + drain state +
+    the request-ledger load summary); a failed poll or a failed
+    dispatch marks the replica DOWN and opens its circuit — re-probes
+    back off exponentially (``DMLC_ROUTER_PROBE_BASE_S`` →
+    ``DMLC_ROUTER_PROBE_MAX_S``) so a dead host is not hammered, and
+    one successful probe closes the circuit again.
+  * **least-loaded routing**: dispatch picks the healthy replica with
+    the smallest ``router-inflight + decode-queue-depth`` — the
+    PR 12 RequestLedger load signal (``live_waiting`` /
+    ``decode_queue_depth`` in the ``/requests`` summary, embedded in
+    ``/healthz``).
+  * **idempotent retry**: every routed request carries a
+    ``request_id`` (client-supplied or minted here).  A dispatch that
+    dies on the wire (connection reset, timeout, replica SIGKILL
+    mid-decode) is re-dispatched to another healthy replica with the
+    SAME id — the engine-side dedupe ring guarantees a retry can
+    never double-generate on a replica that already saw the id, and
+    recompute-resume makes the re-generation output-invisible.
+    Connection-shaped failures mark the replica down and count
+    ``dmlc_router_failovers_total``; a dispatch *timeout* retries
+    WITHOUT opening the circuit (slow is not dead — liveness is the
+    prober's verdict, under its own bounded timeout).
+  * **hedging**: when a dispatch outlives
+    ``DMLC_ROUTER_HEDGE_AFTER_P99_MULT`` × the router's observed p99
+    latency (0 disables), a duplicate dispatch is launched on a
+    different replica; the first completion wins and the loser is
+    abandoned (its replica-side work is bounded and its result is
+    discarded — the client sees exactly one response).
+  * **drain awareness**: a replica whose ``/healthz`` shows
+    ``draining`` (or that answers 503 "draining") stops receiving new
+    work while it finishes its backlog — a SIGTERM'd replica sheds
+    traffic onto the fleet with zero client-facing 503s.
+  * **honest backpressure**: when every healthy replica answers 429,
+    the router answers 429 with a Retry-After computed from the
+    aggregate queue depth and the observed per-request service time,
+    not a made-up constant.
+
+Fault-injection sites: ``router.dispatch`` (armed error = a torn
+dispatch, exercising the retry path deterministically) and
+``router.replica_down`` (fires at the moment a replica is marked
+down).  The HTTP surface is :class:`RouterHTTPServer`
+(``bin/dmlc-router``); the chaos-style CI stage is
+``scripts/fleet_smoke.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+import time
+import urllib.error
+import urllib.request
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import telemetry
+from ..base import get_env
+from ..concurrency import make_lock
+from ..resilience.fault import fault_point
+from ..telemetry.requests import percentile
+
+__all__ = ["Replica", "Router", "RouterHTTPServer", "discover_replicas",
+           "HEALTHY", "DOWN", "DRAINING"]
+
+logger = logging.getLogger("dmlc_tpu.serving")
+
+HEALTHY = "healthy"
+DOWN = "down"
+DRAINING = "draining"
+
+#: Prometheus value encoding of the per-replica health gauge
+_HEALTH_VALUE = {HEALTHY: 1, DOWN: 0, DRAINING: 2}
+
+_LATENCY_RING = 512      # completed-request latency samples kept
+_HEDGE_MIN_SAMPLES = 8   # latency evidence required before hedging
+_MIN_LAUNCH_WINDOW_S = 1.0  # no new dispatch into less deadline than this
+
+MAX_BODY_BYTES = 1 << 20
+
+
+def discover_replicas(tracker_uri: str, tracker_port: int,
+                      serve_port: int) -> List[str]:
+    """Replica URLs from the tracker's job map: rank ``r`` of the
+    current generation is expected to serve on ``serve_port + r`` on
+    its brokered host (the convention ``bin/dmlc-router --tracker``
+    documents; co-hosted replicas get distinct ports, distinct hosts
+    keep a predictable base)."""
+    from ..tracker.client import TrackerClient
+
+    tc = TrackerClient(tracker_uri, tracker_port)
+    doc = tc._query_hostmap()
+    hosts = doc.get("hosts", {})
+    out = []
+    for r in sorted(hosts, key=int):
+        host = hosts[r][0]
+        out.append(f"http://{host}:{serve_port + int(r)}")
+    return out
+
+
+class Replica:
+    """One replica's routing state (mutated only under Router._lock)."""
+
+    __slots__ = ("url", "state", "fail_streak", "next_probe_t",
+                 "last_ok_t", "inflight", "queue_depth", "live",
+                 "active", "waiting", "max_active", "dispatches",
+                 "failures", "last_error")
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+        self.state = HEALTHY     # optimistic: first dispatch/poll decides
+        self.fail_streak = 0
+        self.next_probe_t = 0.0
+        self.last_ok_t: Optional[float] = None
+        self.inflight = 0        # router-side in-flight dispatches
+        self.queue_depth = 0     # decode queue depth from the last poll
+        self.live = 0            # live requests from the last poll
+        self.active = 0
+        self.waiting = 0
+        self.max_active = 0
+        self.dispatches = 0
+        self.failures = 0
+        self.last_error: Optional[str] = None
+
+    def view(self) -> Dict:
+        return {
+            "url": self.url, "state": self.state,
+            "inflight": self.inflight, "queue_depth": self.queue_depth,
+            "live": self.live, "active": self.active,
+            "waiting": self.waiting, "max_active": self.max_active,
+            "dispatches": self.dispatches, "failures": self.failures,
+            "fail_streak": self.fail_streak,
+            "last_error": self.last_error,
+        }
+
+
+def _is_timeout(exc: BaseException) -> bool:
+    """A dispatch timeout means SLOW, not dead: ``socket.timeout`` is
+    ``TimeoutError`` since 3.10, and urllib wraps connect timeouts in
+    ``URLError(reason=timeout)``."""
+    if isinstance(exc, TimeoutError):
+        return True
+    return isinstance(getattr(exc, "reason", None), TimeoutError)
+
+
+class _Outcome:
+    """One dispatch attempt's result, posted to the route() waiter."""
+
+    __slots__ = ("replica", "kind", "ok", "code", "doc", "retry_after",
+                 "transport", "timed_out", "error")
+
+    def __init__(self, replica: Replica, kind: str, *, ok: bool = False,
+                 code: Optional[int] = None, doc: Optional[Dict] = None,
+                 retry_after: Optional[str] = None,
+                 transport: bool = False, timed_out: bool = False,
+                 error: Optional[str] = None):
+        self.replica = replica
+        self.kind = kind          # primary | retry | hedge
+        self.ok = ok
+        self.code = code
+        self.doc = doc
+        self.retry_after = retry_after
+        self.transport = transport
+        self.timed_out = timed_out
+        self.error = error
+
+
+class Router:
+    """Retrying, hedging, drain-aware dispatcher over a replica fleet.
+
+    Defaults come from the ``DMLC_ROUTER_*`` knobs (README "Fleet
+    serving") so ``bin/dmlc-router`` and embedded/test uses read one
+    configuration surface.
+    """
+
+    def __init__(self, replicas: Sequence[str], *,
+                 health_interval_s: Optional[float] = None,
+                 probe_timeout_s: Optional[float] = None,
+                 probe_base_s: Optional[float] = None,
+                 probe_max_s: Optional[float] = None,
+                 retries: Optional[int] = None,
+                 dispatch_timeout_s: Optional[float] = None,
+                 request_timeout_s: Optional[float] = None,
+                 hedge_after_p99_mult: Optional[float] = None,
+                 hedge_min_samples: int = _HEDGE_MIN_SAMPLES,
+                 start_health_thread: bool = True):
+        if not replicas:
+            raise ValueError("router needs at least one replica URL")
+        self._lock = make_lock("Router._lock")
+        self.replicas: List[Replica] = [Replica(u) for u in replicas]
+        if len({r.url for r in self.replicas}) != len(self.replicas):
+            raise ValueError("duplicate replica URLs")
+        self.health_interval_s = (
+            health_interval_s if health_interval_s is not None
+            else get_env("DMLC_ROUTER_HEALTH_INTERVAL_S", 1.0))
+        self.probe_timeout_s = (
+            probe_timeout_s if probe_timeout_s is not None
+            else get_env("DMLC_ROUTER_PROBE_TIMEOUT_S", 2.0))
+        self.probe_base_s = (
+            probe_base_s if probe_base_s is not None
+            else get_env("DMLC_ROUTER_PROBE_BASE_S", 0.5))
+        self.probe_max_s = (
+            probe_max_s if probe_max_s is not None
+            else get_env("DMLC_ROUTER_PROBE_MAX_S", 15.0))
+        self.retries = (retries if retries is not None
+                        else get_env("DMLC_ROUTER_RETRIES", 3))
+        self.dispatch_timeout_s = (
+            dispatch_timeout_s if dispatch_timeout_s is not None
+            else get_env("DMLC_ROUTER_DISPATCH_TIMEOUT_S", 120.0))
+        self.request_timeout_s = (
+            request_timeout_s if request_timeout_s is not None
+            else get_env("DMLC_ROUTER_REQUEST_TIMEOUT_S", 300.0))
+        self.hedge_after_p99_mult = (
+            hedge_after_p99_mult if hedge_after_p99_mult is not None
+            else get_env("DMLC_ROUTER_HEDGE_AFTER_P99_MULT", 0.0))
+        self.hedge_min_samples = max(1, int(hedge_min_samples))
+        self._latencies: List[float] = []  # bounded ring (see _record)
+        self._stop = threading.Event()
+        self._publish_fleet_gauges()
+        self._health_thread: Optional[threading.Thread] = None
+        if start_health_thread:
+            self._health_thread = threading.Thread(
+                target=self._health_loop, daemon=True,
+                name="router-health")
+            self._health_thread.start()
+
+    # ---- registry views -------------------------------------------------
+    def replica_views(self) -> List[Dict]:
+        with self._lock:
+            return [r.view() for r in self.replicas]
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            out = {HEALTHY: 0, DOWN: 0, DRAINING: 0}
+            for r in self.replicas:
+                out[r.state] += 1
+        return out
+
+    def _publish_fleet_gauges(self) -> None:
+        c = self.counts()
+        telemetry.set_gauge("router", "replicas_healthy", c[HEALTHY])
+        telemetry.set_gauge("router", "replicas_down", c[DOWN])
+        telemetry.set_gauge("router", "replicas_draining", c[DRAINING])
+
+    # ---- health ---------------------------------------------------------
+    def _health_loop(self) -> None:
+        while not self._stop.wait(self.health_interval_s):
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 - watcher must not die
+                logger.warning("router health sweep failed: %r", e)
+
+    def poll_once(self) -> None:
+        """One health sweep: refresh every replica's load + drain state,
+        probe DOWN replicas whose circuit-breaker backoff expired.
+        Probes run CONCURRENTLY (one short-lived daemon thread per due
+        replica, same isolation _attempt gives dispatches) so a
+        blackholed host costs one probe timeout, not a serialized
+        timeout per victim that starves the whole fleet's freshness.
+        Returns after every probe resolved — tests (and the smoke)
+        drive it deterministically."""
+        now = time.monotonic()
+        with self._lock:
+            due = [r for r in self.replicas
+                   if not (r.state == DOWN and now < r.next_probe_t)]
+        threads = [threading.Thread(target=self._probe_one, args=(r,),
+                                    daemon=True, name="router-probe")
+                   for r in due]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=self.probe_timeout_s + 2.0)
+        self._publish_fleet_gauges()
+
+    def _probe_one(self, rep: Replica) -> None:
+        try:
+            with urllib.request.urlopen(
+                    rep.url + "/healthz",
+                    timeout=self.probe_timeout_s) as resp:
+                doc = json.loads(resp.read())
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            self._mark_down(rep, f"healthz probe failed: {e!r}")
+            return
+        self._mark_alive(rep, doc)
+
+    def _mark_alive(self, rep: Replica, doc: Dict) -> None:
+        draining = bool(doc.get("draining"))
+        reqs = doc.get("requests") or {}
+        recovered = False
+        with self._lock:
+            if rep.state == DOWN:
+                recovered = True
+            rep.state = DRAINING if draining else HEALTHY
+            rep.fail_streak = 0
+            rep.next_probe_t = 0.0
+            rep.last_ok_t = time.monotonic()
+            rep.last_error = None
+            rep.active = int(doc.get("active") or 0)
+            rep.waiting = int(doc.get("waiting") or 0)
+            rep.max_active = int(doc.get("max_active") or 0)
+            rep.live = int(reqs.get("live_requests") or 0)
+            # live_waiting == 0 is a real (idle) reading — only fall
+            # back to the last decode-iteration's queue depth when the
+            # key is genuinely absent (an older replica), else a stale
+            # nonzero iteration record would repel traffic from an
+            # idle replica forever
+            qd = reqs.get("live_waiting")
+            if qd is None:
+                qd = reqs.get("decode_queue_depth") or 0
+            rep.queue_depth = int(qd)
+        if recovered:
+            telemetry.inc("router", "probe_recoveries")
+            telemetry.record_event("router_replica_up", replica=rep.url)
+            logger.info("router: replica %s recovered", rep.url)
+
+    def _mark_down(self, rep: Replica, error: str) -> None:
+        fault_point("router.replica_down", replica=rep.url)
+        was = None
+        with self._lock:
+            was = rep.state
+            rep.state = DOWN
+            rep.fail_streak += 1
+            rep.failures += 1
+            rep.last_error = error
+            backoff = min(self.probe_base_s * (2 ** (rep.fail_streak - 1)),
+                          self.probe_max_s)
+            rep.next_probe_t = time.monotonic() + backoff
+        if was != DOWN:
+            telemetry.inc("router", "replica_down_total")
+            telemetry.record_event("router_replica_down",
+                                   replica=rep.url, error=error)
+            logger.warning("router: replica %s marked down (%s)",
+                           rep.url, error)
+        self._publish_fleet_gauges()
+
+    def _mark_draining(self, rep: Replica) -> None:
+        changed = False
+        with self._lock:
+            if rep.state != DRAINING:
+                rep.state = DRAINING
+                changed = True
+        if changed:
+            telemetry.inc("router", "drain_shifts")
+            telemetry.record_event("router_replica_draining",
+                                   replica=rep.url)
+            logger.info("router: replica %s draining; shifting traffic",
+                        rep.url)
+        self._publish_fleet_gauges()
+
+    # ---- placement ------------------------------------------------------
+    def pick(self, exclude: Optional[set] = None) -> Optional[Replica]:
+        """Least-loaded healthy replica (drain-aware: a DRAINING
+        replica never receives new work), or None.  Load is the
+        router's own in-flight count plus the replica's decode queue
+        depth from the last poll — live signal + ledger signal."""
+        exclude = exclude or set()
+        with self._lock:
+            candidates = [r for r in self.replicas
+                          if r.state == HEALTHY and r.url not in exclude]
+            if not candidates:
+                return None
+            return min(candidates,
+                       key=lambda r: (r.inflight + r.queue_depth,
+                                      r.inflight, r.url))
+
+    # ---- latency evidence (hedge threshold + honest Retry-After) -------
+    def _record_latency(self, secs: float) -> None:
+        with self._lock:
+            self._latencies.append(secs)
+            if len(self._latencies) > _LATENCY_RING:
+                del self._latencies[:len(self._latencies) - _LATENCY_RING]
+
+    def _latency_pct(self, q: float) -> Optional[float]:
+        with self._lock:
+            samples = list(self._latencies)
+        return percentile(samples, q)
+
+    def hedge_after_s(self) -> Optional[float]:
+        """Seconds a dispatch may run before a hedge fires, or None
+        when hedging is off / latency evidence is still thin."""
+        if self.hedge_after_p99_mult <= 0:
+            return None
+        with self._lock:
+            n = len(self._latencies)
+        if n < self.hedge_min_samples:
+            return None
+        p99 = self._latency_pct(99)
+        if p99 is None:
+            return None
+        return self.hedge_after_p99_mult * p99
+
+    def retry_after_s(self) -> int:
+        """Honest 429 Retry-After: aggregate queued work over aggregate
+        decode capacity, scaled by the observed per-request service
+        time (p50 of routed latencies; 1s before evidence exists),
+        clamped to [1, 60]."""
+        with self._lock:
+            queued = sum(r.live + r.inflight for r in self.replicas
+                         if r.state != DOWN)
+            capacity = sum(r.max_active for r in self.replicas
+                           if r.state != DOWN)
+        service = self._latency_pct(50) or 1.0
+        est = queued * service / max(capacity, 1)
+        return max(1, min(60, int(est + 0.999)))
+
+    # ---- dispatch -------------------------------------------------------
+    def _attempt(self, rep: Replica, kind: str, payload: bytes,
+                 timeout_s: float, out_q: "queue.Queue") -> None:
+        """One POST to one replica; the outcome (success, HTTP error,
+        or transport failure) is posted to the route() waiter.  Runs on
+        a daemon thread so a wedged replica cannot wedge the router."""
+        with self._lock:
+            rep.inflight += 1
+            rep.dispatches += 1
+        telemetry.inc("router", "dispatches")
+        try:
+            fault_point("router.dispatch", replica=rep.url, attempt=kind)
+            req = urllib.request.Request(
+                rep.url + "/generate", data=payload,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                doc = json.loads(resp.read())
+            out_q.put(_Outcome(rep, kind, ok=True, code=200, doc=doc))
+        except urllib.error.HTTPError as e:
+            body = e.read()[:4096]
+            try:
+                doc = json.loads(body)
+            except ValueError:
+                doc = {"error": body.decode(errors="replace")}
+            out_q.put(_Outcome(
+                rep, kind, code=e.code, doc=doc,
+                retry_after=e.headers.get("Retry-After"),
+                error=f"HTTP {e.code}: {doc.get('error')}"))
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            out_q.put(_Outcome(rep, kind, transport=True,
+                               timed_out=_is_timeout(e),
+                               error=f"dispatch failed: {e!r}"))
+        finally:
+            with self._lock:
+                rep.inflight -= 1
+
+    def _launch(self, rep: Replica, kind: str, payload: bytes,
+                deadline: float, out_q: "queue.Queue") -> None:
+        timeout_s = max(0.05, min(self.dispatch_timeout_s,
+                                  deadline - time.monotonic()))
+        threading.Thread(
+            target=self._attempt, args=(rep, kind, payload, timeout_s,
+                                        out_q),
+            daemon=True, name=f"router-dispatch-{kind}").start()
+
+    def route(self, body: Dict,
+              timeout_s: Optional[float] = None
+              ) -> Tuple[int, Dict, Dict[str, str]]:
+        """Route one /generate body: returns ``(status, doc, headers)``
+        for the client.  Guarantees: at most one 200 is ever returned
+        per call (first-wins across hedges), a replica that dies
+        mid-dispatch is retried elsewhere under the same idempotency
+        key, and a saturation verdict carries an honest Retry-After."""
+        t0 = time.monotonic()
+        rid = body.get("request_id")
+        if rid is None:
+            rid = uuid.uuid4().hex
+            body = dict(body, request_id=rid)
+        payload = json.dumps(body).encode()
+        deadline = t0 + (timeout_s if timeout_s is not None
+                         else self.request_timeout_s)
+        telemetry.inc("router", "requests")
+        out_q: "queue.Queue[_Outcome]" = queue.Queue()
+        tried: set = set()
+        primary = self.pick()
+        if primary is None:
+            return self._no_replica_verdict()
+        tried.add(primary.url)
+        self._launch(primary, "primary", payload, deadline, out_q)
+        last_launch = time.monotonic()
+        pending = 1
+        retries_left = max(0, int(self.retries))
+        hedged = False
+        saw_429 = saw_other = False
+        last_error: Optional[str] = None
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                telemetry.inc("router", "failed")
+                return (503, {"error": "router request deadline "
+                              "exceeded", "request_id": rid,
+                              "last_error": last_error},
+                        {"Retry-After": "5"})
+            # a retry/hedge launched into a sliver of deadline would be
+            # clamped into a guaranteed timeout — wasted replica work;
+            # past this floor, only already-in-flight attempts decide
+            can_launch = remaining > _MIN_LAUNCH_WINDOW_S
+            wait = remaining
+            hedge_after = None if hedged else self.hedge_after_s()
+            if hedge_after is not None and can_launch:
+                # the hedge clock starts at the LATEST dispatch: a
+                # retry gets its own full threshold before a hedge
+                # fires, per the knob's per-dispatch contract
+                until_hedge = (last_launch + hedge_after) \
+                    - time.monotonic()
+                if until_hedge <= 0:
+                    hedged = True  # single shot, even if no peer is free
+                    rep2 = self.pick(exclude=tried)
+                    if rep2 is not None:
+                        tried.add(rep2.url)
+                        telemetry.inc("router", "hedges")
+                        telemetry.record_event("router_hedge",
+                                               request_id=rid,
+                                               replica=rep2.url)
+                        self._launch(rep2, "hedge", payload, deadline,
+                                     out_q)
+                        pending += 1
+                    continue
+                wait = min(wait, until_hedge)
+            try:
+                out = out_q.get(timeout=wait)
+            except queue.Empty:
+                continue
+            pending -= 1
+            if out.ok:
+                return self._win(out, rid, t0)
+            # ---- a failed attempt ---------------------------------------
+            last_error = out.error
+            if out.code in (400, 404, 413):
+                # the client's error: deterministic on any replica, so
+                # retrying elsewhere would just repeat it
+                telemetry.inc("router", "failed")
+                return out.code, out.doc or {}, {}
+            if out.code == 429:
+                saw_429 = True  # saturated, NOT unhealthy
+            elif out.code == 503 and "drain" in str(
+                    (out.doc or {}).get("error", "")):
+                self._mark_draining(out.replica)
+            elif out.transport and not out.timed_out:
+                saw_other = True
+                self._mark_down(out.replica, out.error or "dispatch "
+                                "failed")
+            else:
+                # a dispatch TIMEOUT (slow, not dead — liveness is the
+                # health prober's verdict, which carries its own
+                # bounded timeout) or a 5xx with the replica still
+                # answering HTTP: retry elsewhere without opening the
+                # circuit
+                saw_other = True
+            nxt = (self.pick(exclude=tried)
+                   if retries_left > 0 and can_launch else None)
+            if nxt is not None:
+                retries_left -= 1
+                tried.add(nxt.url)
+                telemetry.inc("router", "retries")
+                if out.transport and not out.timed_out:
+                    telemetry.inc("router", "failovers_total")
+                    telemetry.record_event("router_failover",
+                                           request_id=rid,
+                                           from_replica=out.replica.url,
+                                           to_replica=nxt.url)
+                self._launch(nxt, "retry", payload, deadline, out_q)
+                last_launch = time.monotonic()
+                pending += 1
+                continue
+            if pending > 0:
+                continue  # a hedge/retry is still in flight; it decides
+            if saw_429 and not saw_other:
+                telemetry.inc("router", "rejected_busy")
+                telemetry.inc("router", "failed")
+                return (429, {"error": "all replicas saturated",
+                              "request_id": rid},
+                        {"Retry-After": str(self.retry_after_s())})
+            telemetry.inc("router", "failed")
+            return (503, {"error": "no replica could serve the request",
+                          "request_id": rid, "last_error": last_error},
+                    {"Retry-After": "5"})
+
+    def _win(self, out: _Outcome, rid: str, t0: float
+             ) -> Tuple[int, Dict, Dict[str, str]]:
+        elapsed = time.monotonic() - t0
+        self._record_latency(elapsed)
+        telemetry.inc("router", "completed")
+        telemetry.observe_duration("router", "latency", elapsed)
+        doc = dict(out.doc or {})
+        doc.setdefault("request_id", rid)
+        doc["served_by"] = out.replica.url
+        if out.kind == "hedge":
+            telemetry.inc("router", "hedge_wins")
+        ttft = doc.get("ttft_s")
+        if isinstance(ttft, (int, float)):
+            telemetry.observe_duration("router", "ttft", float(ttft))
+        return 200, doc, {}
+
+    def _no_replica_verdict(self) -> Tuple[int, Dict, Dict[str, str]]:
+        telemetry.inc("router", "failed")
+        c = self.counts()
+        if c[DRAINING] and not c[HEALTHY]:
+            doc = {"error": "every replica is draining"}
+        else:
+            doc = {"error": "no healthy replica"}
+        doc["replicas"] = c
+        return 503, doc, {"Retry-After": str(self.retry_after_s())}
+
+    # ---- observability --------------------------------------------------
+    def stats(self) -> Dict:
+        c = self.counts()
+        with self._lock:
+            agg_live = sum(r.live for r in self.replicas)
+            agg_inflight = sum(r.inflight for r in self.replicas)
+            agg_capacity = sum(r.max_active for r in self.replicas
+                               if r.state != DOWN)
+        return {
+            "replicas": self.replica_views(),
+            "healthy": c[HEALTHY], "down": c[DOWN],
+            "draining": c[DRAINING],
+            "aggregate": {"live": agg_live, "inflight": agg_inflight,
+                          "capacity": agg_capacity},
+            "latency_p50_s": self._latency_pct(50),
+            "latency_p99_s": self._latency_pct(99),
+            "hedge_after_s": self.hedge_after_s(),
+        }
+
+    def prometheus_text(self) -> str:
+        """Hand-rendered per-replica families with a ``replica`` label
+        (the core registry is label-free, same pattern as
+        ``SLOMonitor.prometheus_text``)."""
+        views = self.replica_views()
+        if not views:
+            return ""
+
+        def esc(v: str) -> str:
+            return (v.replace("\\", r"\\").replace('"', r'\"')
+                    .replace("\n", r"\n"))
+
+        fams = (
+            ("dmlc_router_replica_health",
+             "replica health: 1 healthy, 0 down (circuit open), "
+             "2 draining", lambda v: _HEALTH_VALUE[v["state"]]),
+            ("dmlc_router_replica_inflight",
+             "router-side in-flight dispatches per replica",
+             lambda v: v["inflight"]),
+            ("dmlc_router_replica_queue_depth",
+             "replica decode queue depth from the last health poll",
+             lambda v: v["queue_depth"]),
+            ("dmlc_router_replica_dispatches",
+             "dispatches sent to this replica", lambda v: v["dispatches"]),
+            ("dmlc_router_replica_failures",
+             "transport/probe failures observed on this replica",
+             lambda v: v["failures"]),
+        )
+        lines = []
+        for name, help_text, getter in fams:
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} gauge")
+            for v in views:
+                lines.append(
+                    f'{name}{{replica="{esc(v["url"])}"}} {getter(v)}')
+        return "\n".join(lines) + "\n"
+
+    def close(self) -> None:
+        self._stop.set()
+        t = self._health_thread
+        if t is not None:
+            t.join(timeout=5.0)
+
+
+#: the status codes the router edge answers with, each a registered
+#: counter family (mirrors serving/server.py _STATUS_COUNTERS)
+_ROUTER_STATUS_COUNTERS = {200: "http_200", 400: "http_400",
+                           404: "http_404", 429: "http_429",
+                           503: "http_503"}
+
+
+class RouterHTTPServer:
+    """HTTP front door over a :class:`Router` (the fleet's /generate).
+
+    Same threading model as :class:`serving.server.ServingHTTPServer`:
+    one cheap parked handler thread per in-flight client request; the
+    router decides placement, retry, and hedging underneath it.
+
+    Endpoints:
+      POST /generate   forwarded to the least-loaded healthy replica
+                       (idempotency key injected when absent; retried /
+                       hedged transparently)
+      GET  /healthz    fleet view: per-replica states + aggregates
+      GET  /replicas   the replica registry document alone
+      GET  /metrics    router-process Prometheus exposition plus the
+                       hand-rendered per-replica ``dmlc_router_replica_*``
+                       labeled families
+    """
+
+    def __init__(self, router: Router, host: str = "127.0.0.1",
+                 port: int = 0):
+        rt = router
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, ctype: str, body: bytes,
+                      extra_headers=None) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _answer(self, code: int, doc, extra_headers=None) -> None:
+                telemetry.inc("router", _ROUTER_STATUS_COUNTERS.get(
+                    code, "http_other"))
+                self._send(code, "application/json",
+                           json.dumps(doc).encode(),
+                           extra_headers=extra_headers)
+
+            def do_GET(self):  # noqa: N802 - http.server API
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    text = (telemetry.to_prometheus_text()
+                            + rt.prometheus_text())
+                    self._send(200,
+                               "text/plain; version=0.0.4; charset=utf-8",
+                               text.encode())
+                elif path == "/healthz":
+                    st = rt.stats()
+                    status = "ok" if st["healthy"] else "degraded"
+                    self._send(200, "application/json",
+                               json.dumps({"status": status,
+                                           **st}).encode())
+                elif path == "/replicas":
+                    self._send(200, "application/json",
+                               json.dumps(rt.replica_views()).encode())
+                else:
+                    # GET 404s uncounted: monitors probe optional
+                    # endpoints by design (same policy as the replica)
+                    self._send(404, "text/plain", b"not found\n")
+
+            def do_POST(self):  # noqa: N802 - http.server API
+                path = self.path.split("?", 1)[0]
+                if path != "/generate":
+                    telemetry.inc("router", "http_404")
+                    self._send(404, "text/plain", b"not found\n")
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", "0"))
+                    if n > MAX_BODY_BYTES:
+                        self._answer(400, {"error": "body too large"})
+                        return
+                    doc = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(doc, dict):
+                        raise ValueError("body must be a JSON object")
+                    rid = doc.get("request_id")
+                    if rid is not None and (not isinstance(rid, str)
+                                            or not rid or len(rid) > 128):
+                        raise ValueError("request_id must be a non-empty "
+                                         "string of at most 128 chars")
+                except (ValueError, TypeError,
+                        json.JSONDecodeError) as e:
+                    self._answer(400, {"error": f"bad request: {e}"})
+                    return
+                code, out, headers = rt.route(doc)
+                self._answer(code, out, extra_headers=headers)
+
+            def log_message(self, fmt, *args):
+                logger.debug("router http: " + fmt, *args)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self.router = router
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="router-http")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+        self.router.close()

@@ -84,7 +84,9 @@ class Request:
         self.finish_t: Optional[float] = None
         self.error: Optional[str] = None
         self.preemptions = 0
+        self.crash_requeues = 0  # engine-iteration crashes survived
         self.slot = None  # admission token (engine's BufferPool buffer)
+        self.client_id: Optional[str] = None  # idempotency key, if any
         self._done = threading.Event()
 
     # ---- views ----------------------------------------------------------
@@ -131,9 +133,19 @@ class Request:
         """Block until the request completes (True) or times out."""
         return self._done.wait(timeout)
 
+    def reject(self, error: str) -> None:
+        """Terminal transition for a request that was never enqueued
+        (its admission failed AFTER a dedupe claim published it): mark
+        FAILED and wake any duplicate waiters, without touching
+        scheduler or cache state — there is none to release."""
+        self.state = FAILED
+        self.error = error
+        self.finish_t = time.monotonic()
+        self._done.set()
+
     def result(self) -> Dict:
         """JSON-able completion document (the server's response body)."""
-        return {
+        out = {
             "id": self.id,
             "state": self.state,
             "error": self.error,
@@ -145,6 +157,9 @@ class Request:
             "decode_tokens_per_s": self.decode_tokens_per_s,
             "preemptions": self.preemptions,
         }
+        if self.client_id is not None:
+            out["request_id"] = self.client_id
+        return out
 
 
 class ContinuousBatchScheduler:
@@ -218,6 +233,29 @@ class ContinuousBatchScheduler:
             self._active.append(req)
             telemetry.set_gauge("serving", "active_requests",
                                 len(self._active))
+
+    def requeue_active(self, req: Request) -> bool:
+        """Crash requeue: pull a SPECIFIC active request back to the
+        front of the wait queue (its cache state after a crashed
+        iteration is unknowable, so its blocks are freed and the
+        re-prefill recomputes from ``context_ids()`` — identical
+        recompute-resume mechanics to preemption, but counted on the
+        request's ``crash_requeues`` budget instead of preemptions).
+        Returns False when the request is not active (it finished or
+        was swept concurrently)."""
+        with self._lock:
+            if req not in self._active:
+                return False
+            self._active.remove(req)
+            req.state = WAITING
+            req.crash_requeues += 1
+            self._waiting.appendleft(req)
+            telemetry.set_gauge("serving", "active_requests",
+                                len(self._active))
+            telemetry.set_gauge("serving", "queue_depth",
+                                len(self._waiting))
+        self.cache.free(req.id)
+        return True
 
     # ---- eviction -------------------------------------------------------
     def preempt_youngest(self) -> Optional[Request]:

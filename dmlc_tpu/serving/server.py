@@ -159,13 +159,11 @@ class ServingHTTPServer:
                     telemetry.inc("serving", "http_404")
                     self._send(404, "text/plain", b"not found\n")
                     return
-                if eng.draining:
-                    # shutting down on a preemption notice: point the
-                    # client (or its load balancer) elsewhere while the
-                    # in-flight generations finish
-                    self._answer(503, {"error": "server draining"},
-                                 extra_headers={"Retry-After": "5"})
-                    return
+                # NB the drain gate lives in eng.submit (raising
+                # EngineDraining → 503 below), not here: the dedupe
+                # lookup must run first so a router retry of
+                # already-admitted work still resolves on a draining
+                # replica instead of bouncing 503
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     if n > MAX_BODY_BYTES:
@@ -179,12 +177,21 @@ class ServingHTTPServer:
                     max_tokens = doc.get("max_tokens")
                     if max_tokens is not None:
                         max_tokens = int(max_tokens)
+                    request_id = doc.get("request_id")
+                    if request_id is not None \
+                            and not isinstance(request_id, str):
+                        raise ValueError("request_id must be a string")
                 except (KeyError, ValueError, TypeError,
                         json.JSONDecodeError) as e:
                     self._answer(400, {"error": f"bad request: {e}"})
                     return
                 try:
-                    req = eng.submit(prompt, max_new_tokens=max_tokens)
+                    # request_id is the idempotency key: a duplicate of
+                    # a live or recently finished request returns the
+                    # SAME request (no second generation) — see
+                    # InferenceEngine.submit
+                    req = eng.submit(prompt, max_new_tokens=max_tokens,
+                                     request_id=request_id)
                 except AdmissionFull as e:
                     self._answer(429, {"error": str(e)},
                                  extra_headers={"Retry-After": "1"})
@@ -207,7 +214,14 @@ class ServingHTTPServer:
                     return
                 doc = req.result()
                 if req.error:
-                    self._answer(503, doc)
+                    if getattr(req, "rejected_busy", False):
+                        # a duplicate that parked on an original whose
+                        # admission then failed: same verdict the
+                        # original got (429), not a generic 503
+                        self._answer(429, doc,
+                                     extra_headers={"Retry-After": "1"})
+                    else:
+                        self._answer(503, doc)
                 else:
                     self._answer(200, doc)
 

@@ -179,6 +179,41 @@ METRIC_NAMES = frozenset({
     "dmlc_serving_failed_nonfinite",
     "dmlc_serving_failed_kv_exhausted",
     "dmlc_serving_failed_other",
+    # serving idempotency + crash-requeue (engine dedupe ring,
+    # requeue-on-crash)
+    "dmlc_serving_dedupe_hits",
+    "dmlc_serving_crash_requeues",
+    # fleet router (serving/router.py): dispatch/retry/hedge/failover
+    # counters, fleet health gauges, routed latency/TTFT, per-status
+    # edge counters, and the hand-rendered per-replica labeled families
+    "dmlc_router_requests",
+    "dmlc_router_completed",
+    "dmlc_router_failed",
+    "dmlc_router_dispatches",
+    "dmlc_router_retries",
+    "dmlc_router_failovers_total",
+    "dmlc_router_hedges",
+    "dmlc_router_hedge_wins",
+    "dmlc_router_drain_shifts",
+    "dmlc_router_replica_down_total",
+    "dmlc_router_probe_recoveries",
+    "dmlc_router_rejected_busy",
+    "dmlc_router_replicas_healthy",
+    "dmlc_router_replicas_down",
+    "dmlc_router_replicas_draining",
+    "dmlc_router_latency_secs",
+    "dmlc_router_ttft_secs",
+    "dmlc_router_http_200",
+    "dmlc_router_http_400",
+    "dmlc_router_http_404",
+    "dmlc_router_http_429",
+    "dmlc_router_http_503",
+    "dmlc_router_http_other",
+    "dmlc_router_replica_health",
+    "dmlc_router_replica_inflight",
+    "dmlc_router_replica_queue_depth",
+    "dmlc_router_replica_dispatches",
+    "dmlc_router_replica_failures",
     # serving SLO monitor (telemetry.slo): counter + hand-rendered
     # labeled gauge families on the serving /metrics
     "dmlc_slo_violations",
@@ -232,6 +267,8 @@ NON_METRIC_TOKENS = frozenset({
     "dmlc_selfheal",      # prose prefix for the dmlc_selfheal_* family
     "dmlc_serving",       # prose prefix for the dmlc_serving_* family
     "dmlc_serve",         # bin/dmlc-serve launcher name in prose
+    "dmlc_router",        # prose prefix for the dmlc_router_* family
+    "dmlc_router_replica",  # prose prefix: dmlc_router_replica_<field>
     "dmlc_slo",           # prose prefix for the dmlc_slo_* family
     "dmlc_serving_http",  # prose prefix: dmlc_serving_http_<code>
     "dmlc_recordio_spans",  # native ABI symbol (dmlc_native.cc)

@@ -65,6 +65,15 @@
 #      strict-Prometheus /metrics, and the quarantine/skip-list,
 #      epoch-cache footer, and corrupt-checkpoint-fallback paths all
 #      exercised onto the metric surface
+#  12. fleet smoke: fault-tolerant fleet serving — the router over two
+#      real replica processes under loadgen.  One replica is SIGKILLed
+#      mid-burst: every client request still completes (idempotent
+#      retry/failover, dmlc_router_failovers_total >= 1 on a
+#      strict-Prometheus /metrics, p99 TTFT bounded), the restarted
+#      replica is re-admitted by the health probe's circuit breaker,
+#      tail hedging races two replicas without double-serving, and a
+#      graceful-drain (SIGTERM) phase shifts traffic with zero 503s
+#      reaching clients while the drained replica exits cleanly
 #
 # Usage: scripts/ci.sh [pytest-args...]
 set -u
@@ -256,6 +265,10 @@ echo "== stage 11: integrity smoke (checksums, quarantine, self-heal) =="
 timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/integrity_smoke.py \
     || { echo "FAIL: integrity smoke"; exit 1; }
 
+echo "== stage 12: fleet smoke (router failover, hedging, drain) =="
+timeout -k 10 480 env JAX_PLATFORMS=cpu python scripts/fleet_smoke.py \
+    || { echo "FAIL: fleet smoke"; exit 1; }
+
 echo "== CI OK (native=$NATIVE_OK tsan=$TSAN_OK asan=$ASAN_OK" \
      "ubsan=$UBSAN_OK telemetry=1 chaos=1 perf=1 serving=1 elastic=1" \
-     "integrity=1) =="
+     "integrity=1 fleet=1) =="

@@ -1,0 +1,318 @@
+#!/usr/bin/env python
+"""CI fleet smoke (ci.sh stage 12): fault-tolerant fleet serving.
+
+Boots TWO real replica processes (InferenceEngine + ServingHTTPServer
+on a tiny model), fronts them with the Router, and asserts the
+failure-first acceptance contract end to end:
+
+  * **SIGKILL under live load is client-invisible**: one replica is
+    killed mid-burst; every client request still completes (the router
+    retries the torn dispatches on the survivor under the same
+    idempotency key — retried, not failed), zero client-visible
+    failures, ``dmlc_router_failovers_total`` >= 1 on the router's
+    strict-Prometheus ``/metrics``, and p99 TTFT stays bounded.
+  * **circuit recovery**: the killed replica is restarted on its old
+    port and the health probe's circuit breaker re-admits it.
+  * **hedging**: with a tight hedge threshold, tail dispatches get a
+    duplicate on the second replica; first wins, hedge counters land
+    on ``/metrics``, nothing double-serves (idempotency keys ride
+    every hedge).
+  * **graceful drain is zero-503**: one replica gets SIGTERM (the
+    preemption notice) mid-burst; traffic shifts to the other replica
+    with ZERO 503s reaching clients — the drained replica finishes its
+    in-flight work and exits cleanly.
+
+Runs in ~2-3 min on 2 CPU cores.  Usage: python scripts/fleet_smoke.py
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_STREAMS = 8
+REQS_PER_STREAM = 3
+MAX_TOKENS = 12
+P99_TTFT_BOUND_S = 20.0
+BOOT_TIMEOUT_S = 180.0
+
+#: the replica worker program: tiny model (identical config to
+#: serving_smoke so shapes/compiles match), fixed port from the
+#: environment, SIGTERM armed as the graceful-drain trigger
+REPLICA_PROG = r"""
+import os, sys, time
+sys.path.insert(0, os.environ["FLEET_REPO"])
+import jax
+from dmlc_tpu.models import transformer as tfm
+from dmlc_tpu.serving import InferenceEngine, ServingHTTPServer
+
+cfg = tfm.TransformerConfig(
+    vocab=128, d_model=32, n_heads=2, head_dim=8, d_ff=64,
+    n_layers=2, n_experts=1, microbatches=1, dtype="float32")
+params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+engine = InferenceEngine(params, cfg, n_blocks=128, block_size=8,
+                         max_active=8, queue_depth=32,
+                         admit_timeout_s=5.0)
+engine.start()
+server = ServingHTTPServer(engine, port=int(os.environ["FLEET_PORT"]))
+server.install_drain_handler()
+print("REPLICA_URL", server.url, flush=True)
+while not engine.draining:
+    time.sleep(0.1)
+server.wait_drained(120)
+print("REPLICA_DRAINED", flush=True)
+"""
+
+
+class ReplicaProc:
+    """One replica subprocess on a pinned port."""
+
+    def __init__(self, port: int):
+        self.port = port
+        self.url = f"http://127.0.0.1:{port}"
+        env = dict(os.environ, FLEET_REPO=REPO, FLEET_PORT=str(port),
+                   JAX_PLATFORMS="cpu")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-c", REPLICA_PROG], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        self.lines = []
+        self._reader = threading.Thread(target=self._read, daemon=True)
+        self._reader.start()
+
+    def _read(self):
+        for line in self.proc.stdout:
+            self.lines.append(line.rstrip())
+
+    def wait_ready(self, timeout_s: float = BOOT_TIMEOUT_S) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if any(ln.startswith("REPLICA_URL") for ln in self.lines):
+                return
+            if self.proc.poll() is not None:
+                raise AssertionError(
+                    f"replica :{self.port} died at boot:\n"
+                    + "\n".join(self.lines[-20:]))
+            time.sleep(0.1)
+        raise AssertionError(f"replica :{self.port} never came up")
+
+    def sigkill(self):
+        self.proc.kill()
+        self.proc.wait(10)
+
+    def sigterm(self):
+        self.proc.send_signal(signal.SIGTERM)
+
+    def stop(self):
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(10)
+
+
+def fetch(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def router_counters(router_url):
+    text = fetch(router_url + "/metrics").decode()
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("dmlc_router_") and " " in line \
+                and not line.startswith("#") and "{" not in line:
+            name, val = line.rsplit(" ", 1)
+            out[name] = float(val)
+    return out
+
+
+def main():
+    from dmlc_tpu.serving import LoadGenerator
+    from dmlc_tpu.serving.router import Router, RouterHTTPServer
+    from dmlc_tpu.telemetry.exporters import validate_exposition_text
+    from dmlc_tpu.tracker.rendezvous import free_port
+
+    ports = [free_port(), free_port()]
+    print(f"fleet_smoke: booting 2 replicas on ports {ports}")
+    reps = [ReplicaProc(p) for p in ports]
+    for rp in reps:
+        rp.wait_ready()
+    print("fleet_smoke: replicas up")
+
+    router = Router([rp.url for rp in reps], health_interval_s=0.2,
+                    probe_base_s=0.2, probe_max_s=2.0, retries=3,
+                    dispatch_timeout_s=120.0, request_timeout_s=240.0)
+    server = RouterHTTPServer(router, port=0)
+    print(f"fleet_smoke: router at {server.url}")
+    try:
+        run(router, server, reps, LoadGenerator,
+            validate_exposition_text)
+    finally:
+        server.close()
+        for rp in reps:
+            rp.stop()
+    print("fleet_smoke: OK")
+
+
+def run(router, server, reps, LoadGenerator, validate_exposition_text):
+    # ---- warmup: absorb each replica's jit compiles DIRECTLY so the
+    # measured phases are steady-state on both
+    for rp in reps:
+        warm = LoadGenerator(rp.url, n_streams=2, requests_per_stream=1,
+                             prompt_len=(4, 28), max_tokens=4, vocab=128,
+                             seed=99)
+        warm.run()
+        assert not warm.failures, \
+            f"warmup failed on {rp.url}: {warm.failures[:2]}"
+    print("fleet_smoke: replicas warmed")
+
+    # ---- phase 1: SIGKILL one replica mid-burst -----------------------
+    victim, survivor = reps[0], reps[1]
+    gen = LoadGenerator(server.url, n_streams=N_STREAMS,
+                        requests_per_stream=REQS_PER_STREAM,
+                        prompt_len=(4, 28), max_tokens=MAX_TOKENS,
+                        vocab=128, seed=0)
+    summary = {}
+    runner = threading.Thread(
+        target=lambda: summary.update(gen.run()), daemon=True)
+    runner.start()
+    # kill once the burst has in-flight dispatches on the victim
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        with router._lock:
+            v = next(r for r in router.replicas
+                     if r.url == victim.url)
+            inflight = v.inflight
+        if inflight > 0:
+            break
+        time.sleep(0.02)
+    assert inflight > 0, "burst never reached the victim replica"
+    victim.sigkill()
+    print(f"fleet_smoke: SIGKILLed {victim.url} with {inflight} "
+          f"dispatch(es) in flight")
+    runner.join(240)
+    assert not runner.is_alive(), "load burst wedged after the kill"
+    print("fleet_smoke: kill-phase summary " + json.dumps(summary))
+
+    want = N_STREAMS * REQS_PER_STREAM
+    assert summary["n_requests_ok"] == want, (
+        f"{summary['n_requests_ok']}/{want} completed; client-visible "
+        f"failures: {gen.failures[:3]}")
+    assert summary["n_requests_failed"] == 0, (
+        f"replica SIGKILL leaked client-visible failures: "
+        f"{gen.failures[:3]}")
+    assert summary["p99_ttft_s"] is not None \
+        and summary["p99_ttft_s"] < P99_TTFT_BOUND_S, (
+        f"p99 TTFT {summary['p99_ttft_s']}s over the "
+        f"{P99_TTFT_BOUND_S}s bound")
+    ctr = router_counters(server.url)
+    assert ctr.get("dmlc_router_failovers_total", 0) >= 1, (
+        f"no failover counted after SIGKILL: {ctr}")
+    assert ctr.get("dmlc_router_replica_down_total", 0) >= 1
+    hz = json.loads(fetch(server.url + "/healthz"))
+    assert hz["down"] >= 1, f"victim not marked down: {hz}"
+    print(f"fleet_smoke: SIGKILL absorbed "
+          f"(failovers={ctr['dmlc_router_failovers_total']:.0f}, "
+          f"p99_ttft={summary['p99_ttft_s']:.2f}s, "
+          f"retried_ok={summary['n_requests_retried_ok']})")
+
+    # ---- phase 2: restart the victim; the circuit re-admits it --------
+    reps[0] = ReplicaProc(victim.port)
+    reps[0].wait_ready()
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        hz = json.loads(fetch(server.url + "/healthz"))
+        if hz["healthy"] == 2:
+            break
+        time.sleep(0.2)
+    assert hz["healthy"] == 2, f"restarted replica never re-admitted: {hz}"
+    ctr = router_counters(server.url)
+    assert ctr.get("dmlc_router_probe_recoveries", 0) >= 1
+    print("fleet_smoke: killed replica restarted and re-admitted "
+          "by the health probe")
+    # re-warm the fresh process (its jit cache died with the old one)
+    warm = LoadGenerator(reps[0].url, n_streams=2, requests_per_stream=1,
+                         prompt_len=(4, 28), max_tokens=4, vocab=128,
+                         seed=98)
+    warm.run()
+    assert not warm.failures
+
+    # ---- phase 3: hedging — tail dispatches race two replicas --------
+    router.hedge_after_p99_mult = 0.5  # hedge anything past half the p99
+    gen2 = LoadGenerator(server.url, n_streams=4, requests_per_stream=2,
+                         prompt_len=(4, 28), max_tokens=MAX_TOKENS,
+                         vocab=128, seed=1)
+    s2 = gen2.run()
+    router.hedge_after_p99_mult = 0.0
+    assert s2["n_requests_ok"] == 8 and s2["n_requests_failed"] == 0, (
+        f"hedged burst failed: {gen2.failures[:3]}")
+    ctr = router_counters(server.url)
+    assert ctr.get("dmlc_router_hedges", 0) >= 1, (
+        f"no hedge fired under a 0.5*p99 threshold: {ctr}")
+    print(f"fleet_smoke: hedging drove "
+          f"{ctr['dmlc_router_hedges']:.0f} hedge(s), "
+          f"{ctr.get('dmlc_router_hedge_wins', 0):.0f} win(s), "
+          f"all requests served exactly once")
+
+    # ---- phase 4: graceful drain is zero-503 to clients ---------------
+    drain_target = reps[1]
+    gen3 = LoadGenerator(server.url, n_streams=N_STREAMS,
+                         requests_per_stream=REQS_PER_STREAM,
+                         prompt_len=(4, 28), max_tokens=MAX_TOKENS,
+                         vocab=128, seed=2)
+    s3 = {}
+    runner = threading.Thread(
+        target=lambda: s3.update(gen3.run()), daemon=True)
+    runner.start()
+    time.sleep(1.0)  # traffic flowing on both replicas
+    drain_target.sigterm()
+    print(f"fleet_smoke: SIGTERMed {drain_target.url} mid-burst")
+    runner.join(240)
+    assert not runner.is_alive(), "drain-phase burst wedged"
+    print("fleet_smoke: drain-phase summary " + json.dumps(s3))
+    want = N_STREAMS * REQS_PER_STREAM
+    assert s3["n_requests_ok"] == want and s3["n_requests_failed"] == 0, (
+        f"drain leaked client-visible failures: {gen3.failures[:3]}")
+    assert s3["n_backoffs_503"] == 0, (
+        f"{s3['n_backoffs_503']} 503(s) reached clients during drain — "
+        "the router must absorb the drain")
+    # the drained replica finished its backlog and exited cleanly
+    rc = drain_target.proc.wait(120)
+    assert rc == 0, f"drained replica exited rc={rc}"
+    assert any("REPLICA_DRAINED" in ln for ln in drain_target.lines), (
+        "drained replica never reported a clean drain:\n"
+        + "\n".join(drain_target.lines[-10:]))
+    hz = json.loads(fetch(server.url + "/healthz"))
+    assert hz["healthy"] >= 1
+    print("fleet_smoke: drain shifted traffic with zero client-facing "
+          "503s; replica exited cleanly")
+
+    # ---- strict exposition + family presence --------------------------
+    text = fetch(server.url + "/metrics").decode()
+    validate_exposition_text(text)
+    for fam in ("dmlc_router_requests", "dmlc_router_completed",
+                "dmlc_router_dispatches", "dmlc_router_retries",
+                "dmlc_router_failovers_total", "dmlc_router_hedges",
+                "dmlc_router_replica_down_total",
+                "dmlc_router_probe_recoveries",
+                "dmlc_router_replicas_healthy",
+                "dmlc_router_latency_secs", "dmlc_router_ttft_secs",
+                "dmlc_router_replica_health",
+                "dmlc_router_replica_queue_depth",
+                "dmlc_router_replica_dispatches"):
+        assert fam in text, f"{fam} missing from router /metrics"
+    assert text.count('dmlc_router_replica_health{') == 2, (
+        "expected one health sample per replica")
+    print("fleet_smoke: router /metrics strict-Prometheus with all "
+          "dmlc_router_* families")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,449 @@
+"""Fleet router: health circuit, least-loaded routing, idempotent
+retry/failover, hedging, drain shift, and honest backpressure.
+
+The router is pure HTTP policy (no jax), so the replicas here are
+scriptable stand-ins whose behavior flips per phase (ok / die / slow /
+saturated / draining) — deterministic and millisecond-fast.  The real
+engine-under-router path is covered end to end by
+``scripts/fleet_smoke.py`` (CI stage 12) and the engine-side dedupe
+tests in test_serving.py.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dmlc_tpu import telemetry
+from dmlc_tpu.resilience import fault
+from dmlc_tpu.serving.router import (DOWN, DRAINING, HEALTHY, Router,
+                                     RouterHTTPServer, discover_replicas)
+
+
+class FakeReplica:
+    """Scriptable replica endpoint: ``mode`` flips its behavior."""
+
+    def __init__(self, name):
+        self.name = name
+        self.mode = "ok"        # ok | die | slow | s429 | s503drain
+        self.slow_s = 0.8
+        self.draining = False
+        self.waiting = 0
+        self.hits = []          # request_ids seen on /generate
+        self._lock = threading.Lock()
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            def _send(self, code, doc, headers=None):
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path != "/healthz" or fake.mode == "die":
+                    self.connection.close()
+                    return
+                self._send(200, {
+                    "status": "ok", "active": 0,
+                    "waiting": fake.waiting, "max_active": 4,
+                    "draining": fake.draining,
+                    "requests": {"live_requests": fake.waiting,
+                                 "live_waiting": fake.waiting}})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                doc = json.loads(self.rfile.read(n))
+                with fake._lock:
+                    fake.hits.append(doc.get("request_id"))
+                if fake.mode == "die":
+                    self.connection.close()
+                    return
+                if fake.mode == "slow":
+                    time.sleep(fake.slow_s)
+                if fake.mode == "s429":
+                    self._send(429, {"error": "admission queue full"},
+                               {"Retry-After": "1"})
+                elif fake.mode == "s503drain":
+                    self._send(503, {"error": "server draining"},
+                               {"Retry-After": "5"})
+                else:
+                    self._send(200, {"state": "done",
+                                     "output_ids": [1, 2, 3],
+                                     "served": fake.name,
+                                     "ttft_s": 0.01,
+                                     "request_id": doc.get("request_id")})
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture()
+def fleet():
+    a, b = FakeReplica("a"), FakeReplica("b")
+    r = Router([a.url, b.url], health_interval_s=0.05, probe_base_s=0.05,
+               probe_max_s=0.5, retries=3, dispatch_timeout_s=5.0,
+               request_timeout_s=10.0, start_health_thread=False)
+    r.poll_once()
+    try:
+        yield a, b, r
+    finally:
+        r.close()
+        a.close()
+        b.close()
+
+
+def _load(router, url, depth):
+    """Pin a replica's polled queue depth (placement steering)."""
+    with router._lock:
+        for rep in router.replicas:
+            if rep.url == url:
+                rep.queue_depth = depth
+
+
+def _counters():
+    return telemetry.counters_snapshot().get("router", {})
+
+
+# ---------------------------------------------------------------------------
+# placement + health
+# ---------------------------------------------------------------------------
+
+def test_routes_least_loaded_and_carries_request_id(fleet):
+    a, b, r = fleet
+    _load(r, a.url, 5)  # a busier -> b must win
+    code, doc, _ = r.route({"prompt": [1, 2], "max_tokens": 2})
+    assert code == 200 and doc["served"] == "b"
+    assert doc["served_by"] == b.url
+    # an idempotency key was minted and forwarded
+    assert b.hits and isinstance(b.hits[-1], str) and b.hits[-1]
+    assert doc["request_id"] == b.hits[-1]
+    # a client-supplied key is forwarded verbatim
+    code, doc, _ = r.route({"prompt": [1], "request_id": "my-key"})
+    assert code == 200 and doc["request_id"] == "my-key"
+    assert "my-key" in (a.hits + b.hits)
+
+
+def test_idle_live_waiting_zero_overrides_stale_iteration_depth(fleet):
+    """live_waiting == 0 is a real idle reading: a stale nonzero
+    decode_queue_depth from the last iteration record must not repel
+    traffic from an idle replica."""
+    a, b, r = fleet
+    # hand the router a healthz doc shaped like an idle replica whose
+    # last decode iteration still says waiting=3
+    rep = next(x for x in r.replicas if x.url == a.url)
+    r._mark_alive(rep, {"active": 0, "waiting": 0, "max_active": 4,
+                        "draining": False,
+                        "requests": {"live_requests": 0,
+                                     "live_waiting": 0,
+                                     "decode_queue_depth": 3}})
+    assert rep.queue_depth == 0
+    # an OLDER replica without live_waiting still falls back
+    r._mark_alive(rep, {"active": 0, "waiting": 0, "max_active": 4,
+                        "requests": {"decode_queue_depth": 3}})
+    assert rep.queue_depth == 3
+
+
+def test_health_poll_marks_down_and_circuit_reprobes(fleet):
+    a, b, r = fleet
+    a.mode = "die"
+    r.poll_once()
+    states = {v["url"]: v["state"] for v in r.replica_views()}
+    assert states[a.url] == DOWN and states[b.url] == HEALTHY
+    down_total = _counters().get("replica_down_total", 0)
+    # circuit open: an immediate re-poll must NOT probe a again
+    hits_before = len(a.hits)
+    r.poll_once()
+    assert _counters().get("replica_down_total", 0) == down_total
+    # backoff expires -> probe -> recovery closes the circuit
+    a.mode = "ok"
+    time.sleep(0.08)
+    r.poll_once()
+    assert r.counts()[HEALTHY] == 2
+    assert len(a.hits) == hits_before  # probes hit /healthz, not /generate
+
+
+def test_probe_backoff_grows_exponentially(fleet):
+    a, b, r = fleet
+    a.mode = "die"
+    r.poll_once()
+    rep = next(x for x in r.replicas if x.url == a.url)
+    first = rep.next_probe_t - time.monotonic()
+    time.sleep(0.08)
+    r.poll_once()  # second failed probe doubles the backoff
+    second = rep.next_probe_t - time.monotonic()
+    assert second > first
+    assert rep.fail_streak >= 2
+
+
+# ---------------------------------------------------------------------------
+# retry / failover
+# ---------------------------------------------------------------------------
+
+def test_failover_on_dead_replica_is_client_invisible(fleet):
+    a, b, r = fleet
+    before = _counters().get("failovers_total", 0)
+    a.mode = "die"
+    _load(r, b.url, 10)  # steer the primary dispatch onto dead a
+    code, doc, _ = r.route({"prompt": [1], "request_id": "fo-1"})
+    assert code == 200 and doc["served"] == "b"
+    assert _counters()["failovers_total"] == before + 1
+    # the retry reused the SAME idempotency key
+    assert a.hits[-1] == "fo-1" and b.hits[-1] == "fo-1"
+    # and the dead replica's circuit opened passively (no poll needed)
+    assert next(x for x in r.replicas if x.url == a.url).state == DOWN
+
+
+def test_dispatch_timeout_retries_without_opening_circuit(fleet):
+    """Slow is not dead: a dispatch timeout retries elsewhere but must
+    NOT mark the replica down (the health prober owns liveness) and
+    must not count as a failover."""
+    a, b, r = fleet
+    r.dispatch_timeout_s = 0.2
+    a.mode = "slow"
+    a.slow_s = 1.0  # outlives the dispatch timeout
+    _load(r, b.url, 10)  # primary goes to slow a
+    before = _counters().get("failovers_total", 0)
+    code, doc, _ = r.route({"prompt": [1]})
+    assert code == 200 and doc["served"] == "b"
+    assert next(x for x in r.replicas if x.url == a.url).state == HEALTHY
+    assert _counters().get("failovers_total", 0) == before
+
+
+def test_no_new_dispatch_into_a_sliver_of_deadline(fleet):
+    """A retry launched into <1s of remaining deadline would be a
+    guaranteed timeout: the router gives up cleanly instead of
+    poisoning a replica with doomed work."""
+    a, b, r = fleet
+    a.mode = b.mode = "slow"
+    a.slow_s = b.slow_s = 5.0
+    r.request_timeout_s = 0.8  # below the launch floor after t0
+    code, doc, _ = r.route({"prompt": [1]})
+    assert code == 503 and "deadline" in doc["error"]
+    # only the primary dispatch ever launched
+    assert len(a.hits) + len(b.hits) == 1
+
+
+def test_injected_dispatch_fault_drives_retry(fleet):
+    """The router.dispatch fault site: an armed error rule simulates a
+    torn dispatch and the retry path absorbs it deterministically."""
+    a, b, r = fleet
+    fault.install_injector(f"router.dispatch@replica:{a.url}=error::1")
+    try:
+        _load(r, b.url, 10)  # primary goes to a, whose dispatch is torn
+        code, doc, _ = r.route({"prompt": [1]})
+        assert code == 200 and doc["served"] == "b"
+    finally:
+        fault.reset_injector()
+
+
+def test_client_errors_pass_through_without_retry():
+    # a 400 is deterministic on any replica: the router must hand it
+    # straight back instead of burning retries on it
+    c = FakeReplica("c")
+
+    def do_post_400(handler_self):
+        body = json.dumps({"error": "bad request: boom"}).encode()
+        handler_self.send_response(400)
+        handler_self.send_header("Content-Length", str(len(body)))
+        handler_self.end_headers()
+        handler_self.wfile.write(body)
+
+    c.httpd.RequestHandlerClass.do_POST = do_post_400
+    r2 = Router([c.url], retries=3, request_timeout_s=5.0,
+                start_health_thread=False)
+    try:
+        code, doc, _ = r2.route({"prompt": "bad"})
+        assert code == 400 and "bad request" in doc["error"]
+    finally:
+        r2.close()
+        c.close()
+
+
+def test_all_replicas_down_yields_503_with_retry_after(fleet):
+    a, b, r = fleet
+    a.mode = b.mode = "die"
+    r.poll_once()
+    assert r.counts()[DOWN] == 2
+    code, doc, headers = r.route({"prompt": [1]})
+    assert code == 503 and "Retry-After" in headers
+    assert "no healthy replica" in doc["error"]
+
+
+# ---------------------------------------------------------------------------
+# backpressure + drain
+# ---------------------------------------------------------------------------
+
+def test_all_saturated_yields_429_with_aggregate_retry_after(fleet):
+    a, b, r = fleet
+    a.mode = b.mode = "s429"
+    before = _counters().get("rejected_busy", 0)
+    code, doc, headers = r.route({"prompt": [1]})
+    assert code == 429
+    assert "saturated" in doc["error"]
+    assert int(headers["Retry-After"]) >= 1
+    assert _counters()["rejected_busy"] == before + 1
+    # both replicas were tried before giving up
+    assert a.hits and b.hits
+
+
+def test_retry_after_scales_with_aggregate_queue_depth(fleet):
+    a, b, r = fleet
+    for _ in range(4):  # pin the service-time evidence
+        r._record_latency(0.5)
+    shallow = r.retry_after_s()
+    _load(r, a.url, 300)
+    _load(r, b.url, 300)
+    with r._lock:
+        for rep in r.replicas:
+            rep.live = 300
+    deep = r.retry_after_s()
+    assert deep > shallow
+    assert 1 <= shallow <= 60 and 1 <= deep <= 60
+
+
+def test_draining_replica_sheds_traffic(fleet):
+    a, b, r = fleet
+    a.draining = True
+    r.poll_once()
+    assert r.counts() == {HEALTHY: 1, DOWN: 0, DRAINING: 1}
+    for _ in range(4):
+        code, doc, _ = r.route({"prompt": [1]})
+        assert code == 200 and doc["served"] == "b"
+    # a 503-draining answer ALSO flips the state without a poll
+    a.draining = False
+    r.poll_once()
+    a.mode = "s503drain"
+    _load(r, b.url, 10)
+    before = _counters().get("drain_shifts", 0)
+    code, doc, _ = r.route({"prompt": [1]})
+    assert code == 200 and doc["served"] == "b"
+    assert _counters()["drain_shifts"] == before + 1
+    assert next(x for x in r.replicas if x.url == a.url).state == DRAINING
+
+
+# ---------------------------------------------------------------------------
+# hedging
+# ---------------------------------------------------------------------------
+
+def test_hedge_fires_after_p99_mult_and_first_wins(fleet):
+    a, b, r = fleet
+    r.hedge_after_p99_mult = 3.0
+    r.hedge_min_samples = 4
+    assert r.hedge_after_s() is None  # no evidence yet: hedging armed off
+    for _ in range(6):
+        assert r.route({"prompt": [1]})[0] == 200
+    threshold = r.hedge_after_s()
+    assert threshold is not None and threshold < 0.5
+    a.mode = "slow"  # tail request: primary outlives the threshold
+    _load(r, a.url, 0)
+    _load(r, b.url, 5)
+    before = _counters().get("hedge_wins", 0)
+    t0 = time.monotonic()
+    code, doc, _ = r.route({"prompt": [1], "request_id": "hedge-1"})
+    assert code == 200 and doc["served"] == "b"
+    assert time.monotonic() - t0 < a.slow_s  # did not wait out the tail
+    assert _counters()["hedge_wins"] == before + 1
+    # both replicas saw the SAME idempotency key (no double-serving:
+    # the client got exactly one response; the loser was abandoned)
+    assert a.hits[-1] == "hedge-1" and b.hits[-1] == "hedge-1"
+
+
+def test_hedge_disabled_by_default(fleet):
+    a, b, r = fleet
+    assert r.hedge_after_p99_mult == 0.0
+    for _ in range(20):
+        r._record_latency(0.01)
+    assert r.hedge_after_s() is None
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface + discovery + exposition
+# ---------------------------------------------------------------------------
+
+def test_router_http_surface(fleet):
+    a, b, r = fleet
+    srv = RouterHTTPServer(r, port=0)
+    try:
+        req = urllib.request.Request(
+            srv.url + "/generate",
+            data=json.dumps({"prompt": [1, 2]}).encode(),
+            headers={"Content-Type": "application/json"})
+        doc = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        assert doc["state"] == "done" and doc["served_by"] in (a.url,
+                                                               b.url)
+        hz = json.loads(urllib.request.urlopen(
+            srv.url + "/healthz", timeout=5).read())
+        assert hz["status"] == "ok" and hz["healthy"] == 2
+        assert len(hz["replicas"]) == 2
+        reps = json.loads(urllib.request.urlopen(
+            srv.url + "/replicas", timeout=5).read())
+        assert {v["url"] for v in reps} == {a.url, b.url}
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(urllib.request.Request(
+                srv.url + "/generate", data=b"{bad json",
+                headers={"Content-Type": "application/json"}),
+                timeout=5)
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(urllib.request.Request(
+                srv.url + "/generate",
+                data=json.dumps({"prompt": [1],
+                                 "request_id": 7}).encode()), timeout=5)
+        assert e.value.code == 400  # non-string idempotency key
+        text = urllib.request.urlopen(
+            srv.url + "/metrics", timeout=5).read().decode()
+        from dmlc_tpu.telemetry.exporters import validate_exposition_text
+
+        validate_exposition_text(text)
+        for fam in ("dmlc_router_requests", "dmlc_router_dispatches",
+                    "dmlc_router_replicas_healthy",
+                    "dmlc_router_replica_health",
+                    "dmlc_router_replica_queue_depth",
+                    "dmlc_router_http_200"):
+            assert fam in text, f"{fam} missing from router /metrics"
+        assert f'replica="{a.url}"' in text
+    finally:
+        srv.close()
+
+
+def test_discover_replicas_from_tracker_job_map(monkeypatch):
+    from dmlc_tpu.tracker import client as tclient
+
+    def fake_hostmap(self):
+        return {"gen": 0, "world": 3,
+                "hosts": {"0": ["10.0.0.1", 4000],
+                          "2": ["10.0.0.2", 4002],
+                          "1": ["10.0.0.1", 4001]}}
+
+    monkeypatch.setattr(tclient.TrackerClient, "_query_hostmap",
+                        fake_hostmap)
+    urls = discover_replicas("10.0.0.9", 9091, 8901)
+    assert urls == ["http://10.0.0.1:8901", "http://10.0.0.1:8902",
+                    "http://10.0.0.2:8903"]
+
+
+def test_router_rejects_empty_or_duplicate_fleets():
+    with pytest.raises(ValueError):
+        Router([])
+    with pytest.raises(ValueError):
+        Router(["http://h:1", "http://h:1/"],
+               start_health_thread=False)

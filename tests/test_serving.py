@@ -569,6 +569,377 @@ def test_drain_deadline_fails_leftovers():
         eng.close()
 
 
+# ---------------------------------------------------------------------------
+# idempotency dedupe (ISSUE 13): the router retry/hedge primitive
+# ---------------------------------------------------------------------------
+
+def test_dedupe_duplicate_while_live_returns_same_request():
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=32, block_size=4,
+                          max_active=2, queue_depth=8)
+    before = telemetry.counters_snapshot().get("serving", {}).get(
+        "dedupe_hits", 0)
+    r1 = eng.submit([1, 2, 3], max_new_tokens=4, request_id="dup-live")
+    r2 = eng.submit([9, 9, 9], max_new_tokens=9, request_id="dup-live")
+    assert r2 is r1, "duplicate while live must not start a second " \
+        "generation"
+    after = telemetry.counters_snapshot()["serving"]["dedupe_hits"]
+    assert after == before + 1
+    eng.start()
+    assert r1.wait(300) and r1.error is None
+    # duplicate after a successful finish: same finished request from
+    # the dedupe ring, same output — not a new generation
+    r3 = eng.submit([1, 2, 3], max_new_tokens=4, request_id="dup-live")
+    assert r3 is r1 and r3.generated == r1.generated
+    # a DIFFERENT id is fresh work
+    r4 = eng.submit([1, 2, 3], max_new_tokens=4, request_id="other")
+    assert r4 is not r1
+    assert r4.wait(300)
+    eng.close()
+
+
+def test_dedupe_ring_is_bounded_and_failed_ids_are_fresh():
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=32, block_size=4,
+                          max_active=2, queue_depth=8)
+    eng._dedupe.capacity = 2
+    eng.start()
+    reqs = {}
+    for key in ("k1", "k2", "k3"):
+        reqs[key] = eng.submit([1, 2], max_new_tokens=2, request_id=key)
+        assert reqs[key].wait(300)
+    # ring capacity 2: k1 was evicted, so its id is fresh work again
+    assert eng.submit([1, 2], max_new_tokens=2,
+                      request_id="k3") is reqs["k3"]
+    r1b = eng.submit([1, 2], max_new_tokens=2, request_id="k1")
+    assert r1b is not reqs["k1"]
+    assert r1b.wait(300)
+    eng.close()
+    # FAILED requests leave the table: a retry is a fresh attempt
+    eng2 = InferenceEngine(params, cfg, n_blocks=32, block_size=4,
+                          max_active=2, queue_depth=8)
+    rf = eng2.submit([1, 2], max_new_tokens=2, request_id="will-fail")
+    eng2.close()  # engine never ran: the sweep fails it
+    assert rf.wait(5) and rf.error is not None
+    assert eng2._dedupe.get("will-fail") is None
+
+
+def test_dedupe_admission_failure_wakes_duplicates_then_resets():
+    """A claimed id whose admission then fails (queue full) must (a)
+    wake any duplicate parked on it with the busy verdict and (b)
+    leave the table so a later retry is a fresh attempt."""
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=32, block_size=4,
+                          max_active=2, queue_depth=1,
+                          admit_timeout_s=0.05)  # NOT started
+    eng.submit([1, 2], max_new_tokens=1)  # occupies the only slot
+    with pytest.raises(AdmissionFull):
+        eng.submit([3, 4], max_new_tokens=1, request_id="busy-key")
+    assert eng._dedupe.get("busy-key") is None
+    eng.close()
+
+
+def test_http_request_id_dedupes_and_echoes():
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=32, block_size=4,
+                          max_active=2, queue_depth=8)
+    eng.start()
+    srv = ServingHTTPServer(eng, port=0)
+    try:
+        d1 = _post(srv.url, {"prompt": [1, 2, 3], "max_tokens": 4,
+                             "request_id": "http-key"})
+        d2 = _post(srv.url, {"prompt": [1, 2, 3], "max_tokens": 4,
+                             "request_id": "http-key"})
+        assert d1["request_id"] == d2["request_id"] == "http-key"
+        assert d1["id"] == d2["id"]  # same internal request, not a rerun
+        assert d1["output_ids"] == d2["output_ids"]
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url, {"prompt": [1], "request_id": 42})
+        assert e.value.code == 400  # non-string key is the client's bug
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.url, {"prompt": [1], "request_id": "x" * 200})
+        assert e.value.code == 400
+    finally:
+        srv.close()
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# requeue-on-crash (ISSUE 13): an engine-iteration crash is
+# output-invisible up to the crash budget
+# ---------------------------------------------------------------------------
+
+def test_crash_requeue_resumes_and_matches_oracle():
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=32, block_size=4,
+                          max_active=3, queue_depth=8)
+    real = eng._decode
+    crashes = []
+
+    def crashing(*a, **kw):
+        if not crashes:
+            crashes.append(1)
+            raise RuntimeError("simulated decode crash")
+        return real(*a, **kw)
+
+    eng._decode = crashing
+    before = telemetry.counters_snapshot().get("serving", {}).get(
+        "crash_requeues", 0)
+    eng.start()
+    try:
+        reqs = [eng.submit([i + 1, i + 2], max_new_tokens=6)
+                for i in range(2)]
+        for r in reqs:
+            assert r.wait(300), f"request {r.id} never finished"
+            assert r.error is None, r.error
+            assert r.n_generated == 6
+        after = telemetry.counters_snapshot()["serving"]["crash_requeues"]
+        assert after > before, "crash must requeue, not fail"
+        # recompute-resume is output-invisible: greedy parity holds
+        # straight through the crash episode
+        for i, r in enumerate(reqs):
+            assert r.generated == _greedy_oracle(
+                params, cfg, [i + 1, i + 2], 6)
+        assert eng.cache.n_blocks_in_use == 0
+    finally:
+        eng.close()
+
+
+def test_crash_requeue_during_drain_still_completes():
+    """A crash requeue moves a request active -> waiting BACKWARD
+    through drain()'s flow-order scan; the re-read of the wait queue
+    must keep drain honest: the requeued request completes (resumed,
+    not swept) and drain reports clean."""
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=32, block_size=4,
+                          max_active=2, queue_depth=8)
+    real = eng._decode
+    crashes = []
+
+    def crash_once_draining(*a, **kw):
+        if eng.draining and not crashes:
+            crashes.append(1)
+            raise RuntimeError("crash during drain")
+        return real(*a, **kw)
+
+    eng._decode = crash_once_draining
+    eng.start()
+    req = eng.submit([1, 2, 3], max_new_tokens=10)
+    clean = eng.drain(timeout_s=120)
+    assert crashes, "the crash never fired while draining"
+    assert clean is True
+    assert req.wait(5)
+    assert req.error is None, req.error
+    assert req.n_generated == 10
+    assert req.crash_requeues == 1
+
+
+def test_crash_requeue_budget_bounds_poisonous_request():
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=32, block_size=4,
+                          max_active=2, queue_depth=8)
+    eng._crash_requeue_max = 2
+
+    def always_crash(*a, **kw):
+        raise RuntimeError("poisoned decode")
+
+    eng._decode = always_crash
+    eng.start()
+    try:
+        r = eng.submit([1, 2, 3], max_new_tokens=4)
+        assert r.wait(60), "poisonous request must FAIL, not loop forever"
+        assert r.error is not None and "iteration failed" in r.error
+        assert r.crash_requeues == 2  # budget fully spent first
+        assert eng.cache.n_blocks_in_use == 0
+    finally:
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# drain admission race (ISSUE 13): requests hitting the window between
+# begin_drain() and the 503 path either complete or get a clean 503
+# ---------------------------------------------------------------------------
+
+def test_drain_admission_race_never_hangs():
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=64, block_size=4,
+                          max_active=4, queue_depth=32,
+                          admit_timeout_s=0.2)
+    eng.start()
+    srv = ServingHTTPServer(eng, port=0)
+    outcomes = []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def hammer(i):
+        j = 0
+        while not stop.is_set():
+            j += 1
+            try:
+                _post(srv.url, {"prompt": [i + 1, j % 16 + 1],
+                                "max_tokens": 2}, timeout=60)
+                code = 200
+            except urllib.error.HTTPError as e:
+                code = e.code
+            except (urllib.error.URLError, OSError):
+                code = -1  # listener already closed: clean refusal
+            with lock:
+                outcomes.append(code)
+
+    threads = [threading.Thread(target=hammer, args=(i,), daemon=True)
+               for i in range(6)]
+    drained = {}
+    try:
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with lock:
+                if len(outcomes) >= 6:
+                    break  # traffic is flowing; drain mid-burst
+            time.sleep(0.01)
+        drain_t = threading.Thread(
+            target=lambda: drained.setdefault(
+                "clean", srv.drain(timeout_s=60)), daemon=True)
+        drain_t.start()
+        drain_t.join(120)
+        assert not drain_t.is_alive(), "drain wedged"
+    finally:
+        stop.set()
+        for t in threads:
+            # a hung handler would park the client past the drain: the
+            # join timeout IS the no-hang assertion
+            t.join(90)
+            assert not t.is_alive(), \
+                "a client hung across the drain window"
+        srv.close()
+        eng.close()
+    assert drained.get("clean") is True
+    with lock:
+        seen = list(outcomes)
+    assert seen.count(200) >= 6, f"no traffic completed: {seen[:20]}"
+    bad = [c for c in seen if c not in (200, 503, 429, -1)]
+    assert not bad, f"non-clean statuses across the drain window: {bad}"
+
+
+# ---------------------------------------------------------------------------
+# loadgen (ISSUE 13): Retry-After honored, retried-then-ok counted
+# ---------------------------------------------------------------------------
+
+class _BackpressureOnce:
+    """Answers each distinct request_id with one 429/503 (Retry-After
+    set), then 200 — the loadgen retry contract in miniature."""
+
+    def __init__(self, code=429, retry_after="0.4"):
+        import json as _json
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+
+        outer = self
+        self.seen = {}
+        self.sleeps = []
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                doc = _json.loads(self.rfile.read(n))
+                rid = doc.get("request_id")
+                outer.seen[rid] = outer.seen.get(rid, 0) + 1
+                if outer.seen[rid] == 1:
+                    body = _json.dumps({"error": "busy"}).encode()
+                    self.send_response(code)
+                    if retry_after is not None:
+                        self.send_header("Retry-After", retry_after)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                body = _json.dumps(
+                    {"state": "done", "output_ids": [1],
+                     "n_generated": 1, "ttft_s": 0.01,
+                     "latency_s": 0.02}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_loadgen_honors_retry_after_and_counts_retried_ok():
+    from dmlc_tpu.serving import LoadGenerator
+
+    fake = _BackpressureOnce(code=429, retry_after="0.4")
+    try:
+        gen = LoadGenerator(fake.url, n_streams=2, requests_per_stream=1,
+                            prompt_len=(2, 4), max_tokens=1,
+                            retry_429_s=0.01)
+        t0 = time.monotonic()
+        summary = gen.run()
+        elapsed = time.monotonic() - t0
+        assert summary["n_requests_ok"] == 2
+        assert summary["n_requests_failed"] == 0
+        assert summary["n_requests_retried_ok"] == 2
+        assert summary["n_rejections_429"] == 2
+        # the header value (0.4s), not the 0.01s fallback, was honored
+        assert elapsed >= 0.4, f"Retry-After ignored ({elapsed:.3f}s)"
+        # every retry reused its request's idempotency key
+        assert all(n == 2 for n in fake.seen.values())
+    finally:
+        fake.close()
+
+
+def test_loadgen_retries_503_and_counts_separately():
+    from dmlc_tpu.serving import LoadGenerator
+
+    fake = _BackpressureOnce(code=503, retry_after="0.05")
+    try:
+        gen = LoadGenerator(fake.url, n_streams=1, requests_per_stream=2,
+                            prompt_len=(2, 4), max_tokens=1,
+                            retry_429_s=0.01)
+        summary = gen.run()
+        assert summary["n_requests_ok"] == 2
+        assert summary["n_requests_failed"] == 0
+        assert summary["n_requests_retried_ok"] == 2
+        assert summary["n_backoffs_503"] == 2
+        assert summary["n_rejections_429"] == 0
+    finally:
+        fake.close()
+
+
+def test_loadgen_terminal_503_fails_once_with_error_body():
+    """A 503 WITHOUT Retry-After is a terminal per-request verdict
+    (engine failure, generation timeout): no retry amplification, and
+    the server's error body survives into the failure record."""
+    from dmlc_tpu.serving import LoadGenerator
+
+    fake = _BackpressureOnce(code=503, retry_after=None)
+    try:
+        gen = LoadGenerator(fake.url, n_streams=1, requests_per_stream=1,
+                            prompt_len=(2, 4), max_tokens=1,
+                            retry_429_s=0.01)
+        summary = gen.run()
+        assert summary["n_requests_ok"] == 0
+        assert summary["n_requests_failed"] == 1
+        assert summary["n_backoffs_503"] == 0
+        assert "busy" in gen.failures[0]["error"]  # body preserved
+        # exactly ONE attempt: no fresh-generation amplification
+        assert all(n == 1 for n in fake.seen.values())
+    finally:
+        fake.close()
+
+
 def test_engine_fails_only_nonfinite_logit_request():
     """A non-finite logit row fails exactly that request with a clear
     error; the other request in the same decode batch (and the engine)
