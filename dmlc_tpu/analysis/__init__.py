@@ -23,9 +23,16 @@ each of which has eaten a real review round in this repo's history:
   * :mod:`contract_pass`     except clauses that could swallow the
                              typed exceptions that MUST propagate
                              (WorldResized/CorruptRecord/
-                             EngineDraining), sockets without timeouts,
-                             fault_point site names vs DMLC_FAULT_SPEC
-                             literals
+                             EngineDraining/AlreadyFinished), sockets
+                             without timeouts, fault_point site names
+                             vs DMLC_FAULT_SPEC literals
+  * :mod:`race_pass`         guarded-by classification: every mutable
+                             attribute of a threaded class is locked,
+                             immutable-after-init, or carries an
+                             explicit ``guarded-by``/``unguarded``
+                             annotation; mixed locked/unlocked access,
+                             divergent guards, and leaked guarded
+                             container refs are findings
 
 Run via ``scripts/dmlc_check.py`` (a ci.sh stage).  Suppress a finding
 with an inline ``# dmlc-check: disable=<check-id>[,<check-id>...]``
@@ -35,7 +42,7 @@ counted in the runner summary so they stay visible.
 
 from .core import Finding, FileContext, RepoIndex, Pass, run_passes
 from . import (concurrency_pass, contract_pass, knob_pass, metrics_pass,
-               style_pass)
+               race_pass, style_pass)
 
 ALL_PASSES = (
     style_pass.StylePass,
@@ -43,6 +50,7 @@ ALL_PASSES = (
     concurrency_pass.ConcurrencyPass,
     knob_pass.KnobPass,
     contract_pass.ContractPass,
+    race_pass.RacePass,
 )
 
 __all__ = ["ALL_PASSES", "Finding", "FileContext", "RepoIndex", "Pass",

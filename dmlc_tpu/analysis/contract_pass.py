@@ -41,9 +41,12 @@ from .core import (Finding, Pass, RepoIndex, call_name, literal_str,
                    module_str_consts)
 
 #: the typed exceptions that must propagate, and the handler types
-#: broad enough to swallow them (all three subclass DMLCError, which
-#: subclasses RuntimeError)
-PROTECTED_EXCEPTIONS = ("WorldResized", "CorruptRecord", "EngineDraining")
+#: broad enough to swallow them (all four subclass DMLCError, which
+#: subclasses RuntimeError).  AlreadyFinished joined in PR 15: the
+#: exactly-once terminal-transition signal — a broad sweep that eats
+#: it also eats cache double-free errors behind the same handler.
+PROTECTED_EXCEPTIONS = ("WorldResized", "CorruptRecord", "EngineDraining",
+                        "AlreadyFinished")
 _BROAD_TYPES = {"BaseException", "Exception", "RuntimeError", "DMLCError"}
 
 #: files whose call chains carry the protected exceptions
@@ -58,6 +61,10 @@ PROTECTED_FILES = (
     "dmlc_tpu/serving/engine.py",
     "dmlc_tpu/serving/scheduler.py",
     "dmlc_tpu/serving/server.py",
+    "dmlc_tpu/serving/router.py",
+    "dmlc_tpu/telemetry/requests.py",
+    "dmlc_tpu/telemetry/slo.py",
+    "dmlc_tpu/feed/autotune.py",
     "dmlc_tpu/resilience/selfheal.py",
     "examples/train_lm_recordio.py",
 )

@@ -29,7 +29,9 @@ from .base import DMLCError, get_env
 __all__ = ["BufferPool", "CheckedLock", "ConcurrentBlockingQueue",
            "MultiThreadedIter", "ThreadedIter", "lockcheck_assert_clean",
            "lockcheck_enabled", "lockcheck_report", "lockcheck_reset",
-           "make_lock", "make_rlock"]
+           "make_lock", "make_rlock", "racecheck_assert_clean",
+           "racecheck_enabled", "racecheck_observed", "racecheck_report",
+           "set_lock_factory_hook"]
 
 T = TypeVar("T")
 
@@ -62,11 +64,37 @@ _lc_violations: List[dict] = []
 _LC_MAX_VIOLATIONS = 256
 _lc_tls = threading.local()
 
+#: DMLC_RACECHECK=1 observation store: (file basename, with/acquire
+#: line) -> set of runtime lock names seen held at that site.  The
+#: static race pass (analysis.race_pass.guarded_region_map) knows which
+#: lock *should* guard each site's attributes; racecheck_report()
+#: cross-checks the two.
+_rc_sites: dict = {}
+
+#: deterministic-interleaving hook (analysis.interleave): when set,
+#: make_lock/make_rlock offer the construction to the explorer first,
+#: so a scenario's objects are built over scheduler-owned locks.  The
+#: hook returns a lock-like object or None (= not under exploration).
+_lock_factory_hook = None
+
+
+def set_lock_factory_hook(hook) -> None:
+    """Install/clear (None) the interleaving explorer's lock factory."""
+    global _lock_factory_hook
+    _lock_factory_hook = hook
+
 
 def lockcheck_enabled() -> bool:
     """Whether make_lock() instruments (``DMLC_LOCKCHECK``, read per
-    lock construction so tests can flip it)."""
-    return get_env("DMLC_LOCKCHECK", False)
+    lock construction so tests can flip it).  ``DMLC_RACECHECK=1``
+    implies it — the racecheck rides the same CheckedLock."""
+    return get_env("DMLC_LOCKCHECK", False) or racecheck_enabled()
+
+
+def racecheck_enabled() -> bool:
+    """Whether acquire sites record attribute→lock pairing evidence
+    (``DMLC_RACECHECK``)."""
+    return get_env("DMLC_RACECHECK", False)
 
 
 def _lc_held() -> list:
@@ -117,7 +145,8 @@ class CheckedLock:
     ``threading.Condition`` (whose wait() releases and re-acquires
     through these methods, keeping the held stack truthful)."""
 
-    __slots__ = ("name", "graph_name", "_lock", "_reentrant", "_block_s")
+    __slots__ = ("name", "graph_name", "_lock", "_reentrant", "_block_s",
+                 "_racecheck")
 
     #: instance counter: edges are recorded per INSTANCE (``name#n``),
     #: not per class-level name — two queues of the same class acquired
@@ -133,6 +162,7 @@ class CheckedLock:
         self._lock = threading.RLock() if reentrant else threading.Lock()
         self._reentrant = reentrant
         self._block_s = get_env("DMLC_LOCKCHECK_BLOCK_S", 1.0)
+        self._racecheck = racecheck_enabled()
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         held = _lc_held()
@@ -140,6 +170,8 @@ class CheckedLock:
         got = self._lock.acquire(blocking, timeout)
         if not got:
             return False
+        if self._racecheck:
+            self._rc_note(self._rc_site())
         waited = time.monotonic() - t0
         reacquire = self._reentrant and any(l is self for l in held)
         outer = [l for l in held if l is not self]
@@ -166,6 +198,43 @@ class CheckedLock:
                         locks=sorted((a, b)), site=site)
         held.append(self)
         return True
+
+    def _rc_note(self, site: str) -> None:
+        """Record this acquire's (site, lock name) pairing for the
+        DMLC_RACECHECK static/dynamic cross-check."""
+        try:
+            base, line = site.rsplit(":", 1)
+            key = (base, int(line))
+        except ValueError:
+            return
+        with _lc_graph_lock:
+            if key not in _rc_sites:
+                if len(_rc_sites) >= get_env(
+                        "DMLC_RACECHECK_MAX_SITES", 4096):
+                    return
+                _rc_sites[key] = set()
+            _rc_sites[key].add(self.name)
+
+    def _rc_site(self) -> str:
+        """The ``with self.<lock>:`` frame for the racecheck pairing.
+        Unlike :func:`_lc_site` this must NOT skip all of
+        concurrency.py — BufferPool/ThreadedIter acquire their own
+        locks here and those with-statements ARE the annotated sites —
+        only CheckedLock's own plumbing frames and threading.py."""
+        import sys
+
+        own = _CHECKEDLOCK_CODE
+        try:
+            depth = 1
+            while True:
+                f = sys._getframe(depth)
+                code = f.f_code
+                base = code.co_filename.rsplit("/", 1)[-1]
+                if base != "threading.py" and code not in own:
+                    return f"{base}:{f.f_lineno}"
+                depth += 1
+        except (ValueError, AttributeError):
+            return "?"
 
     def release(self) -> None:
         held = _lc_held()
@@ -220,12 +289,26 @@ class CheckedLock:
         return f"CheckedLock({self.name!r})"
 
 
+#: CheckedLock's own frames, skipped by the racecheck site walk
+_CHECKEDLOCK_CODE = frozenset(
+    getattr(CheckedLock, m).__code__
+    for m in ("acquire", "release", "__enter__", "__exit__",
+              "_rc_note", "_rc_site", "_release_save",
+              "_acquire_restore"))
+
+
 def make_lock(name: str):
-    """A ``threading.Lock`` — or, under ``DMLC_LOCKCHECK=1``, a
-    :class:`CheckedLock` feeding the runtime lock-order watchdog.
-    ``name`` identifies the lock in the order graph and in violation
-    reports; by convention ``Class.attr`` or ``module.attr`` (matching
-    the static pass's node naming)."""
+    """A ``threading.Lock`` — or, under ``DMLC_LOCKCHECK=1`` /
+    ``DMLC_RACECHECK=1``, a :class:`CheckedLock` feeding the runtime
+    watchdog — or, inside an interleaving-explorer scenario, the
+    explorer's scheduler-owned lock.  ``name`` identifies the lock in
+    the order graph and in violation reports; by convention
+    ``Class.attr`` or ``module.attr`` (matching the static passes'
+    node naming — the racecheck cross-check depends on it)."""
+    if _lock_factory_hook is not None:
+        lk = _lock_factory_hook(name, False)
+        if lk is not None:
+            return lk
     if lockcheck_enabled():
         return CheckedLock(name)
     return threading.Lock()
@@ -233,6 +316,10 @@ def make_lock(name: str):
 
 def make_rlock(name: str):
     """Reentrant variant of :func:`make_lock`."""
+    if _lock_factory_hook is not None:
+        lk = _lock_factory_hook(name, True)
+        if lk is not None:
+            return lk
     if lockcheck_enabled():
         return CheckedLock(name, reentrant=True)
     return threading.RLock()
@@ -245,10 +332,64 @@ def lockcheck_report() -> List[dict]:
 
 
 def lockcheck_reset() -> None:
-    """Clear the order graph and violation list (tests)."""
+    """Clear the order graph, violation list, and racecheck site
+    observations (tests)."""
     with _lc_graph_lock:
         _lc_edges.clear()
         del _lc_violations[:]
+        _rc_sites.clear()
+
+
+def racecheck_observed() -> dict:
+    """``(file basename, line) -> sorted lock names`` observed held at
+    each acquire site so far (``DMLC_RACECHECK=1`` runs)."""
+    with _lc_graph_lock:
+        return {k: sorted(v) for k, v in _rc_sites.items()}
+
+
+def racecheck_report() -> List[dict]:
+    """Cross-check the observed attribute→lock pairings against the
+    static guarded-by analysis: every executed ``with self.<lock>:``
+    site of a threaded class must have held the lock the race pass
+    says guards that region's attributes (``Class.attr`` naming).  A
+    mismatch means the static annotations and the runtime disagree —
+    a renamed lock, an aliased lock instance, or a stale annotation."""
+    observed = racecheck_observed()
+    if not observed:
+        return []
+    from .analysis.core import RepoIndex, default_paths
+    from .analysis.race_pass import guarded_region_map
+
+    index = RepoIndex(default_paths(["dmlc_tpu"]), None)
+    expected = guarded_region_map(index)
+    out: List[dict] = []
+    for key, names in sorted(observed.items()):
+        exp = expected.get(key)
+        if exp is None:
+            continue  # module-level lock, or an ambiguous basename
+        for name in names:
+            if name != exp:
+                out.append({
+                    "kind": "attr-lock-mismatch",
+                    "site": f"{key[0]}:{key[1]}",
+                    "expected": exp, "observed": name,
+                    "detail": f"acquire at {key[0]}:{key[1]} held lock "
+                              f"{name!r} but the static guarded-by "
+                              f"analysis expects {exp!r} to protect "
+                              f"that region's attributes"})
+    return out
+
+
+def racecheck_assert_clean() -> None:
+    """Raise :class:`DMLCError` on any static/dynamic guarded-by
+    mismatch — the smoke-test exit gate next to
+    :func:`lockcheck_assert_clean`."""
+    bad = racecheck_report()
+    if bad:
+        lines = "; ".join(v["detail"] for v in bad[:8])
+        raise DMLCError(
+            f"racecheck recorded {len(bad)} attribute→lock "
+            f"mismatch(es): {lines}")
 
 
 def lockcheck_assert_clean() -> None:
@@ -587,8 +728,11 @@ class MultiThreadedIter(Generic[T]):
         self._n = num_threads
         self._out: ConcurrentBlockingQueue = ConcurrentBlockingQueue(max_capacity)
         self._src_lock = make_lock("MultiThreadedIter._src_lock")
+        # dmlc-check: unguarded(consumer-confined: next() is single-consumer)
         self._sentinels_seen = 0
+        # dmlc-check: unguarded(consumer-confined: next() is single-consumer)
         self._ended = False
+        # dmlc-check: unguarded(written before the sentinel push; read after the last sentinel pops)
         self._worker_exc: Optional[BaseException] = None
         self._threads = [
             threading.Thread(target=self._worker, daemon=True) for _ in range(num_threads)

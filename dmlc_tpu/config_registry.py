@@ -347,6 +347,14 @@ KNOBS: Tuple[Knob, ...] = (
        "lockcheck: an acquire blocking longer than this while the "
        "thread holds another lock is flagged held-while-blocked",
        ship=True, group="lockcheck"),
+    _k("DMLC_RACECHECK", bool, False,
+       "1 = lockcheck plus attribute->lock pairing capture: every "
+       "CheckedLock acquire site is recorded and cross-checked against "
+       "the static guarded-by analysis (analysis.race_pass)",
+       ship=True, group="lockcheck"),
+    _k("DMLC_RACECHECK_MAX_SITES", int, 4096,
+       "racecheck: bound on distinct acquire sites recorded (memory "
+       "guard for very long runs)", ship=True, group="lockcheck"),
 
     # ---- kernels -------------------------------------------------------
     _k("DMLC_FLASH_BH_BLOCK", int, 0,

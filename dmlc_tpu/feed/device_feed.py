@@ -207,6 +207,7 @@ class DeviceFeed:
             n_parts *= cfg.axis_size(a)
         self._n_parts = n_parts
         self._source_builder = source_builder
+        # dmlc-check: unguarded(consumer-thread epoch/resize state; close() joins first)
         self._world = self._check_world(world) if world is not None \
             else (0, 1)
         if part_sources is None:
@@ -215,14 +216,19 @@ class DeviceFeed:
             part_sources = self._build_sources()
         check(len(part_sources) == n_parts,
               f"need {n_parts} partition sources, got {len(part_sources)}")
+        # dmlc-check: unguarded(consumer-thread epoch/resize state; close() joins first)
         self._multi_epoch = all(callable(s) for s in part_sources)
+        # dmlc-check: unguarded(consumer-thread epoch/resize state; close() joins first)
         self._sources = part_sources
+        # dmlc-check: unguarded(consumer-thread epoch state)
         self._epochs_started = 0
         self.sharding = jax.sharding.NamedSharding(
             mesh, jax.sharding.PartitionSpec(axes)
         )
+        # dmlc-check: unguarded(autotuned between epochs before Thread.start publishes)
         self._depth = (queue_depth if queue_depth is not None
                        else max(1, get_env("DMLC_FEED_DEPTH", 2)))
+        # dmlc-check: unguarded(autotuned between epochs before Thread.start publishes)
         self._workers = max(1, min(n_parts, num_workers
                             or get_env("DMLC_FEED_WORKERS",
                                        min(4, os.cpu_count() or 2))))
@@ -253,26 +259,44 @@ class DeviceFeed:
                 max_workers=max(1, min(n_parts, wmax)),
                 max_depth=max(self._depth,
                               get_env("DMLC_FEED_DEPTH_MAX", 4)))
+            # dmlc-check: unguarded(consumer-thread epoch-boundary cursor)
             self._ledger_seen_seq = 0
+        # dmlc-check: unguarded(thread-safe Queue; rebound between epochs pre-start)
         self._queue: Queue = Queue(maxsize=self._depth)
+        # dmlc-check: unguarded(rebuilt between epochs; each iterator read by its one owning worker)
         self.part_iters: list = []
+        # dmlc-check: unguarded(per-cell owner-worker reads; mutated under _cv)
         self._part_done = [False] * n_parts
+        # dmlc-check: unguarded(mutation under _cv; epoch reset pre-start)
         self._n_dead = 0
+        # dmlc-check: unguarded(write-once under _cv; read only after _checkin_slot saw it locked)
         self._template: Optional[Dict[str, np.ndarray]] = None
+        # dmlc-check: unguarded(thread-safe BufferPool; rebound between epochs pre-start)
         self._pool: Optional[BufferPool] = None
+        # dmlc-check: unguarded(accesses under _cv; rebound between epochs pre-start)
         self._pending: Dict[int, _Slot] = {}
         self._cv = threading.Condition(make_rlock("DeviceFeed._cv"))
+        # dmlc-check: unguarded(under _cv; cancel polls are stale-tolerant)
         self._error: Optional[BaseException] = None
+        # dmlc-check: unguarded(set/read under _cv; epoch reset pre-start)
         self._empty_epoch = False
+        # dmlc-check: unguarded(consumer-thread lifecycle; joined before rebinding)
         self._thread: Optional[threading.Thread] = None  # placer
+        # dmlc-check: unguarded(consumer-thread lifecycle; joined before rebinding)
         self._parsers: List[threading.Thread] = []
         self._stop = threading.Event()
+        # dmlc-check: unguarded(placer-thread-confined cache)
         self._shard_maps: Dict[str, list] = {}
+        # dmlc-check: unguarded(placer-thread-confined cache)
         self._zero_shards: Dict[tuple, object] = {}
+        # dmlc-check: unguarded(placer-thread-confined lazy probe)
         self._host_aliasing: Optional[bool] = None
         self._log_every = log_every_mb << 20
+        # dmlc-check: unguarded(placer-thread writes; bytes_fed is a stale-tolerant monitor read)
         self._bytes = 0
+        # dmlc-check: unguarded(placer-thread-confined)
         self._last_log = 0
+        # dmlc-check: unguarded(placer-thread-confined)
         self._t0 = None
 
     # ---- parser workers ------------------------------------------------

@@ -129,7 +129,8 @@ class BufferPool:
 
     @property
     def held_bytes(self) -> int:
-        return self._held
+        with self._lock:
+            return self._held
 
 
 class ThreadLocalPool:

@@ -80,7 +80,9 @@ class CollectiveFuture:
 
     def __init__(self):
         self._ev = threading.Event()
+        # dmlc-check: unguarded(written before _ev.set(); read after wait())
         self._res = None
+        # dmlc-check: unguarded(written before _ev.set(); read after wait())
         self._exc: Optional[BaseException] = None
 
     def set_result(self, res) -> None:
@@ -184,6 +186,7 @@ class GradientBucketer:
         nbytes = bucket_bytes_ or bucket_bytes()
         self._bucket_elems = max(1, nbytes // self._dtype.itemsize)
         self._worker = _CollectiveThread()
+        # dmlc-check: unguarded(best-effort early-stop flag; the join is authoritative)
         self._failed: Optional[BaseException] = None
         self._timings: List[Tuple[int, float]] = []
         self._tlock = make_lock("GradientBucketer._tlock")

@@ -87,7 +87,7 @@ class _DedupeTable:
 
     def __init__(self, capacity: int):
         self.capacity = max(1, int(capacity))
-        self._lock = make_lock("InferenceEngine._dedupe_lock")
+        self._lock = make_lock("_DedupeTable._lock")
         self._live: dict = {}
         self._done: dict = {}
         self._order: "deque" = deque()
@@ -198,8 +198,17 @@ class InferenceEngine:
         self._prefill, self._decode = _jitted_programs()
         self._stop = threading.Event()
         self._draining = threading.Event()
-        self._stepping = False  # an iteration is mid-flight (see drain)
+        # iteration seqlock: odd = an engine iteration is mid-flight
+        # (its pop window can hold a request in NEITHER queue), even =
+        # quiescent.  Single writer (the engine thread); drain()'s scan
+        # reads it around an atomic scheduler.counts() snapshot and
+        # retries on any change, so a request in transit can never be
+        # mistaken for drained — see drain() for the proof sketch
+        # dmlc-check: unguarded(seqlock: single-writer engine thread; GIL-atomic int reads)
+        self._step_seq = 0
+        # dmlc-check: unguarded(start/close control-thread lifecycle; close joins before the sweep)
         self._thread: Optional[threading.Thread] = None
+        # dmlc-check: unguarded(engine-thread-confined)
         self._flops_declared = False
 
     # ---- client surface -------------------------------------------------
@@ -332,27 +341,30 @@ class InferenceEngine:
              else get_env("DMLC_SERVE_DRAIN_S", 30.0))
         self.begin_drain()
         deadline = time.monotonic() + t
-        # a request usually transits waiting -> stepping (popped,
-        # mid-prefill) -> active, and submits are already refused.
-        # Reading the stages in FLOW ORDER (waiting first, active
-        # last) guarantees at least one read sees any forward-moving
-        # request: whatever stage it occupied at the first read, by
-        # the time later reads happen it can only be in a stage not
-        # yet read.  But two paths move BACKWARD (active -> waiting):
-        # self-preemption and crash requeue — a request that made that
-        # move entirely between the waiting read and the active read
-        # would be invisible to all three.  Both backward moves land
-        # the request in the wait queue atomically, so re-reading
-        # n_waiting LAST closes the gap: "all four false" truly means
-        # drained, and close() can never sweep a recoverable
-        # generation.
-        while (self.scheduler.n_waiting or self._stepping
-               or self.scheduler.n_active or self.scheduler.n_waiting):
+        # "Drained" must be judged against a CONSISTENT cut.  Queue
+        # membership comes from scheduler.counts() — one lock hold, so
+        # the two backward movers (self-preemption, crash requeue) can
+        # never hide a request between separate waiting/active reads
+        # (the original PR 13 bug).  A request in the POP WINDOW
+        # (popped by next_prefill, not yet activated) is in neither
+        # queue; the step seqlock covers it: the window runs strictly
+        # inside one step()'s odd interval, so either a seq read is
+        # odd or the two reads differ — both retry.  (The interleaving
+        # explorer found the flag-based predecessor of this scan being
+        # fooled by a requeue-then-resume cycle mid-pass: a boolean
+        # "stepping" can flip False->True->False between reads;
+        # a counter cannot revisit a value.)
+        while True:
+            s1 = self._step_seq
+            active, waiting = self.scheduler.counts()
+            s2 = self._step_seq
+            if (not active and not waiting and s1 == s2
+                    and s1 % 2 == 0):
+                break
             if time.monotonic() > deadline:
                 logger.warning(
                     "drain deadline (%.1fs) hit with %d active / %d "
-                    "waiting; failing the rest", t,
-                    self.scheduler.n_active, self.scheduler.n_waiting)
+                    "waiting; failing the rest", t, active, waiting)
                 self.close()
                 telemetry.record_event("serving_drain_end", clean=False)
                 return False
@@ -428,7 +440,7 @@ class InferenceEngine:
         one decode token for every active request.  Returns whether any
         work happened (the loop's idle signal).  Public so tests can
         single-step the engine deterministically."""
-        self._stepping = True
+        self._step_seq += 1
         try:
             did = False
             req = self.scheduler.next_prefill()
@@ -441,7 +453,7 @@ class InferenceEngine:
                 did = True
             return did
         finally:
-            self._stepping = False
+            self._step_seq += 1
 
     def _finish(self, req: Request, error: Optional[str] = None,
                 reason: Optional[str] = None) -> None:
@@ -636,9 +648,10 @@ class InferenceEngine:
 
     # ---- observability --------------------------------------------------
     def stats(self) -> dict:
+        active, waiting = self.scheduler.counts()
         return {
-            "active": self.scheduler.n_active,
-            "waiting": self.scheduler.n_waiting,
+            "active": active,
+            "waiting": waiting,
             "max_active": self.max_active,
             "draining": self.draining,
             "kv": self.cache.stats(),

@@ -150,7 +150,9 @@ class PagedKVCache:
         self.mesh = mesh
         shape = (self.n_layers, self.n_blocks, self.block_size,
                  self.n_heads, self.head_dim)
+        # dmlc-check: unguarded(data plane is single-step-thread by contract — class docstring)
         self.k_pool = np.zeros(shape, dtype)
+        # dmlc-check: unguarded(data plane is single-step-thread by contract — class docstring)
         self.v_pool = np.zeros(shape, dtype)
         self._alloc = BlockAllocator(self.n_blocks)
         self._seqs: Dict[int, _SeqEntry] = {}

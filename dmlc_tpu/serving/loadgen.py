@@ -160,11 +160,20 @@ class LoadGenerator:
         return self.summary(wall)
 
     def summary(self, wall_s: float) -> Dict:
-        ttfts = [r["ttft_s"] for r in self.results
+        # one consistent snapshot: summary() may race live streams (a
+        # caller polling mid-run), so every counter and both result
+        # lists are copied under the same lock hold the streams use
+        with self._lock:
+            results = list(self.results)
+            failures = list(self.failures)
+            retried_ok = self.retried_ok
+            rejections = self.rejections
+            backoffs_503 = self.backoffs_503
+        ttfts = [r["ttft_s"] for r in results
                  if r.get("ttft_s") is not None]
-        tps = [r["decode_tokens_per_s"] for r in self.results
+        tps = [r["decode_tokens_per_s"] for r in results
                if r.get("decode_tokens_per_s")]
-        gen = sum(r.get("n_generated", 0) for r in self.results)
+        gen = sum(r.get("n_generated", 0) for r in results)
         # client-vs-server corroboration: the client clock covers HTTP
         # transport + handler queueing AROUND the server-side request
         # lifetime, so per request (client latency - server latency)
@@ -172,18 +181,18 @@ class LoadGenerator:
         # timing paths disagree about what a request is, and a large
         # one means the HTTP edge (not the engine) is the bottleneck
         deltas = [r["client_latency_s"] - r["latency_s"]
-                  for r in self.results
+                  for r in results
                   if r.get("latency_s") is not None
                   and r.get("client_latency_s") is not None]
         out = {
             "n_streams": self.n_streams,
-            "n_requests_ok": len(self.results),
-            "n_requests_failed": len(self.failures),
+            "n_requests_ok": len(results),
+            "n_requests_failed": len(failures),
             # retried-then-succeeded ≠ failed: a request that rode out
             # backpressure/drain on retries still completed
-            "n_requests_retried_ok": self.retried_ok,
-            "n_rejections_429": self.rejections,
-            "n_backoffs_503": self.backoffs_503,
+            "n_requests_retried_ok": retried_ok,
+            "n_rejections_429": rejections,
+            "n_backoffs_503": backoffs_503,
             "wall_s": wall_s,
             "total_generated_tokens": gen,
             "aggregate_tokens_per_s": gen / max(wall_s, 1e-9),
@@ -191,10 +200,10 @@ class LoadGenerator:
             "p99_ttft_s": percentile(ttfts, 99),
             "tokens_per_s_per_user": (sum(tps) / len(tps)) if tps else None,
             "p50_latency_s": percentile(
-                [r["latency_s"] for r in self.results
+                [r["latency_s"] for r in results
                  if r.get("latency_s") is not None], 50),
             "preemptions": sum(r.get("preemptions", 0)
-                               for r in self.results),
+                               for r in results),
             "client_server_delta_p50_s": percentile(deltas, 50),
             "client_server_delta_p99_s": percentile(deltas, 99),
         }

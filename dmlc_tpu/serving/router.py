@@ -775,6 +775,7 @@ class RouterHTTPServer:
         self.host = host
         self.port = self._httpd.server_address[1]
         self.router = router
+        # dmlc-check: unguarded(owner-thread close() latch; double shutdown is benign)
         self._closed = False
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,

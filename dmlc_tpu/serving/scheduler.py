@@ -78,10 +78,17 @@ class Request:
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
         self.submit_t = time.monotonic()
+        # state/error/finish_t transition under the owning scheduler's
+        # lock (a cross-object guard the race pass cannot see); the
+        # terminal transition publishes them before the _done Event is
+        # set, and readers (result(), duplicate waiters) wait() first
+        # dmlc-check: unguarded(scheduler-lock guarded; terminal write fenced by _done)
         self.state = WAITING
         self.generated: List[int] = []
         self.ttft_s: Optional[float] = None
+        # dmlc-check: unguarded(scheduler-lock guarded; terminal write fenced by _done)
         self.finish_t: Optional[float] = None
+        # dmlc-check: unguarded(scheduler-lock guarded; terminal write fenced by _done)
         self.error: Optional[str] = None
         self.preemptions = 0
         self.crash_requeues = 0  # engine-iteration crashes survived
@@ -188,6 +195,13 @@ class ContinuousBatchScheduler:
     def active_requests(self) -> List[Request]:
         with self._lock:
             return list(self._active)
+
+    def counts(self) -> tuple:
+        """``(n_active, n_waiting)`` under ONE lock hold: composed
+        views (``/healthz``, the router's load signal) get a consistent
+        pair instead of two reads an iteration can interleave."""
+        with self._lock:
+            return len(self._active), len(self._waiting)
 
     # ---- admission ------------------------------------------------------
     def enqueue(self, req: Request) -> None:

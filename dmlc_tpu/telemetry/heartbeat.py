@@ -490,10 +490,17 @@ class HeartbeatSender:
         self.ship_trace = bool(ship_trace)
         self.max_beat_bytes = get_env(
             "DMLC_TELEMETRY_MAX_BEAT_BYTES", 256 << 10)
+        # the three ship cursors are beat-thread-confined: send_once
+        # runs on the beat thread, and close()'s final flush only runs
+        # after joining it
+        # dmlc-check: unguarded(beat-thread-confined; close() flushes only after join)
         self._last_seq = 0
+        # dmlc-check: unguarded(beat-thread-confined; close() flushes only after join)
         self._last_step_seq = 0
+        # dmlc-check: unguarded(beat-thread-confined; close() flushes only after join)
         self._clock: Optional[Tuple[float, float]] = None  # (offset, rtt)
         self._stop = threading.Event()
+        # dmlc-check: unguarded(start/close control-thread lifecycle)
         self._thread: Optional[threading.Thread] = None
         from . import postmortem
 

@@ -173,7 +173,9 @@ class SLOMonitor:
     def maybe_evaluate(self, now: Optional[float] = None) -> None:
         """Throttled evaluate — the engine calls this per iteration."""
         now = time.monotonic() if now is None else now
-        if now - self._last_eval >= self.min_eval_interval_s:
+        with self._lock:
+            due = now - self._last_eval >= self.min_eval_interval_s
+        if due:
             self.evaluate(now)
 
     def evaluate(self, now: Optional[float] = None) -> Dict[str, Dict]:

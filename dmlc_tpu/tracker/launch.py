@@ -68,7 +68,8 @@ PASS_ENVS = [
     "DMLC_TELEMETRY_MAX_EVENTS", "DMLC_TELEMETRY_SHIP_TRACE",
     "DMLC_TELEMETRY_MAX_BEAT_BYTES", "DMLC_POSTMORTEM_DIR",
     "DMLC_STEP_LEDGER_MAX", "DMLC_PEAK_FLOPS", "DMLC_LOCKCHECK",
-    "DMLC_LOCKCHECK_BLOCK_S", "DMLC_FLASH_BH_BLOCK",
+    "DMLC_LOCKCHECK_BLOCK_S", "DMLC_RACECHECK",
+    "DMLC_RACECHECK_MAX_SITES", "DMLC_FLASH_BH_BLOCK",
     "DMLC_FLASH_BLOCK_Q", "DMLC_FLASH_BLOCK_K",
     "DMLC_FLASH_BWD_BLOCK_Q", "DMLC_FLASH_BWD_BLOCK_K",
 ]

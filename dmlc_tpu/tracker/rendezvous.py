@@ -261,8 +261,11 @@ class RabitTracker:
         self.sock = sock
         self.host_ip = host_ip
         self.n_workers = n_workers
+        # dmlc-check: unguarded(start/join control-thread lifecycle)
         self.thread: Optional[threading.Thread] = None
+        # dmlc-check: unguarded(accept-loop writes; logged after join)
         self.start_time: Optional[float] = None
+        # dmlc-check: unguarded(accept-loop writes; logged after join)
         self.end_time: Optional[float] = None
         if miss_window_s is None:
             miss_window_s = get_env("DMLC_TRACKER_MISS_WINDOW_S", 0.0)
@@ -273,26 +276,39 @@ class RabitTracker:
         if elastic_grace_s is None:
             elastic_grace_s = get_env("DMLC_ELASTIC_GRACE_S", 5.0)
         self.elastic_grace_s = elastic_grace_s
+        # dmlc-check: unguarded(accept-loop-owned; cross-thread int reads are stale-tolerant)
         self.gen = 0
         self._resize_lock = make_lock("RabitTracker._resize_lock")
         self._resize_req: Optional[Dict] = None
+        # dmlc-check: unguarded(accept-loop-confined — class docstring)
         self._rank_maps: Dict[int, Dict[int, int]] = {}  # gen -> old->new
         self._dead_since: Dict[int, float] = {}          # rank -> monotonic
         self._evicted_total = 0
         # accept-loop world state (mutated only on the accept thread)
+        # dmlc-check: unguarded(accept-loop-owned; cross-thread int reads are stale-tolerant)
         self._world = n_workers
+        # dmlc-check: unguarded(accept-loop-confined — class docstring)
         self._tree_map = None
+        # dmlc-check: unguarded(accept-loop-confined — class docstring)
         self._parent_map = None
+        # dmlc-check: unguarded(accept-loop-confined — class docstring)
         self._ring_map = None
+        # dmlc-check: unguarded(accept-loop-confined — class docstring)
         self._job_map: Dict[str, int] = {}
+        # dmlc-check: unguarded(accept-loop-confined — class docstring)
         self._todo: List[int] = []
+        # dmlc-check: unguarded(accept-loop-confined — class docstring)
         self._pending: List["WorkerEntry"] = []
+        # dmlc-check: unguarded(accept-loop-confined — class docstring)
         self._shutdown: Dict[int, "WorkerEntry"] = {}
         self.dead_ranks: set = set()
         self._finished_ranks: set = set()  # clean shutdowns: never "dead"
         self._dead_lock = make_lock("RabitTracker._dead_lock")
+        # dmlc-check: unguarded(accept-loop-confined — class docstring)
         self._entries: Dict[int, "WorkerEntry"] = {}
+        # dmlc-check: unguarded(accept-loop-confined — class docstring)
         self._registry: Optional[AcceptRegistry] = None
+        # dmlc-check: unguarded(start/close control-thread lifecycle)
         self._monitor: Optional[threading.Thread] = None
         self._monitor_stop = threading.Event()
         from ..telemetry import (FlightRecorder, TelemetryAggregator,
@@ -308,9 +324,7 @@ class RabitTracker:
         self.telemetry.extra_health = lambda: {
             "dead_ranks": self._dead_snapshot(),
             "clock_offsets": self._clock_snapshot(),
-            "elastic": {"enabled": self.elastic, "gen": self.gen,
-                        "world": self._world,
-                        "evicted_total": self._evicted_total}}
+            "elastic": self._elastic_snapshot()}
         # flight recorder: workers ship span rings incrementally with
         # their heartbeats; /trace serves the clock-corrected merge,
         # with the tracker's own spans riding along as the reference row
@@ -321,6 +335,7 @@ class RabitTracker:
         self.watchdog = Watchdog(log=logger)
         self.telemetry.extra_text = self.watchdog.prometheus_text
         self.flight.marker_source = self.watchdog.trace_markers
+        # dmlc-check: unguarded(built pre-start; closed by the control thread)
         self.metrics_server = None
         self.metrics_port: Optional[int] = None
         if metrics_port is None:
@@ -811,6 +826,16 @@ class RabitTracker:
                 self.start_time = time.time()
 
     # ---- heartbeat-driven failure detection ----------------------------
+    def _elastic_snapshot(self) -> Dict:
+        """The /healthz elastic block.  ``_evicted_total`` is mutated
+        under ``_dead_lock`` so the read takes it too; ``gen``/``_world``
+        are accept-loop-owned ints whose stale snapshot a health view
+        tolerates (see their declarations)."""
+        with self._dead_lock:
+            evicted = self._evicted_total
+        return {"enabled": self.elastic, "gen": self.gen,
+                "world": self._world, "evicted_total": evicted}
+
     def _dead_snapshot(self) -> List[int]:
         with self._dead_lock:  # the monitor mutates the set concurrently
             return sorted(self.dead_ranks)
@@ -899,6 +924,7 @@ class RabitTracker:
 
     def start(self, n_workers: Optional[int] = None) -> None:
         n = self.n_workers if n_workers is None else n_workers
+        # dmlc-check: unguarded(written before thread exit; join() reads after)
         self.error: Optional[BaseException] = None
 
         def run():
@@ -960,9 +986,12 @@ class PSTracker:
                  port: int = 9091, port_end: int = 9999):
         self.host_ip = host_ip
         self.cmd = cmd
+        # dmlc-check: unguarded(start/join control-thread lifecycle)
         self.thread = None
         self.proc: Optional[subprocess.Popen] = None
+        # dmlc-check: unguarded(written before the watcher thread exits; join() reads after it)
         self.error: Optional[BaseException] = None
+        # dmlc-check: unguarded(control-thread terminate latch; watcher read race is benign)
         self._terminated = False
         self.port = free_port(host_ip)
         if cmd is None:

@@ -16,9 +16,14 @@
 #      native surface with nontrivial object lifecycle
 #   5.5 UBSan build+run of the collective ABI (same skip pattern):
 #      all three sanitizers now cover the C sources
+#   5.7 interleave smoke: the deterministic interleaving explorer runs
+#      the known-hairy-machine scenarios under seeded bounded
+#      schedules, and must both catch the reverted PR 13 drain race
+#      deterministically and hold every invariant on the current tree
 #   6. telemetry smoke: 2-worker local rendezvous pushing heartbeats
-#      (workers run under DMLC_LOCKCHECK=1 — the runtime lock-order
-#      watchdog — and assert a clean report before exiting)
+#      (workers run under DMLC_LOCKCHECK=1 + DMLC_RACECHECK=1 — the
+#      runtime lock-order watchdog plus the attribute→lock pairing
+#      cross-check — and assert clean reports before exiting)
 #      while driving the step ledger with rank 1 fault-injected slow;
 #      the anomaly watchdog must flag exactly that rank as a straggler
 #      on /anomalies (no false positive on rank 0), dmlc-top renders a
@@ -91,10 +96,14 @@ echo "== stage 0.5: dmlc-check gate (static-analysis suite) =="
 # style + metrics (the absorbed lint.py) + concurrency (blocking-under-
 # lock, lock-graph cycles, non-daemon threads) + knobs (config_registry
 # coverage, raw-env ban, PASS_ENVS + README knob table) + contracts
-# (swallowed WorldResized/CorruptRecord/EngineDraining, timeout-less
-# sockets, typo'd DMLC_FAULT_SPEC sites); zero findings = pass,
-# suppressions are inline-commented and counted in the summary
-python scripts/dmlc_check.py || { echo "FAIL: dmlc-check findings"; exit 1; }
+# (swallowed WorldResized/CorruptRecord/EngineDraining/AlreadyFinished,
+# timeout-less sockets, typo'd DMLC_FAULT_SPEC sites) + races (guarded-
+# by classification of every threaded class's mutable state); zero
+# findings = pass, suppressions/annotations are inline and counted.
+# --budget-s pins the full-sweep runtime so the suite cannot drift off
+# the inner loop (incremental runs: scripts/dmlc_check.py --changed)
+python scripts/dmlc_check.py --budget-s 60 \
+    || { echo "FAIL: dmlc-check findings (or budget blown)"; exit 1; }
 
 echo "== stage 1: native build =="
 NATIVE_OK=0
@@ -240,6 +249,16 @@ if command -v g++ >/dev/null 2>&1 && command -v gcc >/dev/null 2>&1; then
         echo "ubsan runtime unavailable; skipping"
     fi
 fi
+
+echo "== stage 5.7: interleave smoke (deterministic schedule explorer) =="
+# the guarded-by race pass's dynamic sibling: the known-hairy threaded
+# machines (engine drain vs crash-requeue, router circuit sweep,
+# BufferPool kill-wake, bucketer join-with-error, dedupe admission)
+# each run under 400 seeded schedules (bounded DFS + biased random
+# walks); the reverted PR 13 drain bug must be caught AND replay
+# deterministically, the current tree must hold every invariant
+timeout -k 10 180 env JAX_PLATFORMS=cpu python scripts/interleave_smoke.py \
+    || { echo "FAIL: interleave smoke"; exit 1; }
 
 echo "== stage 6: telemetry smoke (rendezvous heartbeats + /metrics) =="
 timeout -k 10 180 python scripts/telemetry_smoke.py \

@@ -12,14 +12,25 @@ under ``dmlc_tpu/analysis/``:
   knobs        every DMLC_* env read resolves against
                dmlc_tpu/config_registry.py; raw os.environ reads are
                banned in dmlc_tpu/; PASS_ENVS + README table complete
-  contracts    swallowed WorldResized/CorruptRecord/EngineDraining,
-               sockets without timeouts, typo'd DMLC_FAULT_SPEC sites
+  contracts    swallowed WorldResized/CorruptRecord/EngineDraining/
+               AlreadyFinished, sockets without timeouts, typo'd
+               DMLC_FAULT_SPEC sites
+  races        guarded-by classification of threaded-class state:
+               mixed locked/unlocked access, divergent guards, leaked
+               guarded container refs, annotation hygiene
 
 Usage:
   python scripts/dmlc_check.py [paths...]         # all passes
   python scripts/dmlc_check.py --passes knobs,contracts
+  python scripts/dmlc_check.py --changed          # git-diff-scoped run
   python scripts/dmlc_check.py --list             # show passes/checks
   python scripts/dmlc_check.py --write-knob-table # regenerate README
+
+``--changed`` restricts the index to files touched vs HEAD (staged,
+unstaged, and untracked) — the inner-loop mode.  Cross-file invariants
+that need files outside the diff (PASS_ENVS completeness, the
+repo-wide lock graph) are checked only as far as the partial index
+reaches; CI runs the full sweep.
 
 Suppress one finding with an inline comment on (or directly above) the
 offending line::
@@ -32,13 +43,16 @@ clean, 1 with findings.
 
 import argparse
 import os
+import subprocess
 import sys
+import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from dmlc_tpu.analysis import ALL_PASSES, run_passes  # noqa: E402
-from dmlc_tpu.analysis.core import RepoIndex, default_paths  # noqa: E402
+from dmlc_tpu.analysis.core import (RepoIndex, _py_shebang,  # noqa: E402
+                                    default_paths)
 
 DEFAULT_ROOTS = ["dmlc_tpu", "tests", "scripts", "examples", "bench.py",
                  "__graft_entry__.py", "bin"]
@@ -64,6 +78,40 @@ def write_knob_table() -> int:
     return 0
 
 
+def changed_paths() -> list:
+    """Repo files touched vs HEAD (staged + unstaged + untracked),
+    filtered to the default check surface."""
+    out = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            text = subprocess.run(
+                cmd, cwd=REPO, capture_output=True, text=True,
+                timeout=30, check=True).stdout
+        except (OSError, subprocess.SubprocessError) as e:
+            print(f"--changed: {' '.join(cmd)} failed ({e}); "
+                  f"falling back to the full sweep", file=sys.stderr)
+            return None
+        out.update(line.strip() for line in text.splitlines()
+                   if line.strip())
+    roots = tuple(r.rstrip("/") for r in DEFAULT_ROOTS)
+    keep = []
+    for rel in sorted(out):
+        if not any(rel == r or rel.startswith(r + "/") for r in roots):
+            continue
+        full = os.path.join(REPO, rel)
+        # same admission rule as the full sweep's directory walk:
+        # .py files and extensionless python-shebang executables only
+        # (a changed ci.sh / JSON / Markdown file is not Python and
+        # must not be parsed as it)
+        if not os.path.isfile(full):
+            continue
+        if rel.endswith(".py") or (not os.path.splitext(rel)[1]
+                                   and _py_shebang(full)):
+            keep.append(rel)
+    return keep
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="dmlc_check.py",
@@ -72,6 +120,15 @@ def main(argv=None) -> int:
                     "(default: the whole repo surface)")
     ap.add_argument("--passes", default=None,
                     help="comma-separated subset of pass names")
+    ap.add_argument("--changed", action="store_true",
+                    help="check only files changed vs git HEAD "
+                         "(incl. staged + untracked); exits 0 when "
+                         "nothing relevant changed")
+    ap.add_argument("--budget-s", type=float, default=None,
+                    help="fail (exit 3) when the run exceeds this "
+                         "many seconds — the CI smoke pins the "
+                         "suite's runtime so it stays on the inner "
+                         "loop")
     ap.add_argument("--list", action="store_true",
                     help="list passes and their check ids")
     ap.add_argument("--write-knob-table", action="store_true",
@@ -95,7 +152,21 @@ def main(argv=None) -> int:
             return 2
         passes = [p for p in passes if p.name in wanted]
 
-    paths = default_paths(args.paths or DEFAULT_ROOTS, REPO)
+    t0 = time.monotonic()
+    roots = args.paths or DEFAULT_ROOTS
+    if args.changed:
+        if args.paths:
+            print("--changed and explicit paths are exclusive",
+                  file=sys.stderr)
+            return 2
+        roots = changed_paths()
+        if roots is None:
+            roots = DEFAULT_ROOTS  # git unavailable: full sweep
+        elif not roots:
+            print("dmlc-check: no relevant files changed vs HEAD",
+                  file=sys.stderr)
+            return 0
+    paths = default_paths(roots, REPO)
     index = RepoIndex(paths, REPO)
     findings, suppressed = run_passes(index, passes)
     for f in findings:
@@ -104,11 +175,20 @@ def main(argv=None) -> int:
     for s in suppressed:
         by_check[s.check] = by_check.get(s.check, 0) + 1
     supp = ", ".join(f"{k}={v}" for k, v in sorted(by_check.items()))
+    elapsed = time.monotonic() - t0
     print(f"dmlc-check: {len(index.files)} files, "
           f"{len(passes)} passes, {len(findings)} findings, "
           f"{len(suppressed)} suppressed"
-          + (f" ({supp})" if supp else ""), file=sys.stderr)
-    return 1 if findings else 0
+          + (f" ({supp})" if supp else "")
+          + f" in {elapsed:.1f}s", file=sys.stderr)
+    if findings:
+        return 1
+    if args.budget_s is not None and elapsed > args.budget_s:
+        print(f"dmlc-check: runtime {elapsed:.1f}s exceeded the "
+              f"--budget-s {args.budget_s:g}s ceiling — the suite "
+              f"drifted off the inner loop", file=sys.stderr)
+        return 3
+    return 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Interleaving smoke: the deterministic schedule explorer, end to end.
+
+Three layers, all seeded and wall-time bounded:
+
+  1. **Teeth check** — the explorer must DETECT a planted race: the
+     pre-PR-13 ``InferenceEngine.drain`` scan (kept verbatim as
+     ``analysis.scenarios.drain_pre_pr13``) is known to conclude
+     "drained" while a crash-requeued request is recoverable.  The
+     explorer has to find a violating schedule within the budget and
+     the failure has to REPLAY deterministically from its recorded
+     decision list.  A green pass here proves schedule exploration
+     actually explores.
+  2. **Current-tree scenarios** — every registered scenario
+     (scheduler drain, router sweep, BufferPool kill-wake, bucketer
+     join-with-error, dedupe admission) must hold its invariant over
+     the full schedule budget on HEAD.
+  3. **Budget** — the whole smoke must finish inside
+     ``INTERLEAVE_BUDGET_S`` so the stage stays on the inner loop;
+     scenario exploration is millisecond-scale by construction (no
+     real sleeps — timed waits are schedulable transitions).
+
+Exit 0 on success, 1 with the failing scenario's decision trace.
+"""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from dmlc_tpu.analysis import scenarios as sc  # noqa: E402
+from dmlc_tpu.analysis.interleave import explore, replay  # noqa: E402
+
+SCHEDULES = 400
+SEED = 0
+INTERLEAVE_BUDGET_S = 120.0
+
+
+def fail(msg: str) -> None:
+    print(f"interleave smoke FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+def main() -> None:
+    import logging
+
+    # scenario threads drive circuit transitions thousands of times;
+    # the router's (correct) state-change warnings would drown the
+    # smoke's own output
+    logging.getLogger("dmlc_tpu.serving").setLevel(logging.ERROR)
+    t0 = time.monotonic()
+
+    # ---- 1. the explorer must catch the reverted PR 13 drain bug ----
+    res = explore(lambda: sc.DrainRaceScenario("pr13"),
+                  schedules=SCHEDULES, seed=SEED)
+    if res.ok:
+        fail(f"explorer missed the reverted drain race in {res.runs} "
+             f"schedules — exploration lost its teeth")
+    failure = res.failures[0]
+    if "swept by a concluding drain" not in (failure.error or ""):
+        fail(f"reverted drain race produced the wrong failure: "
+             f"{failure.error}")
+    print(f"  teeth: reverted PR 13 drain caught on run {res.runs} "
+          f"({len(failure.decisions)} decisions)")
+    rep = replay(lambda: sc.DrainRaceScenario("pr13"),
+                 failure.decisions)
+    if rep.ok or rep.error != failure.error:
+        fail(f"failure did not replay deterministically: "
+             f"{rep.error!r} != {failure.error!r}")
+    print("  teeth: failure replays deterministically")
+
+    # ---- 2. every scenario holds on the current tree ----------------
+    results = sc.run_all(schedules=SCHEDULES, seed=SEED, verbose=False)
+    for name, r in sorted(results.items()):
+        if not r.ok:
+            f = r.failures[0]
+            fail(f"scenario {name} violated its invariant: {f.error}\n"
+                 f"  replay decisions: {f.decisions}")
+        print(f"  scenario {name}: clean over {r.runs} schedules")
+
+    # ---- 3. wall-time budget ----------------------------------------
+    elapsed = time.monotonic() - t0
+    if elapsed > INTERLEAVE_BUDGET_S:
+        fail(f"smoke took {elapsed:.1f}s > {INTERLEAVE_BUDGET_S:g}s "
+             f"budget — scenarios drifted off the inner loop")
+    print(f"interleave smoke OK ({elapsed:.1f}s, "
+          f"{SCHEDULES} schedules/scenario, seed {SEED})")
+
+
+if __name__ == "__main__":
+    main()

@@ -25,9 +25,12 @@ fault-injected (``DMLC_FAULT_SPEC`` delay) to be a straggler — then:
      validates it is well-formed with >= 1 complete ("X") event.
 
 Both workers run under ``DMLC_LOCKCHECK=1`` (the runtime lock-order
-watchdog instruments every ``concurrency.make_lock`` lock) and assert
-a clean violation report before exiting — a lock-order regression in
-the telemetry path fails this smoke, not production.
+watchdog instruments every ``concurrency.make_lock`` lock) AND
+``DMLC_RACECHECK=1`` (every acquire site records its attribute→lock
+pairing, cross-checked against the static guarded-by analysis of
+``analysis.race_pass``), and assert clean reports for both before
+exiting — a lock-order regression or a static/dynamic guarded-by
+drift in the telemetry path fails this smoke, not production.
 
 Exit 0 on success, 1 with a diagnostic on any failure.
 """
@@ -79,12 +82,18 @@ for i in range({n_steps}):
 time.sleep(1.0)
 hb.close()
 c.shutdown()
-# this worker ran with DMLC_LOCKCHECK=1: every make_lock() lock in the
-# telemetry/heartbeat/step-ledger path was instrumented — any recorded
-# order inversion or held-while-blocked wait fails the worker (and so
-# the smoke) right here
-from dmlc_tpu.concurrency import lockcheck_assert_clean
+# this worker ran with DMLC_LOCKCHECK=1 + DMLC_RACECHECK=1: every
+# make_lock() lock in the telemetry/heartbeat/step-ledger path was
+# instrumented — a recorded order inversion, a held-while-blocked
+# wait, or an observed attribute→lock pairing contradicting the
+# static guarded-by analysis fails the worker (and so the smoke)
+from dmlc_tpu.concurrency import lockcheck_assert_clean, \
+    racecheck_assert_clean, racecheck_observed
 lockcheck_assert_clean()
+if not racecheck_observed():
+    raise SystemExit("racecheck recorded no acquire sites — the "
+                     "DMLC_RACECHECK instrumentation went dark")
+racecheck_assert_clean()
 """
 
 def fail(msg: str) -> None:
@@ -214,6 +223,8 @@ def main() -> None:
     # heartbeat/ledger/telemetry lock surface is exercised end-to-end
     # and each worker asserts a clean lockcheck report before exiting
     env["DMLC_LOCKCHECK"] = "1"
+    # ... and a clean racecheck (attribute→lock pairing) report too
+    env["DMLC_RACECHECK"] = "1"
     workers = [
         subprocess.Popen(
             [sys.executable, "-c",

@@ -1,0 +1,383 @@
+"""Guarded-by race pass + DMLC_RACECHECK runtime cross-check tests.
+
+Same shape as test_analysis.py: a seeded-bad and a clean fixture per
+check, plus the annotation contract, the held-lock inference, the
+static region map, and the runtime attribute→lock pairing check.
+"""
+
+import threading
+
+import pytest
+
+from dmlc_tpu import concurrency
+from dmlc_tpu.analysis.core import RepoIndex, default_paths, repo_root
+from dmlc_tpu.analysis.race_pass import RacePass, guarded_region_map
+
+REPO = repo_root()
+
+
+def _index(tmp_path, files):
+    paths = []
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+        paths.append(str(p))
+    return RepoIndex(paths, str(tmp_path))
+
+
+def _checks(findings, check):
+    return [f for f in findings if f.check == check]
+
+
+def _run(tmp_path, src):
+    return RacePass().run(_index(tmp_path, {"dmlc_tpu/mod.py": src}))
+
+
+# ---- unguarded-access ---------------------------------------------------
+
+MIXED = '''\
+from dmlc_tpu.concurrency import make_lock
+
+
+class Counter:
+    def __init__(self):
+        self._lock = make_lock("Counter._lock")
+        self._n = 0
+
+    def bump(self):
+        with self._lock:
+            self._n += 1
+
+    def peek(self):
+        return self._n
+'''
+
+CLEAN = MIXED.replace(
+    "    def peek(self):\n        return self._n\n",
+    "    def peek(self):\n        with self._lock:\n"
+    "            return self._n\n")
+
+
+def test_mixed_access_caught(tmp_path):
+    found = _checks(_run(tmp_path, MIXED), "unguarded-access")
+    assert found and "Counter._n" in found[0].message, found
+
+
+def test_all_locked_clean(tmp_path):
+    assert not _run(tmp_path, CLEAN)
+
+
+def test_immutable_after_init_clean(tmp_path):
+    src = '''\
+from dmlc_tpu.concurrency import make_lock
+
+
+class Conf:
+    def __init__(self, n):
+        self._lock = make_lock("Conf._lock")
+        self.n = int(n)
+        self._items = []
+
+    def read(self):
+        return self.n  # never written post-init: unlocked read is safe
+
+    def peek(self):
+        return len(self._items)  # never mutated either
+'''
+    assert not _run(tmp_path, src)
+
+
+def test_event_threaded_class_in_scope(tmp_path):
+    """A class with no lock but a Thread/Event is still threaded: its
+    unsynchronized mutable state needs annotations."""
+    src = '''\
+import threading
+
+
+class Loop:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._count = 0
+
+    def run(self):
+        while not self._stop.is_set():
+            self._count += 1
+'''
+    found = _checks(_run(tmp_path, src), "unguarded-access")
+    assert found and "Loop._count" in found[0].message
+
+
+def test_container_mutator_counts_as_write(tmp_path):
+    src = '''\
+from dmlc_tpu.concurrency import make_lock
+
+
+class Ring:
+    def __init__(self):
+        self._lock = make_lock("Ring._lock")
+        self._items = []
+
+    def push(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def drain(self):
+        return list(self._items)
+'''
+    found = _checks(_run(tmp_path, src), "unguarded-access")
+    assert found and found[0].line == 14, found
+
+
+# ---- annotations --------------------------------------------------------
+
+def test_attr_level_unguarded_annotation_silences(tmp_path):
+    src = MIXED.replace(
+        "        self._n = 0",
+        "        # dmlc-check: unguarded(peek is a monitor estimate)\n"
+        "        self._n = 0")
+    assert not _run(tmp_path, src)
+
+
+def test_site_level_guarded_by_annotation(tmp_path):
+    src = MIXED.replace(
+        "    def peek(self):\n        return self._n\n",
+        "    def peek(self):\n"
+        "        # dmlc-check: guarded-by(_lock)\n"
+        "        return self._n\n")
+    assert not _run(tmp_path, src)
+
+
+def test_unguarded_without_reason_is_bad_annotation(tmp_path):
+    src = MIXED.replace(
+        "        self._n = 0",
+        "        # dmlc-check: unguarded()\n        self._n = 0")
+    found = RacePass().run(_index(tmp_path, {"dmlc_tpu/mod.py": src}))
+    assert _checks(found, "bad-annotation")
+
+
+def test_guarded_by_unknown_lock_is_bad_annotation(tmp_path):
+    src = MIXED.replace(
+        "        self._n = 0",
+        "        # dmlc-check: guarded-by(_nope)\n        self._n = 0")
+    found = RacePass().run(_index(tmp_path, {"dmlc_tpu/mod.py": src}))
+    assert _checks(found, "bad-annotation")
+
+
+# ---- divergent-guard ----------------------------------------------------
+
+DIVERGENT = '''\
+from dmlc_tpu.concurrency import make_lock
+
+
+class Split:
+    def __init__(self):
+        self._a = make_lock("Split._a")
+        self._b = make_lock("Split._b")
+        self._n = 0
+
+    def via_a(self):
+        with self._a:
+            self._n += 1
+
+    def via_b(self):
+        with self._b:
+            self._n += 1
+'''
+
+
+def test_divergent_guard_caught(tmp_path):
+    found = _checks(_run(tmp_path, DIVERGENT), "divergent-guard")
+    assert found and "_a" in found[0].message \
+        and "_b" in found[0].message \
+        and "Split._n" in found[0].message, found
+
+
+def test_one_common_lock_clean(tmp_path):
+    src = DIVERGENT.replace("with self._b:", "with self._a:")
+    assert not _checks(_run(tmp_path, src), "divergent-guard")
+
+
+# ---- leaked-guarded-ref -------------------------------------------------
+
+def test_leaked_guarded_container_ref_caught(tmp_path):
+    src = '''\
+from dmlc_tpu.concurrency import make_lock
+
+
+class Store:
+    def __init__(self):
+        self._lock = make_lock("Store._lock")
+        self._items = []
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def snapshot(self):
+        with self._lock:
+            return self._items
+'''
+    found = _checks(_run(tmp_path, src), "leaked-guarded-ref")
+    assert found, found
+    ok = src.replace("return self._items", "return list(self._items)")
+    assert not _checks(_run(tmp_path, ok), "leaked-guarded-ref")
+
+
+# ---- held-lock inference ------------------------------------------------
+
+def test_locked_helper_inference(tmp_path):
+    """A private helper whose every intra-class call site holds the
+    lock runs under it — no annotation needed."""
+    src = '''\
+from dmlc_tpu.concurrency import make_lock
+
+
+class Q:
+    def __init__(self):
+        self._lock = make_lock("Q._lock")
+        self._items = []
+
+    def push(self, x):
+        with self._lock:
+            self._items.append(x)
+            self._trim_locked()
+
+    def pop(self):
+        with self._lock:
+            self._trim_locked()
+            return self._items.pop()
+
+    def _trim_locked(self):
+        while len(self._items) > 4:
+            del self._items[0]
+'''
+    assert not _run(tmp_path, src)
+
+
+def test_condition_alias_collapses_to_lock(tmp_path):
+    src = '''\
+import threading
+
+from dmlc_tpu.concurrency import make_lock
+
+
+class W:
+    def __init__(self):
+        self._lock = make_lock("W._lock")
+        self._cv = threading.Condition(self._lock)
+        self._ready = False
+
+    def set(self):
+        with self._cv:
+            self._ready = True
+            self._cv.notify_all()
+
+    def get(self):
+        with self._lock:
+            return self._ready
+'''
+    assert not _run(tmp_path, src)
+
+
+# ---- the shipped tree ---------------------------------------------------
+
+def test_repo_race_pass_zero_findings():
+    idx = RepoIndex(default_paths(["dmlc_tpu"], REPO), REPO)
+    found = RacePass().run(idx)
+    assert not found, "\n".join(str(f) for f in found[:25])
+
+
+def test_guarded_region_map_names_real_sites():
+    idx = RepoIndex(default_paths(["dmlc_tpu"], REPO), REPO)
+    m = guarded_region_map(idx)
+    assert m, "no guarded regions found in the package"
+    names = {v for v in m.values() if v is not None}
+    # a few load-bearing locks must be mapped under their class names
+    for expect in ("BufferPool._lock", "Router._lock",
+                   "ContinuousBatchScheduler._lock"):
+        assert expect in names, sorted(names)[:20]
+    # make_lock names across the repo agree with the static node names
+    # (the convention the runtime cross-check rides on)
+
+
+# ---- DMLC_RACECHECK runtime cross-check ---------------------------------
+
+@pytest.fixture
+def racecheck(monkeypatch):
+    monkeypatch.setenv("DMLC_RACECHECK", "1")
+    concurrency.lockcheck_reset()
+    yield
+    concurrency.lockcheck_reset()
+
+
+def test_racecheck_implies_lockcheck(racecheck):
+    lk = concurrency.make_lock("x")
+    assert isinstance(lk, concurrency.CheckedLock)
+
+
+def test_racecheck_records_and_cross_checks_clean(racecheck):
+    pool = concurrency.BufferPool(object, capacity=2)
+    a = pool.acquire()
+    pool.release(a)
+    pool.kill()
+    obs = concurrency.racecheck_observed()
+    assert any(base == "concurrency.py" for base, _ in obs), obs
+    concurrency.racecheck_assert_clean()
+
+
+def test_racecheck_flags_wrong_lock_at_known_site(racecheck):
+    """An observed acquire whose runtime lock name contradicts the
+    static guarded-by analysis is a violation."""
+    idx = RepoIndex(default_paths(["dmlc_tpu"], REPO), REPO)
+    m = guarded_region_map(idx)
+    (base, line), expected = next(
+        (k, v) for k, v in sorted(m.items()) if v is not None)
+    with concurrency._lc_graph_lock:
+        concurrency._rc_sites[(base, line)] = {"Bogus._lock"}
+    bad = concurrency.racecheck_report()
+    assert bad and bad[0]["kind"] == "attr-lock-mismatch"
+    assert bad[0]["expected"] == expected
+    with pytest.raises(Exception, match="mismatch"):
+        concurrency.racecheck_assert_clean()
+
+
+def test_racecheck_off_records_nothing(monkeypatch):
+    monkeypatch.delenv("DMLC_RACECHECK", raising=False)
+    monkeypatch.setenv("DMLC_LOCKCHECK", "1")
+    concurrency.lockcheck_reset()
+    lk = concurrency.make_lock("plain.lock")
+    with lk:
+        pass
+    assert concurrency.racecheck_observed() == {}
+    assert concurrency.racecheck_report() == []
+    concurrency.lockcheck_reset()
+
+
+def test_racecheck_site_bound(racecheck, monkeypatch):
+    monkeypatch.setenv("DMLC_RACECHECK_MAX_SITES", "1")
+    a = concurrency.make_lock("A.l")
+    b = concurrency.make_lock("B.l")
+    with a:
+        pass
+    with b:
+        pass
+    assert len(concurrency.racecheck_observed()) <= 1
+
+
+def _ab_ba(a, b):
+    with a:
+        with b:
+            pass
+
+
+def test_lockcheck_still_works_under_racecheck(racecheck):
+    a = concurrency.make_lock("rc.A")
+    b = concurrency.make_lock("rc.B")
+    for first, second in ((a, b), (b, a)):
+        t = threading.Thread(target=_ab_ba, args=(first, second),
+                             daemon=True)
+        t.start()
+        t.join()
+    kinds = [v["kind"] for v in concurrency.lockcheck_report()]
+    assert "order-inversion" in kinds
