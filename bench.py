@@ -293,6 +293,10 @@ def _ledger_keys(summary):
     if summary.get("goodput_tokens_per_s") is not None:
         out["goodput_tokens_per_s"] = round(
             summary["goodput_tokens_per_s"], 1)
+    if summary.get("membw_util") is not None:
+        out["membw_util"] = round(summary["membw_util"], 4)
+    if summary.get("bound") is not None:
+        out["bound"] = summary["bound"]
     return out
 
 
@@ -525,6 +529,17 @@ def main():
                 extra.update(r)
         except Exception as e:  # noqa: BLE001
             log(f"bench: {fn.__name__} failed: {e!r}")
+    # compile-ledger keys across every bench above: a perf PR that adds
+    # a recompile per step shows up here before it shows up in step time
+    try:
+        from dmlc_tpu.telemetry import compute
+
+        if compute.enabled():
+            extra["recompiles"] = compute.recompiles_total()
+            extra["hbm_peak_bytes"] = compute.sample_hbm(
+                publish=False).get("peak_bytes")
+    except Exception as e:  # noqa: BLE001
+        log(f"bench: compute ledger snapshot failed: {e!r}")
     result = {
         "metric": "recordio_inputsplit_read_MBps",
         "value": round(ours, 1),

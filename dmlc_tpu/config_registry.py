@@ -337,6 +337,24 @@ KNOBS: Tuple[Knob, ...] = (
     _k("DMLC_BENCH_TRACE", str, None,
        "bench.py: directory for per-phase Chrome trace exports",
        group="telemetry"),
+    _k("DMLC_PEAK_HBM_GBPS", float, None,
+       "peak HBM bandwidth in GB/s for roofline accounting; overrides "
+       "the device-kind table", ship=True, group="telemetry"),
+    _k("DMLC_COMPUTE_PROFILE", bool, True,
+       "compute observability: profiled_jit compile ledger, XLA "
+       "cost/roofline accounting, HBM gauges (counter/gauge cost "
+       "only); 0 = plain jax.jit, zero per-call overhead", ship=True,
+       group="telemetry"),
+    _k("DMLC_COMPUTE_TRACE_PHASES", bool, False,
+       "deep device-phase tracing: profiler TraceAnnotation scopes "
+       "around decode/train phases (profile-capture runs only)",
+       ship=True, group="telemetry"),
+    _k("DMLC_COMPUTE_STORM_WINDOW_S", float, 60.0,
+       "recompile-storm sliding window (seconds)", ship=True,
+       group="telemetry"),
+    _k("DMLC_COMPUTE_STORM_TRACES", int, 4,
+       "jit traces within the storm window that flag a jit site as a "
+       "recompile storm", ship=True, group="telemetry"),
 
     # ---- lock-order watchdog ------------------------------------------
     _k("DMLC_LOCKCHECK", bool, False,
@@ -409,6 +427,10 @@ KNOBS: Tuple[Knob, ...] = (
     _k("DMLC_SERVE_CRASH_REQUEUE_MAX", int, 2,
        "engine-iteration crashes a request may survive by requeue "
        "(recompute-resume) before failing with reason crash",
+       group="serving"),
+    _k("DMLC_SERVE_MAX_DECODE_SIGS", int, 64,
+       "distinct decode jit signatures (context-length buckets) the "
+       "engine may compile before erroring (recompile-storm guard)",
        group="serving"),
 
     # ---- fleet router (serving/router.py) -----------------------------

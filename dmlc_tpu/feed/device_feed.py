@@ -237,6 +237,9 @@ class DeviceFeed:
         # (it needs the constructed feed's sharding, so it cannot be a
         # constructor argument)
         self._transform = None
+        # pinned staging-pool footprint (feed_staging_pool_bytes gauge)
+        # dmlc-check: unguarded(advisory gauge; reset precedes parser threads)
+        self._staging_bytes = 0
         # ledger-driven auto-tuning: when DMLC_FEED_AUTOTUNE=1, the
         # controller watches the step ledger's feed-wait fraction and
         # re-sizes workers/depth within bounds at every epoch boundary
@@ -556,6 +559,8 @@ class DeviceFeed:
         self._empty_epoch = False
         self._queue = Queue(maxsize=self._depth)
         self._stop.clear()
+        # dmlc-check: unguarded(advisory gauge; reset precedes parser threads)
+        self._staging_bytes = 0
         self._pool = BufferPool(
             functools.partial(self._make_staging), capacity=self._depth)
         self._parsers = [
@@ -587,7 +592,16 @@ class DeviceFeed:
             yield item
 
     def _make_staging(self) -> _StagingBuf:
-        return _StagingBuf(self._template, self._n_parts)
+        from .. import telemetry
+
+        sbuf = _StagingBuf(self._template, self._n_parts)
+        # host-side half of the memory ledger: the compute HBM gauges
+        # cover device memory, this covers the pinned staging pool
+        # dmlc-check: unguarded(advisory gauge; GIL-atomic int accumulate)
+        self._staging_bytes += sum(a.nbytes for a in sbuf.bufs.values())
+        telemetry.set_gauge("feed", "staging_pool_bytes",
+                            self._staging_bytes)
+        return sbuf
 
     # ---- ledger-driven auto-tuning -------------------------------------
     def _apply_autotune(self) -> None:
@@ -1205,7 +1219,10 @@ def _make_padded_expander(feed: DeviceFeed, batch_records: int,
     B = batch_records
     sharding = feed.sharding
 
-    @functools.partial(jax.jit, out_shardings=(sharding, sharding))
+    from ..telemetry import compute
+
+    @functools.partial(compute.profiled_jit, site="feed.expand",
+                       out_shardings=(sharding, sharding))
     def expand(data, offsets):
         offs = offsets.reshape(n_parts, B + 1)
         base = (jnp.arange(n_parts, dtype=jnp.int32) * stride)[:, None]

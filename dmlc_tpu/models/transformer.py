@@ -311,8 +311,13 @@ def _moe_ffn(x, p, axes: ShardAxes, cfg: "TransformerConfig"):
 
 
 def _block(x, p, positions, axes: ShardAxes, cfg: "TransformerConfig"):
-    x = x + _attention(rms_norm(x, p["ln1"]), p, positions, axes)
-    x = x + _moe_ffn(rms_norm(x, p["ln2"]), p, axes, cfg)
+    # named_scope labels are trace-time only (zero runtime cost); they
+    # name the HLO so profiler captures and the compute phase ledger
+    # can attribute device time to attention vs mlp
+    with jax.named_scope("attention"):
+        x = x + _attention(rms_norm(x, p["ln1"]), p, positions, axes)
+    with jax.named_scope("mlp"):
+        x = x + _moe_ffn(rms_norm(x, p["ln2"]), p, axes, cfg)
     return x
 
 
@@ -421,6 +426,24 @@ def decode_flops_per_token(cfg: TransformerConfig, ctx: int) -> float:
     is exactly the forward third of ``train_flops_per_token`` counted
     without the causal discount."""
     return train_flops_per_token(cfg, ctx, causal=False) / 3.0
+
+
+def decode_phase_flops(cfg: TransformerConfig, ctx: int) -> dict:
+    """Per-phase breakdown of :func:`decode_flops_per_token` — the
+    analytic FLOP shares the compute phase ledger
+    (telemetry.compute.phase_estimate) uses to apportion the decode
+    step's device residual across attention / mlp / unembed when deep
+    per-phase tracing is off.  The three values sum exactly to
+    ``decode_flops_per_token(cfg, ctx)`` (qkvo projections count as
+    attention; the KV gather and sampling phases are host-measured and
+    carry no matmul FLOPs)."""
+    e, hd, f, x = (cfg.d_model, cfg.n_heads * cfg.head_dim, cfg.d_ff,
+                   cfg.n_experts)
+    return {
+        "attention": float(cfg.n_layers * (2 * 4 * e * hd + 4 * ctx * hd)),
+        "mlp": float(cfg.n_layers * (2 * 3 * e * f * x)),
+        "unembed": float(2 * e * cfg.vocab),
+    }
 
 
 def _rope_at(x, positions, theta: float = 10000.0):
@@ -560,20 +583,24 @@ def forward_decode(params, ids, positions, k_cache, v_cache, lengths,
     for s in range(n_stages):
         for i in range(lps):
             p = _layer_params(blocks, s, i)
-            xn = rms_norm(x, p["ln1"])
-            q = jnp.einsum("bte,ehd->bthd", xn, p["wq"])
-            k = jnp.einsum("bte,ehd->bthd", xn, p["wk"])
-            v = jnp.einsum("bte,ehd->bthd", xn, p["wv"])
-            q = _rope_at(q, positions)
-            k = _rope_at(k, positions)
-            o = _cached_attention(q, k, v, k_cache[li], v_cache[li], lengths)
-            x = x + jnp.einsum("bthd,hde->bte", o, p["wo"])
-            x = x + _moe_ffn(rms_norm(x, p["ln2"]), p, ShardAxes(), cfg)
+            with jax.named_scope("attention"):
+                xn = rms_norm(x, p["ln1"])
+                q = jnp.einsum("bte,ehd->bthd", xn, p["wq"])
+                k = jnp.einsum("bte,ehd->bthd", xn, p["wk"])
+                v = jnp.einsum("bte,ehd->bthd", xn, p["wv"])
+                q = _rope_at(q, positions)
+                k = _rope_at(k, positions)
+                o = _cached_attention(q, k, v, k_cache[li], v_cache[li],
+                                      lengths)
+                x = x + jnp.einsum("bthd,hde->bte", o, p["wo"])
+            with jax.named_scope("mlp"):
+                x = x + _moe_ffn(rms_norm(x, p["ln2"]), p, ShardAxes(), cfg)
             k_news.append(k[:, 0])
             v_news.append(v[:, 0])
             li += 1
-    x = rms_norm(x, params["ln_f"])
-    logits = jnp.einsum("bte,ev->btv", x, params["unembed"])[:, 0]
+    with jax.named_scope("unembed"):
+        x = rms_norm(x, params["ln_f"])
+        logits = jnp.einsum("bte,ev->btv", x, params["unembed"])[:, 0]
     return logits, jnp.stack(k_news), jnp.stack(v_news)
 
 
@@ -671,7 +698,12 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
     def init_state(params):
         return optimizer.init(params)
 
-    jitted = jax.jit(train_step)
+    from ..telemetry import compute as _compute
+
+    # profiled_jit is plain jax.jit when DMLC_COMPUTE_PROFILE=0; when
+    # on it counts traces vs cache hits per call signature (recompile
+    # ledger) and extracts the executable's XLA cost analysis
+    jitted = _compute.profiled_jit(train_step, site="train.step")
     if not ledger:
         return jitted, init_state
 
@@ -683,12 +715,19 @@ def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
         if not declared:
             telemetry.declare_flops_per_token(
                 train_flops_per_token(cfg, int(ids.shape[-1])))
+            telemetry.declare_dtype(cfg.dtype)
             declared.append(True)
         telemetry.step_begin()
         # a raising dispatch leaves the step open; the next step_begin
         # abandons it instead of recording a garbage wall time
         out = jitted(params, opt_state, ids, labels)
-        telemetry.step_end(tokens=float(ids.size))
+        stats_fn = getattr(jitted, "stats", None)  # absent on plain jit
+        cost = stats_fn().get("last_cost") if stats_fn else None
+        telemetry.step_end(
+            tokens=float(ids.size),
+            bytes_accessed=cost.get("bytes_accessed") if cost else None)
+        if _compute.enabled():
+            _compute.sample_hbm()
         return out
 
     return stepped, init_state

@@ -128,15 +128,36 @@ class _DedupeTable:
 def _jitted_programs():
     """Process-wide jitted prefill/decode (one jit wrapper, so every
     engine instance shares one compile cache — tests and smokes build
-    several engines and must not pay XLA again for identical shapes)."""
-    if not _JIT_CACHE:
-        import jax
+    several engines and must not pay XLA again for identical shapes).
 
-        _JIT_CACHE["prefill"] = jax.jit(tfm.forward_prefill_last,
-                                        static_argnums=(3,))
-        _JIT_CACHE["decode"] = jax.jit(tfm.forward_decode,
-                                       static_argnums=(6,))
-    return _JIT_CACHE["prefill"], _JIT_CACHE["decode"]
+    Both programs go through :func:`telemetry.compute.profiled_jit`
+    (sites ``serving.prefill`` / ``serving.decode``), which is plain
+    ``jax.jit`` when ``DMLC_COMPUTE_PROFILE=0``; the cache is keyed on
+    that mode so toggling the knob between tests cannot hand a plain
+    engine a profiled program or vice versa.  The decode site carries
+    the ``DMLC_SERVE_MAX_DECODE_SIGS`` signature cap — every distinct
+    gathered context length is a full XLA recompile, so unbounded
+    signature growth is a bug worth failing loudly on."""
+    compute = telemetry.compute
+    key = "profiled" if compute.enabled() else "plain"
+    progs = _JIT_CACHE.get(key)
+    if progs is None:
+        progs = (
+            compute.profiled_jit(tfm.forward_prefill_last,
+                                 site="serving.prefill",
+                                 static_argnums=(3,)),
+            compute.profiled_jit(
+                tfm.forward_decode, site="serving.decode",
+                static_argnums=(6,),
+                max_signatures=get_env("DMLC_SERVE_MAX_DECODE_SIGS", 64)),
+        )
+        _JIT_CACHE[key] = progs
+    else:
+        for prog in progs:
+            rereg = getattr(prog, "reregister", None)
+            if rereg is not None:
+                rereg()
+    return progs
 
 
 class InferenceEngine:
@@ -210,6 +231,10 @@ class InferenceEngine:
         self._thread: Optional[threading.Thread] = None
         # dmlc-check: unguarded(engine-thread-confined)
         self._flops_declared = False
+        # padded prompt lengths seen so far: a NEW bucket means a fresh
+        # XLA prefill compile, worth a log line and a counter
+        # dmlc-check: unguarded(engine-thread-confined)
+        self._prompt_buckets: set = set()
 
     # ---- client surface -------------------------------------------------
     def submit(self, prompt_ids: List[int],
@@ -497,6 +522,13 @@ class InferenceEngine:
         resume = bool(req.generated)
         try:
             padded = n + (-n % bs)
+            if padded not in self._prompt_buckets:
+                self._prompt_buckets.add(padded)
+                telemetry.inc("serving", "prompt_bucket_new")
+                logger.info(
+                    "serving: new prefill padding bucket %d tokens "
+                    "(%d seen) — expect one XLA compile", padded,
+                    len(self._prompt_buckets))
             ids = np.zeros((1, padded), np.int32)
             ids[0, :n] = ctx
             t0 = time.perf_counter()
@@ -587,27 +619,49 @@ class InferenceEngine:
         for i, req in enumerate(active):
             ids[i] = req.generated[-1]
             positions[i] = self.cache.length(req.id)
+        compute = telemetry.compute
         if not self._flops_declared:
             # per-token FLOPs vary with context; declared once for the
             # ledger's goodput math, exact FLOPs passed per step below
             telemetry.declare_flops_per_token(
                 tfm.decode_flops_per_token(self.cfg, self.cache.block_size))
+            # the decode roofline needs the dtype's peak FLOPs/HBM BW
+            telemetry.declare_dtype(self.cfg.dtype)
             self._flops_declared = True
         telemetry.step_begin()
-        k, v, lengths = self.cache.gather(
-            [r.id for r in active], pad_batch=pad_b)
-        k, v = self.cache.shard_gathered(k, v)
+        with compute.phase("gather"):
+            k, v, lengths = self.cache.gather(
+                [r.id for r in active], pad_batch=pad_b)
+            k, v = self.cache.shard_gathered(k, v)
+        t_dev = time.perf_counter()
         logits, k_new, v_new = self._decode(
             self.params, ids, positions, k, v, lengths, self.cfg)
         logits = np.asarray(logits)
         k_new = np.asarray(k_new)
         v_new = np.asarray(v_new)
+        dev_s = time.perf_counter() - t_dev
         flops = float(sum(
             tfm.decode_flops_per_token(self.cfg, int(lengths[i]) + 1)
             for i in range(b)))
-        telemetry.step_end(tokens=float(b), flops=flops)
+        if compute.enabled():
+            # the fused decode executable's internal split is not host
+            # observable; apportion its wall time by the model's exact
+            # per-phase FLOP breakdown at the gathered context depth
+            compute.phase_estimate(
+                tfm.decode_phase_flops(self.cfg, int(k.shape[2])), dev_s)
+        stats_fn = getattr(self._decode, "stats", None)
+        cost = stats_fn() if stats_fn else None
+        telemetry.step_end(
+            tokens=float(b), flops=flops,
+            bytes_accessed=(cost["last_cost"] or {}).get("bytes_accessed")
+            if cost else None)
         telemetry.inc("serving", "decode_steps")
         telemetry.observe("serving", "decode_batch", b)
+        if cost:
+            telemetry.set_gauge("serving", "decode_signatures",
+                                cost["signatures"])
+        if compute.enabled():
+            compute.sample_hbm()
         # per-sequence numeric health: a non-finite logit row (NaN/Inf
         # from a poisoned cache page or an overflowed activation) would
         # serve garbage silently.  Checking only the sampled position is
@@ -617,24 +671,25 @@ class InferenceEngine:
         # hot path.  Fail exactly that request with a clear error; the
         # rest of the batch (and the engine) keep serving.
         n_tokens = 0
-        for i, req in enumerate(active):
-            next_id = int(np.argmax(logits[i]))
-            if not np.isfinite(logits[i, next_id]):
-                telemetry.inc("serving", "nonfinite_failures")
-                logger.error("request %d produced non-finite logits at "
-                             "decode position %d", req.id,
-                             int(lengths[i]))
-                self._finish(req, error="non-finite logits during "
-                             "decode (numeric corruption); retry the "
-                             "request", reason="nonfinite")
-                continue
-            self.cache.append(req.id, k_new[:, i], v_new[:, i])
-            req.generated.append(next_id)
-            n_tokens += 1
-            telemetry.inc("serving", "tokens_generated")
-            self.requests.on_token(req.id)
-            if req.is_finished_by(next_id):
-                self._finish(req)
+        with compute.phase("sampling"):
+            for i, req in enumerate(active):
+                next_id = int(np.argmax(logits[i]))
+                if not np.isfinite(logits[i, next_id]):
+                    telemetry.inc("serving", "nonfinite_failures")
+                    logger.error("request %d produced non-finite logits "
+                                 "at decode position %d", req.id,
+                                 int(lengths[i]))
+                    self._finish(req, error="non-finite logits during "
+                                 "decode (numeric corruption); retry the "
+                                 "request", reason="nonfinite")
+                    continue
+                self.cache.append(req.id, k_new[:, i], v_new[:, i])
+                req.generated.append(next_id)
+                n_tokens += 1
+                telemetry.inc("serving", "tokens_generated")
+                self.requests.on_token(req.id)
+                if req.is_finished_by(next_id):
+                    self._finish(req)
         # the decode ledger's per-iteration record: batch composition +
         # admission queue depth + KV pressure — the /requests load
         # signal a router/autoscaler consumes — then a throttled SLO

@@ -256,6 +256,20 @@ class LoadGenerator:
         doc["kv_waste_tokens"] = reqs.get("kv_waste_tokens")
         slo = self._fetch_optional("/slo")
         doc["slo_active"] = slo.get("active", [])
+        # the compute ledger's headline roofline/compile keys: decode
+        # bandwidth-boundedness, steady-state recompiles, HBM peak —
+        # the surface the roofline acceptance gate pins
+        comp = self._fetch_optional("/compute")
+        roof = comp.get("roofline", {}) or {}
+        doc["decode_membw_util"] = (ledger.get("membw_util")
+                                    if ledger.get("membw_util") is not None
+                                    else roof.get("membw_util"))
+        doc["decode_bound"] = (ledger.get("bound")
+                               if ledger.get("bound") is not None
+                               else roof.get("bound"))
+        doc["recompiles"] = comp.get("recompiles_total")
+        doc["hbm_peak_bytes"] = (comp.get("hbm", {}) or {}).get(
+            "peak_bytes")
         if extra:
             doc.update(extra)
         with open(path, "w") as f:

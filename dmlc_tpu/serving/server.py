@@ -32,6 +32,10 @@ Endpoints:
                    requests, decode-iteration ring (router load signal)
   GET  /slo        SLO burn-rate document (objectives, windows, active
                    violations); the GET forces a fresh evaluation
+  GET  /compute    compute observability document: per-jit-site compile
+                   ledger (traces/hits/recompiles, cost analysis),
+                   recompile-storm verdict, HBM accounting, decode
+                   phase shares, step-ledger roofline
   GET  /trace      this replica's local Chrome trace — engine threads
                    plus one labeled row per request and SLO-violation
                    instant markers (tracker-launched replicas ALSO ship
@@ -125,12 +129,15 @@ class ServingHTTPServer:
                 path = self.path.split("?", 1)[0]
                 if path == "/metrics":
                     text = (telemetry.to_prometheus_text()
-                            + eng.slo.prometheus_text())
+                            + eng.slo.prometheus_text()
+                            + telemetry.compute.prometheus_text())
                     self._send(200,
                                "text/plain; version=0.0.4; charset=utf-8",
                                text.encode())
                 elif path == "/healthz":
                     self._send_json(200, {"status": "ok", **eng.stats()})
+                elif path == "/compute":
+                    self._send_json(200, telemetry.compute.report())
                 elif path == "/requests":
                     self._send_json(200, eng.requests.report())
                 elif path == "/slo":

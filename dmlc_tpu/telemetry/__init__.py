@@ -33,6 +33,10 @@ counters, which remains as a compatible shim over this package):
   * ``slo``        declarative serving SLOs (DMLC_SLO_*) evaluated as
                    multi-window burn rates behind /slo; violations
                    flow into the watchdog's anomaly surface
+  * ``compute``    compute observability: profiled_jit compile ledger
+                   (hit/trace/recompile counting, storm detection),
+                   XLA cost/roofline accounting, per-device HBM
+                   gauges, decode phase decomposition behind /compute
   * ``metric_names`` the checked-in metric-name contract registry
                    (scripts/lint.py enforces it)
 
@@ -50,6 +54,7 @@ Typical use::
 from . import (  # noqa: F401
     anomaly,
     clock,
+    compute,
     core,
     events,
     exporters,
@@ -103,11 +108,17 @@ from .heartbeat import (  # noqa: F401
     TelemetryAggregator,
     TelemetryHTTPServer,
 )
+from .compute import (  # noqa: F401
+    profiled_jit,
+    reset_compute,
+)
 from .steps import (  # noqa: F401
     StepLedger,
+    declare_dtype,
     declare_flops_per_token,
     declare_peak_flops,
     detect_peak_flops,
+    detect_peaks,
     ledger,
     reset_steps,
     step_begin,
@@ -130,9 +141,11 @@ __all__ = [
     "anchor_epoch",
     "annotate",
     "counters_snapshot",
+    "declare_dtype",
     "declare_flops_per_token",
     "declare_peak_flops",
     "detect_peak_flops",
+    "detect_peaks",
     "events_tail",
     "export_json",
     "inc",
@@ -140,9 +153,11 @@ __all__ = [
     "observe",
     "observe_duration",
     "open_spans",
+    "profiled_jit",
     "record_event",
     "record_span",
     "reset",
+    "reset_compute",
     "reset_events",
     "reset_steps",
     "set_gauge",

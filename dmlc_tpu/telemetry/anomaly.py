@@ -44,12 +44,17 @@ from ..base import get_env
 from ..concurrency import make_lock
 from .slo import SLO_KINDS
 
-__all__ = ["Watchdog", "ANOMALY_KINDS"]
+__all__ = ["Watchdog", "ANOMALY_KINDS", "COMPUTE_KINDS"]
 
 logger = logging.getLogger("dmlc_tpu.tracker")
 
 ANOMALY_KINDS = ("straggler", "regression", "feed_stall",
                  "goodput_collapse")
+
+# compute-ledger kinds ride the heartbeat ``compute`` sub-doc
+# (telemetry.compute.status); like the SLO kinds they apply/clear
+# directly from each shipped verdict — no consecutive-step gating
+COMPUTE_KINDS = ("recompile_storm",)
 
 # per-rank recent-step window used for the cluster median/MAD view
 _RECENT = 32
@@ -74,7 +79,7 @@ class _RankState:
     __slots__ = ("recent", "steps", "ewma_fast", "ewma_slow",
                  "goodput_ewma", "goodput_peak", "feed_frac_ewma",
                  "last", "last_seq", "anchor", "consec", "active",
-                 "active_since", "remediation")
+                 "active_since", "remediation", "compute")
 
     def __init__(self):
         self.recent: deque = deque(maxlen=_RECENT)
@@ -91,6 +96,7 @@ class _RankState:
         self.active: set = set()
         self.active_since: Dict[str, float] = {}
         self.remediation: Optional[Dict] = None  # shipped selfheal doc
+        self.compute: Optional[Dict] = None      # shipped compute doc
 
 
 def _ewma(prev: Optional[float], x: float, alpha: float) -> float:
@@ -132,6 +138,9 @@ class Watchdog:
             slo = doc.get("slo")
             if isinstance(slo, dict):
                 self.ingest_slo(rank, slo)
+            comp = doc.get("compute")
+            if isinstance(comp, dict):
+                self.ingest_compute(rank, comp)
             trace = doc.get("trace")
             if not isinstance(trace, dict):
                 return
@@ -193,6 +202,48 @@ class Watchdog:
         for kind, detail in fresh:
             self._flag(rank, kind, detail, {}, step_gated=False)
 
+    def ingest_compute(self, rank: int, doc: Dict) -> None:
+        """Mirror a worker's shipped compute-ledger status (the
+        heartbeat ``compute`` sub-doc from telemetry.compute) into this
+        rank's anomaly flags under :data:`COMPUTE_KINDS`.  The storm
+        verdict is computed worker-side over a sliding window, so flags
+        apply/clear directly — no consecutive-step gating — and
+        step-record ingestion never touches them (its clear loop covers
+        ANOMALY_KINDS only)."""
+        if rank < 0 or not isinstance(doc, dict):
+            return
+        clean = {}
+        for k in ("traces", "hits", "recompiles", "hbm_peak_bytes",
+                  "hbm_headroom_bytes"):
+            v = doc.get(k)
+            if isinstance(v, (int, float)):
+                clean[k] = v
+        storm = doc.get("storm") if isinstance(doc.get("storm"), dict) \
+            else {}
+        storming = bool(storm.get("active"))
+        hot = storm.get("sites")
+        if isinstance(hot, list):
+            clean["storm_sites"] = [
+                str(s.get("site"))[:128] for s in hot[:8]
+                if isinstance(s, dict)]
+        fresh = []
+        with self._lock:
+            st = self._ranks.setdefault(rank, _RankState())
+            st.compute = clean or None
+            kind = "recompile_storm"
+            if storming and kind not in st.active:
+                st.active.add(kind)
+                st.active_since[kind] = time.time()
+                fresh.append((kind,
+                              f"worker-reported recompile storm "
+                              f"(sites {clean.get('storm_sites')})"))
+            elif not storming and kind in st.active:
+                st.active.discard(kind)
+                st.active_since.pop(kind, None)
+                self._log.info("anomaly cleared: rank %d %s", rank, kind)
+        for kind, detail in fresh:
+            self._flag(rank, kind, detail, {}, step_gated=False)
+
     def ingest(self, rank: int, records: List[Dict],
                anchor: Optional[float] = None) -> None:
         if rank < 0 or not isinstance(records, list):
@@ -215,6 +266,7 @@ class Watchdog:
                     fresh.active = st.active
                     fresh.active_since = st.active_since
                     fresh.remediation = st.remediation
+                    fresh.compute = st.compute
                     st = self._ranks[rank] = fresh
                 st.anchor = anchor
         for rec in records:
@@ -369,6 +421,7 @@ class Watchdog:
                     "mfu": last.get("mfu"),
                     "flags": sorted(st.active),
                     "remediation": st.remediation,
+                    "compute": st.compute,
                 }
                 for kind in sorted(st.active):
                     active.append({"rank": r, "kind": kind,
@@ -382,6 +435,20 @@ class Watchdog:
                 "active": active,
                 "recent_verdicts": list(self._verdicts)[-32:],
             }
+
+    def compute_report(self) -> Dict:
+        """The tracker's ``GET /compute`` document: each rank's shipped
+        compute-ledger status (compile/recompile totals, storm sites,
+        HBM headlines) keyed by rank, plus which ranks are currently
+        storm-flagged — the cluster counterpart of a replica's local
+        ``telemetry.compute.report``."""
+        with self._lock:
+            ranks = {str(r): st.compute
+                     for r, st in sorted(self._ranks.items())
+                     if st.compute is not None}
+            storming = sorted(r for r, st in self._ranks.items()
+                              if "recompile_storm" in st.active)
+        return {"ranks": ranks, "storming_ranks": storming}
 
     def trace_markers(self) -> List[Dict]:
         """Verdicts as (wall-epoch-seconds, label) pairs for instant
@@ -406,7 +473,7 @@ class Watchdog:
             items = [(r, sorted(st.active))
                      for r, st in sorted(self._ranks.items())]
         for r, kinds in items:
-            for kind in ANOMALY_KINDS + SLO_KINDS:
+            for kind in ANOMALY_KINDS + SLO_KINDS + COMPUTE_KINDS:
                 val = 1 if kind in kinds else 0
                 lines.append(
                     f'dmlc_anomaly_active{{rank="{r}",kind="{kind}"}} '

@@ -1,0 +1,644 @@
+"""Compute observability: compile ledger, XLA cost/roofline, HBM, phases.
+
+The step ledger (PR 5) and request ledger (PR 12) decompose a step into
+feed / collective / "device-compute residual" and a request into
+queue / prefill / decode — but the residual itself was a black box.
+This module opens it along four axes:
+
+  * **compile ledger** — :func:`profiled_jit` wraps every ``jax.jit``
+    entry the repo owns and takes over its compile cache through the
+    AOT path (``lower().compile()``): exact cache-hit vs. trace
+    counting, compile wall-time spans on the flight recorder, and each
+    recompile attributed to the (shape, dtype) signature that
+    triggered it.  Signature churn beyond a threshold inside a sliding
+    window is a *recompile storm* — shipped to the tracker watchdog as
+    the ``recompile_storm`` anomaly kind.
+  * **cost/roofline ledger** — the first compile of a signature pulls
+    the executable's XLA cost analysis (FLOPs, bytes accessed) for
+    free; combined with the per-dtype peak-FLOPs / HBM-bandwidth
+    table (:func:`telemetry.steps.detect_peaks`) this yields an
+    analytic roofline per step: ``mfu``, ``membw_util`` and a
+    ``bound=compute|memory`` verdict.
+  * **device memory accounting** — per-device HBM live/peak/limit from
+    ``Device.memory_stats()`` with a host-RSS fallback for backends
+    (CPU) that report none, plus a headroom gauge future autoscaling /
+    KV-quantization work gates on.
+  * **phase decomposition** — host-measured spans for the host-side
+    decode phases (KV gather, sampling) and an analytic split of the
+    device residual across attention / MLP / unembed, exported as
+    per-phase time shares.
+
+Everything here is dark-cheap: ``DMLC_COMPUTE_PROFILE=1`` (default)
+costs counters and one dict lookup per jitted call; ``=0`` makes
+:func:`profiled_jit` return the plain ``jax.jit`` object — zero
+per-call overhead, no registry entries.  Deep per-phase device
+tracing (profiler ``TraceAnnotation`` scopes) sits behind
+``DMLC_COMPUTE_TRACE_PHASES=1``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import deque
+from typing import Any, Dict, Optional, Tuple
+
+from ..base import DMLCError, get_env
+from ..concurrency import make_lock
+from . import core
+
+__all__ = [
+    "PHASES", "profiled_jit", "enabled", "phases_enabled", "sites",
+    "roofline", "sample_hbm", "phase", "phase_estimate", "phase_shares",
+    "recompiles_total", "status", "report", "prometheus_text",
+    "reset_compute",
+]
+
+logger = logging.getLogger("dmlc_tpu.telemetry")
+
+# the fixed decode-phase vocabulary: gather + sampling are measured on
+# the host (they ARE host work), attention/mlp/unembed split the
+# device residual analytically from the model's FLOP breakdown
+PHASES = ("gather", "attention", "mlp", "unembed", "sampling")
+
+
+def enabled() -> bool:
+    """Compile/cost/HBM ledgers on (the dark-cheap default)."""
+    return bool(get_env("DMLC_COMPUTE_PROFILE", True))
+
+
+def phases_enabled() -> bool:
+    """Deep device-phase tracing (profiler annotations) requested."""
+    return bool(get_env("DMLC_COMPUTE_TRACE_PHASES", False))
+
+
+# ---------------------------------------------------------------------------
+# compile ledger
+# ---------------------------------------------------------------------------
+
+_lock = make_lock("compute._lock")
+_sites: Dict[str, "_ProfiledJit"] = {}
+
+
+def _leaf_sig(av) -> Tuple:
+    return (tuple(av.shape), str(av.dtype),
+            bool(getattr(av, "weak_type", False)))
+
+
+def _sig_text(key) -> str:
+    """Compact human-readable signature: what a recompile is
+    attributed to in spans, logs and /compute."""
+    parts = []
+    for item in key:
+        if isinstance(item, tuple) and len(item) == 2 \
+                and isinstance(item[0], str) and item[0] == "static":
+            parts.append(f"static:{item[1]!r:.40}")
+        elif isinstance(item, tuple) and len(item) == 2:
+            leaves = item[1]
+            parts.append(",".join(
+                f"{'x'.join(map(str, shp))}:{dt}" + ("w" if wk else "")
+                for shp, dt, wk in leaves) or "()")
+        else:  # pragma: no cover - defensive
+            parts.append(repr(item)[:40])
+    return ";".join(parts)
+
+
+class _ProfiledJit:
+    """A ``jax.jit`` wrapper that owns its compile cache.
+
+    The wrapper keys on the canonicalized abstract values of the array
+    arguments (shape, dtype, weak_type — exactly what jit traces on)
+    plus the values of the static arguments, compiles each fresh
+    signature once through the AOT path, and dispatches cache hits
+    straight to the compiled executable.  Any AOT surprise (an
+    unlowerable transform, a sharding mismatch at call time) falls back
+    to the plain jit call and is counted, never raised — profiling must
+    not be able to break the model.
+    """
+
+    def __init__(self, fn, *, site: str, static_argnums=(),
+                 max_signatures: Optional[int] = None, **jit_kwargs):
+        import jax
+
+        self._fn = fn
+        self.site = str(site)
+        self._static = tuple(int(i) for i in static_argnums)
+        self._max_sigs = max_signatures
+        if self._static:
+            jit_kwargs = dict(jit_kwargs,
+                              static_argnums=self._static)
+        self._jit = jax.jit(fn, **jit_kwargs)
+        self._lock = make_lock("_ProfiledJit._lock")
+        self._cache: Dict[Any, Tuple] = {}
+        self.traces = 0
+        self.hits = 0
+        self.recompiles = 0
+        self.aot_fallbacks = 0
+        self.compile_secs_total = 0.0
+        self.last_cost: Optional[Dict] = None
+        self.last_signature: Optional[str] = None
+        self._trace_times: deque = deque(maxlen=256)
+        with _lock:
+            _sites[self.site] = self
+
+    # -- signature ------------------------------------------------------
+    def _signature(self, args) -> Tuple:
+        import jax
+        from jax.api_util import shaped_abstractify
+
+        parts = []
+        for i, a in enumerate(args):
+            if i in self._static:
+                parts.append(("static", a))
+            else:
+                leaves, treedef = jax.tree_util.tree_flatten(a)
+                parts.append((treedef, tuple(
+                    _leaf_sig(shaped_abstractify(leaf))
+                    for leaf in leaves)))
+        return tuple(parts)
+
+    # -- compile (cache miss) -------------------------------------------
+    def _compile(self, key, args):
+        with self._lock:
+            entry = self._cache.get(key)
+            if entry is not None:  # raced another thread's compile
+                self.hits += 1
+                self.last_cost = entry[1]
+                return entry
+            if (self._max_sigs is not None
+                    and len(self._cache) >= self._max_sigs):
+                raise DMLCError(
+                    f"jit site {self.site!r} hit its signature cap: "
+                    f"{len(self._cache)} distinct compile signatures "
+                    f"(new: {_sig_text(key)}) — every novel signature "
+                    f"is a full XLA recompile; bucket the inputs or "
+                    f"raise the cap")
+            sig = _sig_text(key)
+            t0 = time.perf_counter()
+            try:
+                compiled = self._jit.lower(*args).compile()
+            except Exception:  # noqa: BLE001 - AOT must not break the model
+                self.aot_fallbacks += 1
+                core.inc("compute", "aot_fallbacks")
+                compiled = None
+            t1 = time.perf_counter()
+            self.traces += 1
+            n_traces = self.traces
+            n_recompiles = self.recompiles = self.traces - 1
+            self._trace_times.append((time.time(), sig))
+            self.compile_secs_total += t1 - t0
+            self.last_signature = sig
+            cost = _extract_cost(compiled) if compiled is not None else None
+            self.last_cost = cost
+            entry = (compiled, cost)
+            self._cache[key] = entry
+        core.observe_duration("compute", "compile", t1 - t0)
+        core.record_span(f"compile:{self.site}", stage="compute",
+                         t0=t0, t1=t1,
+                         args={"site": self.site, "signature": sig,
+                               "trace": n_traces})
+        if n_recompiles:
+            logger.info("compute: recompile #%d at site %s for "
+                        "signature %s (%.3fs)", n_recompiles,
+                        self.site, sig, t1 - t0)
+        return entry
+
+    # -- dispatch --------------------------------------------------------
+    def __call__(self, *args):
+        try:
+            key = self._signature(args)
+            hash(key)  # unhashable static args surface HERE, not below
+        except Exception:  # noqa: BLE001 - unhashable static etc.
+            with self._lock:
+                self.aot_fallbacks += 1
+            core.inc("compute", "aot_fallbacks")
+            return self._jit(*args)
+        with self._lock:
+            entry = self._cache.get(key)
+            if entry is not None:
+                self.hits += 1
+                self.last_cost = entry[1]
+        if entry is None:
+            entry = self._compile(key, args)
+        compiled, _cost = entry
+        if compiled is None:
+            return self._jit(*args)
+        dyn = tuple(a for i, a in enumerate(args)
+                    if i not in self._static)
+        try:
+            return compiled(*dyn)
+        except Exception:  # noqa: BLE001 - e.g. committed-device mismatch
+            with self._lock:
+                self.aot_fallbacks += 1
+            core.inc("compute", "aot_fallbacks")
+            return self._jit(*args)
+
+    # -- views -----------------------------------------------------------
+    def stats(self) -> Dict:
+        with self._lock:
+            return {
+                "traces": self.traces,
+                "hits": self.hits,
+                "recompiles": self.recompiles,
+                "aot_fallbacks": self.aot_fallbacks,
+                "compile_secs_total": round(self.compile_secs_total, 6),
+                "signatures": len(self._cache),
+                "last_signature": self.last_signature,
+                "last_cost": dict(self.last_cost)
+                if self.last_cost else None,
+            }
+
+    def recent_traces(self, window_s: float) -> int:
+        now = time.time()
+        with self._lock:
+            return sum(1 for t, _ in self._trace_times
+                       if now - t <= window_s)
+
+    def reregister(self) -> None:
+        """Re-enter the site registry after a test-time
+        :func:`reset_compute` orphaned a long-lived wrapper (the
+        serving engine caches its jitted programs process-wide)."""
+        with _lock:
+            _sites.setdefault(self.site, self)
+
+
+def _extract_cost(compiled) -> Optional[Dict]:
+    """FLOPs / bytes-accessed from an executable's XLA cost analysis.
+
+    ``cost_analysis()`` returns a list of per-module dicts on current
+    jax (one module per jit) — tolerate both that and a bare dict, and
+    missing keys on exotic backends."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 - optional backend feature
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    out = {}
+    flops = ca.get("flops")
+    nbytes = ca.get("bytes accessed")
+    if isinstance(flops, (int, float)) and flops >= 0:
+        out["flops"] = float(flops)
+    if isinstance(nbytes, (int, float)) and nbytes >= 0:
+        out["bytes_accessed"] = float(nbytes)
+    return out or None
+
+
+def profiled_jit(fn, *, site: str, static_argnums=(),
+                 max_signatures: Optional[int] = None, **jit_kwargs):
+    """``jax.jit`` with a compile ledger attached.
+
+    With ``DMLC_COMPUTE_PROFILE=0`` this *is* ``jax.jit(fn, ...)`` —
+    the returned object carries no wrapper, no registry entry and no
+    per-call cost, which is what the zero-overhead acceptance test
+    pins."""
+    if not enabled():
+        import jax
+
+        if static_argnums:
+            jit_kwargs = dict(jit_kwargs, static_argnums=static_argnums)
+        return jax.jit(fn, **jit_kwargs)
+    return _ProfiledJit(fn, site=site, static_argnums=static_argnums,
+                        max_signatures=max_signatures, **jit_kwargs)
+
+
+def sites() -> Dict[str, _ProfiledJit]:
+    with _lock:
+        return dict(_sites)
+
+
+def recompiles_total() -> int:
+    return sum(pj.stats()["recompiles"] for pj in sites().values())
+
+
+# ---------------------------------------------------------------------------
+# recompile storms
+# ---------------------------------------------------------------------------
+
+def _storm_params() -> Tuple[float, int]:
+    return (get_env("DMLC_COMPUTE_STORM_WINDOW_S", 60.0),
+            get_env("DMLC_COMPUTE_STORM_TRACES", 4))
+
+
+def _storm_doc() -> Dict:
+    """Sites whose compile rate inside the sliding window crossed the
+    storm threshold.  Counted on *traces* (not recompiles) so a cold
+    site churning through fresh signatures trips just as loudly as a
+    warm one re-tracing."""
+    window_s, threshold = _storm_params()
+    hot = []
+    for site, pj in sorted(sites().items()):
+        n = pj.recent_traces(window_s)
+        if n >= threshold:
+            hot.append({"site": site, "traces_in_window": n})
+    return {"active": bool(hot), "window_s": window_s,
+            "threshold": threshold, "sites": hot}
+
+
+# ---------------------------------------------------------------------------
+# roofline
+# ---------------------------------------------------------------------------
+
+def roofline(flops: Optional[float], bytes_accessed: Optional[float],
+             wall_s: float, peak_flops: Optional[float],
+             peak_bw: Optional[float]) -> Dict:
+    """Analytic roofline verdict for one measured interval.
+
+    ``bound`` compares the kernel's arithmetic intensity (FLOPs per
+    byte moved) against the machine balance (peak FLOP/s per peak
+    byte/s): below the balance point the kernel cannot saturate the
+    ALUs no matter how well it is scheduled — it is memory-bound."""
+    out: Dict[str, Optional[float]] = {
+        "flops": flops, "bytes_accessed": bytes_accessed,
+        "intensity": None, "mfu": None, "membw_util": None,
+        "bound": None,
+    }
+    if wall_s <= 0:
+        return out
+    if flops and bytes_accessed:
+        out["intensity"] = flops / bytes_accessed
+    if flops and peak_flops:
+        out["mfu"] = flops / wall_s / peak_flops
+    if bytes_accessed and peak_bw:
+        out["membw_util"] = bytes_accessed / wall_s / peak_bw
+    if out["intensity"] is not None and peak_flops and peak_bw:
+        balance = peak_flops / peak_bw
+        out["bound"] = "memory" if out["intensity"] < balance \
+            else "compute"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# device memory (HBM) accounting
+# ---------------------------------------------------------------------------
+
+_hbm_lock = make_lock("compute._hbm_lock")
+_last_hbm: Optional[Dict] = None
+
+
+def _host_rss() -> Dict:
+    """Host fallback when the backend reports no memory_stats (CPU):
+    the process's live/peak RSS against total system memory — a proxy,
+    flagged as such (``source=host_rss``), but enough that the gauges
+    and the /compute schema never go dark on a dev box."""
+    live = peak = limit = None
+    try:
+        import resource
+
+        # ru_maxrss is KiB on linux
+        peak = float(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+                     ) * 1024.0
+    except Exception:  # noqa: BLE001 - non-posix
+        pass
+    try:
+        with open("/proc/self/statm") as f:
+            import os as _os
+
+            live = float(f.read().split()[1]) * _os.sysconf("SC_PAGE_SIZE")
+    except Exception:  # noqa: BLE001 - non-linux
+        live = peak
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    limit = float(line.split()[1]) * 1024.0
+                    break
+    except Exception:  # noqa: BLE001 - non-linux
+        pass
+    return {"available": False, "source": "host_rss", "devices": [],
+            "live_bytes": live, "peak_bytes": peak,
+            "limit_bytes": limit,
+            "headroom_bytes": (limit - live)
+            if (limit is not None and live is not None) else None}
+
+
+def sample_hbm(publish: bool = True) -> Dict:
+    """One HBM sample across local devices (live/peak/limit/headroom).
+
+    Returns the device view when ``memory_stats()`` works, the
+    host-RSS proxy otherwise; optionally publishes the aggregate
+    gauges (sum live, max per-device peak, min per-device headroom —
+    the conservative reading for an admission decision)."""
+    global _last_hbm
+    doc: Optional[Dict] = None
+    try:
+        import jax
+
+        devices = jax.local_devices()
+        per_dev = []
+        for d in devices:
+            ms = d.memory_stats()
+            if not isinstance(ms, dict):
+                per_dev = []
+                break
+            live = ms.get("bytes_in_use")
+            peak = ms.get("peak_bytes_in_use", live)
+            limit = ms.get("bytes_limit")
+            per_dev.append({
+                "id": d.id, "kind": d.device_kind,
+                "live_bytes": live, "peak_bytes": peak,
+                "limit_bytes": limit,
+                "headroom_bytes": (limit - live)
+                if (limit is not None and live is not None) else None})
+        if per_dev:
+            lives = [d["live_bytes"] for d in per_dev
+                     if d["live_bytes"] is not None]
+            peaks = [d["peak_bytes"] for d in per_dev
+                     if d["peak_bytes"] is not None]
+            limits = [d["limit_bytes"] for d in per_dev
+                      if d["limit_bytes"] is not None]
+            heads = [d["headroom_bytes"] for d in per_dev
+                     if d["headroom_bytes"] is not None]
+            doc = {"available": True, "source": "device",
+                   "devices": per_dev,
+                   "live_bytes": sum(lives) if lives else None,
+                   "peak_bytes": max(peaks) if peaks else None,
+                   "limit_bytes": sum(limits) if limits else None,
+                   "headroom_bytes": min(heads) if heads else None}
+    except Exception:  # noqa: BLE001 - no jax / backend quirk
+        doc = None
+    if doc is None:
+        doc = _host_rss()
+    if publish:
+        if doc.get("live_bytes") is not None:
+            core.set_gauge("compute", "hbm_live_bytes",
+                           float(doc["live_bytes"]))
+        if doc.get("peak_bytes") is not None:
+            core.set_gauge("compute", "hbm_peak_bytes",
+                           float(doc["peak_bytes"]))
+        if doc.get("headroom_bytes") is not None:
+            core.set_gauge("compute", "hbm_headroom_bytes",
+                           float(doc["headroom_bytes"]))
+    with _hbm_lock:
+        _last_hbm = doc
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# phase decomposition
+# ---------------------------------------------------------------------------
+
+_phase_lock = make_lock("compute._phase_lock")
+_phase_secs: Dict[str, float] = {p: 0.0 for p in PHASES}
+
+
+def _add_phase(name: str, secs: float) -> None:
+    if secs <= 0:
+        return
+    with _phase_lock:
+        if name in _phase_secs:
+            _phase_secs[name] += secs
+    core.set_gauge("compute", f"phase_{name}_share",
+                   phase_shares().get(name, 0.0))
+
+
+@contextlib.contextmanager
+def phase(name: str):
+    """Host-measured phase scope (gather / sampling / ...).
+
+    Always accounts wall time into the phase-share estimate (two clock
+    reads — dark-cheap); additionally opens a profiler
+    ``TraceAnnotation`` scope when deep tracing is on, so the phase
+    shows up as a named region in captured device profiles."""
+    if not enabled():
+        yield
+        return
+    ctx = core.annotate(name) if phases_enabled() \
+        else contextlib.nullcontext()
+    t0 = time.perf_counter()
+    try:
+        with ctx:
+            yield
+    finally:
+        _add_phase(name, time.perf_counter() - t0)
+
+
+def phase_estimate(shares: Dict[str, float], secs: float) -> None:
+    """Split a device-residual interval across phases analytically.
+
+    The device computation is one fused executable — its internal
+    phase split is not host-observable without a profiler capture, but
+    the model's FLOP breakdown (attention vs. MLP vs. unembed) is
+    exact, so the residual wall time is apportioned by it.  The result
+    is an *estimate* and is labeled as one on /compute."""
+    if not enabled() or secs <= 0 or not shares:
+        return
+    total = sum(v for v in shares.values() if v and v > 0)
+    if total <= 0:
+        return
+    with _phase_lock:
+        for name, v in shares.items():
+            if name in _phase_secs and v and v > 0:
+                _phase_secs[name] += secs * (v / total)
+    for name in shares:
+        if name in _phase_secs:
+            core.set_gauge("compute", f"phase_{name}_share",
+                           phase_shares().get(name, 0.0))
+
+
+def phase_shares() -> Dict[str, float]:
+    """Normalized per-phase time shares (empty before any sample)."""
+    with _phase_lock:
+        total = sum(_phase_secs.values())
+        if total <= 0:
+            return {}
+        return {p: s / total for p, s in _phase_secs.items()}
+
+
+# ---------------------------------------------------------------------------
+# views: heartbeat status, /compute document, prometheus text
+# ---------------------------------------------------------------------------
+
+def status() -> Dict:
+    """Small-scalar compute doc shipped with heartbeats (the watchdog's
+    ``recompile_storm`` signal plus the headline gauges); empty when
+    the profile is off or nothing was ever jitted through it."""
+    if not enabled():
+        return {}
+    site_map = {s: pj.stats() for s, pj in sites().items()}
+    if not site_map:
+        return {}
+    storm = _storm_doc()
+    with _hbm_lock:
+        hbm = _last_hbm
+    out = {
+        "traces": sum(st["traces"] for st in site_map.values()),
+        "hits": sum(st["hits"] for st in site_map.values()),
+        "recompiles": sum(st["recompiles"] for st in site_map.values()),
+        "storm": storm,
+    }
+    if hbm:
+        out["hbm_peak_bytes"] = hbm.get("peak_bytes")
+        out["hbm_headroom_bytes"] = hbm.get("headroom_bytes")
+    return out
+
+
+def _step_roofline() -> Dict:
+    """The step ledger's roofline view (peaks + latest verdict)."""
+    from . import steps
+
+    return steps.ledger().roofline_summary()
+
+
+def report() -> Dict:
+    """The ``GET /compute`` document."""
+    site_map = {s: pj.stats() for s, pj in sorted(sites().items())}
+    with _hbm_lock:
+        hbm = _last_hbm
+    return {
+        "enabled": enabled(),
+        "deep_phase_tracing": phases_enabled(),
+        "sites": site_map,
+        "traces_total": sum(s["traces"] for s in site_map.values()),
+        "cache_hits_total": sum(s["hits"] for s in site_map.values()),
+        "recompiles_total": sum(s["recompiles"]
+                                for s in site_map.values()),
+        "aot_fallbacks_total": sum(s["aot_fallbacks"]
+                                   for s in site_map.values()),
+        "storm": _storm_doc(),
+        "hbm": hbm if hbm is not None else sample_hbm(),
+        "phases": {"shares": phase_shares(),
+                   "estimated": ("attention", "mlp", "unembed"),
+                   "measured": ("gather", "sampling")},
+        "roofline": _step_roofline(),
+    }
+
+
+def prometheus_text() -> str:
+    """Per-site compile-ledger families as labeled exposition text
+    (the core registry cannot label, so these are hand-rendered the
+    same way slo/anomaly surfaces are)."""
+    site_map = {s: pj.stats() for s, pj in sorted(sites().items())}
+    if not site_map:
+        return ""
+    fams = (
+        ("dmlc_compute_recompiles_total", "counter",
+         "XLA recompiles beyond the first trace, per jit site",
+         "recompiles"),
+        ("dmlc_compute_traces_total", "counter",
+         "jit traces (compiles) per jit site", "traces"),
+        ("dmlc_compute_cache_hits_total", "counter",
+         "jit compile-cache hits per jit site", "hits"),
+    )
+    lines = []
+    for fam, typ, help_txt, key in fams:
+        lines.append(f"# HELP {fam} {help_txt}")
+        lines.append(f"# TYPE {fam} {typ}")
+        for site, st in site_map.items():
+            lines.append(f'{fam}{{site="{site}"}} {st[key]}')
+    return "\n".join(lines) + "\n"
+
+
+def reset_compute() -> None:
+    """Forget every ledger (tests / fresh bench runs)."""
+    global _last_hbm
+    with _lock:
+        _sites.clear()
+    with _hbm_lock:
+        _last_hbm = None
+    with _phase_lock:
+        for p in PHASES:
+            _phase_secs[p] = 0.0

@@ -363,12 +363,16 @@ class TelemetryHTTPServer:
     (a callable taking the parsed JSON body, returning a JSON-able
     dict) enables ``POST /resize`` — the elastic tracker's operator
     scale-up endpoint; a ``ValueError`` from the handler maps to 400, a
-    ``RuntimeError`` (e.g. tracker not elastic) to 409."""
+    ``RuntimeError`` (e.g. tracker not elastic) to 409.
+    ``compute_source`` (zero-arg callable returning a JSON-able dict,
+    e.g. ``Watchdog.compute_report``) enables ``GET /compute``: the
+    cluster view of the per-rank compile/roofline/HBM ledgers shipped
+    with heartbeats."""
 
     def __init__(self, aggregator: TelemetryAggregator,
                  host: str = "127.0.0.1", port: int = 0,
                  trace_source=None, anomaly_source=None,
-                 resize_handler=None):
+                 resize_handler=None, compute_source=None):
         agg = aggregator
 
         class Handler(BaseHTTPRequestHandler):
@@ -404,6 +408,15 @@ class TelemetryHTTPServer:
                         logger.warning("/anomalies render failed: %r", e)
                         self._send(503, "text/plain",
                                    b"anomaly render failed\n")
+                        return
+                    self._send(200, "application/json", body)
+                elif path == "/compute" and compute_source is not None:
+                    try:
+                        body = json.dumps(compute_source()).encode()
+                    except Exception as e:  # noqa: BLE001 - no 500s
+                        logger.warning("/compute render failed: %r", e)
+                        self._send(503, "text/plain",
+                                   b"compute render failed\n")
                         return
                     self._send(200, "application/json", body)
                 else:
@@ -544,6 +557,14 @@ class HeartbeatSender:
         slo_doc = slo_mod.status()
         if slo_doc:
             doc["slo"] = slo_doc
+        # compute ledger status (telemetry.compute): compile/recompile
+        # totals, the recompile-storm verdict and the headline HBM
+        # gauges — the tracker watchdog's recompile_storm signal
+        from . import compute as compute_mod
+
+        compute_doc = compute_mod.status()
+        if compute_doc:
+            doc["compute"] = compute_doc
         if self.ship_trace:
             doc["trace"] = self._trace_doc()
             payload = self._capped_payload(doc)

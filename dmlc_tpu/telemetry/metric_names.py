@@ -33,6 +33,23 @@ METRIC_NAMES = frozenset({
     "dmlc_anomaly_slo_ttft_flags",
     "dmlc_anomaly_slo_tbt_flags",
     "dmlc_anomaly_slo_error_rate_flags",
+    "dmlc_anomaly_recompile_storm_flags",
+    # compute observability (telemetry.compute): compile ledger
+    # (hand-rendered per-site *_total families + registry families),
+    # HBM accounting, per-phase time shares
+    "dmlc_compute_recompiles_total",
+    "dmlc_compute_traces_total",
+    "dmlc_compute_cache_hits_total",
+    "dmlc_compute_compile_secs",
+    "dmlc_compute_aot_fallbacks",
+    "dmlc_compute_hbm_live_bytes",
+    "dmlc_compute_hbm_peak_bytes",
+    "dmlc_compute_hbm_headroom_bytes",
+    "dmlc_compute_phase_gather_share",
+    "dmlc_compute_phase_attention_share",
+    "dmlc_compute_phase_mlp_share",
+    "dmlc_compute_phase_unembed_share",
+    "dmlc_compute_phase_sampling_share",
     # elastic world resize (tracker generations + client + launcher)
     "dmlc_elastic_resizes_total",
     "dmlc_elastic_shrinks_total",
@@ -74,6 +91,7 @@ METRIC_NAMES = frozenset({
     "dmlc_feed_queue_depth",
     "dmlc_feed_resizes",
     "dmlc_feed_stage_stall_secs",
+    "dmlc_feed_staging_pool_bytes",
     # flash attention
     "dmlc_flash_fwd_calls",
     "dmlc_flash_fwd_flops",
@@ -183,6 +201,10 @@ METRIC_NAMES = frozenset({
     # requeue-on-crash)
     "dmlc_serving_dedupe_hits",
     "dmlc_serving_crash_requeues",
+    # serving compile-signature hygiene (engine prompt padding buckets
+    # and the decode jit-signature population)
+    "dmlc_serving_prompt_bucket_new",
+    "dmlc_serving_decode_signatures",
     # fleet router (serving/router.py): dispatch/retry/hedge/failover
     # counters, fleet health gauges, routed latency/TTFT, per-status
     # edge counters, and the hand-rendered per-replica labeled families
@@ -227,6 +249,8 @@ METRIC_NAMES = frozenset({
     "dmlc_step_count",
     "dmlc_step_feed_wait_secs",
     "dmlc_step_goodput_tokens_per_s",
+    "dmlc_step_membw_util_pct",
+    "dmlc_step_memory_bound",
     "dmlc_step_mfu_pct",
     "dmlc_step_time_secs",
     # telemetry self-accounting
@@ -262,6 +286,7 @@ NON_METRIC_TOKENS = frozenset({
     "dmlc_top",
     "dmlc_tracker",       # reference repo path tracker/dmlc_tracker/…
     "dmlc_anomaly",       # prose prefix for the dmlc_anomaly_* family
+    "dmlc_compute",       # prose prefix for the dmlc_compute_* family
     "dmlc_elastic",       # prose prefix for the dmlc_elastic_* family
     "dmlc_integrity",     # prose prefix for the dmlc_integrity_* family
     "dmlc_selfheal",      # prose prefix for the dmlc_selfheal_* family

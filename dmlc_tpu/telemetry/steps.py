@@ -60,22 +60,42 @@ __all__ = [
     "step_end",
     "declare_flops_per_token",
     "declare_peak_flops",
+    "declare_dtype",
     "detect_peak_flops",
+    "detect_peaks",
     "DEVICE_PEAK_FLOPS",
+    "DEVICE_PEAKS",
     "reset_steps",
 ]
 
-#: dense bf16 peak FLOP/s per chip by jax device_kind (bench.py's MFU
-#: table, promoted here so bench and the ledger share one source)
-DEVICE_PEAK_FLOPS: Dict[str, float] = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
+#: per-chip peaks by jax device_kind: dense peak FLOP/s per compute
+#: dtype plus HBM bandwidth.  bf16 figures are the datasheet MXU
+#: peaks; f32 is modeled at half rate (the MXU is a bf16 engine — f32
+#: matmuls run as multi-pass decompositions), which is what makes a
+#: bf16-table MFU silently wrong for models that actually run f32.
+DEVICE_PEAKS: Dict[str, Dict[str, float]] = {
+    "TPU v4": {"bf16": 275e12, "f32": 137.5e12, "hbm_gbps": 1228.0},
+    "TPU v5 lite": {"bf16": 197e12, "f32": 98.5e12, "hbm_gbps": 819.0},
+    "TPU v5e": {"bf16": 197e12, "f32": 98.5e12, "hbm_gbps": 819.0},
+    "TPU v5": {"bf16": 459e12, "f32": 229.5e12, "hbm_gbps": 2765.0},
+    "TPU v5p": {"bf16": 459e12, "f32": 229.5e12, "hbm_gbps": 2765.0},
+    "TPU v6 lite": {"bf16": 918e12, "f32": 459e12, "hbm_gbps": 1640.0},
+    "TPU v6e": {"bf16": 918e12, "f32": 459e12, "hbm_gbps": 1640.0},
 }
+
+#: back-compat view (bench.py's original bf16 MFU table)
+DEVICE_PEAK_FLOPS: Dict[str, float] = {
+    kind: peaks["bf16"] for kind, peaks in DEVICE_PEAKS.items()
+}
+
+
+def _canon_dtype(dtype: Optional[str]) -> str:
+    d = str(dtype or "bf16").lower()
+    if d in ("float32", "f32", "fp32"):
+        return "f32"
+    # f16 runs on the same MXU path as bf16; anything unknown gets the
+    # bf16 column (the table's headline figure) rather than no peak
+    return "bf16"
 
 
 def detect_peak_flops() -> Optional[float]:
@@ -94,6 +114,88 @@ def detect_peak_flops() -> Optional[float]:
         return DEVICE_PEAK_FLOPS.get(jax.devices()[0].device_kind)
     except Exception:  # noqa: BLE001 - no jax / no backend: no peak
         return None
+
+
+# one-time measured CPU peaks (dev boxes have no datasheet row):
+# a small f32 GEMM for FLOP/s, a large buffer copy for memory
+# bandwidth.  Cached forever — the number is a calibration, not a
+# per-step measurement.
+_cpu_cal_lock = make_lock("steps._cpu_cal_lock")
+_cpu_cal: Optional[Tuple[float, float]] = None
+
+
+def _calibrate_cpu() -> Tuple[float, float]:
+    global _cpu_cal
+    with _cpu_cal_lock:
+        if _cpu_cal is not None:
+            return _cpu_cal
+        import numpy as np
+
+        n = 256
+        a = np.ones((n, n), np.float32)
+        b = np.ones((n, n), np.float32)
+        flops = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            a @ b
+            dt = max(time.perf_counter() - t0, 1e-9)
+            flops = max(flops, 2.0 * n ** 3 / dt)
+        buf = np.ones(32 << 20, np.uint8)
+        t0 = time.perf_counter()
+        buf.copy()
+        dt = max(time.perf_counter() - t0, 1e-9)
+        bw = 2.0 * buf.nbytes / dt  # the copy reads AND writes
+        _cpu_cal = (flops, bw)
+        return _cpu_cal
+
+
+def detect_peaks(dtype: Optional[str] = "bf16"
+                 ) -> Tuple[Optional[float], Optional[float]]:
+    """(peak FLOP/s in ``dtype``, peak HBM bytes/s) for the local chip.
+
+    Resolution per component: the env override wins
+    (``DMLC_PEAK_FLOPS`` / ``DMLC_PEAK_HBM_GBPS`` — operator
+    statements about the hardware), else the device-kind table in the
+    requested compute dtype, else — on the CPU backend only — a
+    one-time measured calibration, else None (unreported beats
+    wrong)."""
+    dt = _canon_dtype(dtype)
+    flops = bw = None
+    try:
+        env = get_env("DMLC_PEAK_FLOPS", None, float)
+        if env is not None and env > 0:
+            flops = env
+    except ParamError:
+        pass
+    try:
+        env = get_env("DMLC_PEAK_HBM_GBPS", None, float)
+        if env is not None and env > 0:
+            bw = env * 1e9
+    except ParamError:
+        pass
+    if flops is not None and bw is not None:
+        return flops, bw
+    platform = kind = None
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        platform, kind = dev.platform, dev.device_kind
+    except Exception:  # noqa: BLE001 - no jax / no backend
+        return flops, bw
+    peaks = DEVICE_PEAKS.get(kind)
+    if peaks is not None:
+        if flops is None:
+            flops = peaks.get(dt)
+        if bw is None:
+            bw = peaks["hbm_gbps"] * 1e9
+    elif platform == "cpu":
+        cal_flops, cal_bw = _calibrate_cpu()
+        if flops is None:
+            flops = cal_flops
+        if bw is None:
+            bw = cal_bw
+    return flops, bw
 
 
 class StepRecord(dict):
@@ -139,6 +241,10 @@ class StepLedger:
         self._flops_per_token: Optional[float] = None
         self._peak = peak_flops
         self._peak_resolved = peak_flops is not None
+        self._peak_declared = peak_flops is not None
+        self._dtype: Optional[str] = None
+        self._peak_bw: Optional[float] = None
+        self._peak_bw_resolved = False
         # dmlc-check: unguarded(one step_begin/step_end pair at a time — class docstring)
         self._open: Optional[Dict] = None
 
@@ -154,13 +260,40 @@ class StepLedger:
         with self._lock:
             self._peak = flops
             self._peak_resolved = True
+            self._peak_declared = True
+
+    def declare_dtype(self, dtype: Optional[str]) -> None:
+        """Declare the compute dtype the model actually runs in so MFU
+        normalizes against THAT peak (an f32 model judged against the
+        bf16 table column reports a wrong utilization).  Re-arms lazy
+        peak resolution; an explicit ``declare_peak_flops`` still
+        wins."""
+        with self._lock:
+            self._dtype = _canon_dtype(dtype) if dtype else None
+            if not self._peak_declared:
+                self._peak_resolved = False
+            self._peak_bw_resolved = False
 
     def peak_flops(self) -> Optional[float]:
         with self._lock:
             if not self._peak_resolved:
-                self._peak = detect_peak_flops()
+                if self._dtype is not None:
+                    self._peak, bw = detect_peaks(self._dtype)
+                    self._peak_bw = bw
+                    self._peak_bw_resolved = True
+                else:
+                    self._peak = detect_peak_flops()
                 self._peak_resolved = True
             return self._peak
+
+    def peak_membw(self) -> Optional[float]:
+        """Peak HBM bytes/s (None when unresolvable — membw_util and
+        the bound verdict stay unreported rather than wrong)."""
+        with self._lock:
+            if not self._peak_bw_resolved:
+                _, self._peak_bw = detect_peaks(self._dtype or "bf16")
+                self._peak_bw_resolved = True
+            return self._peak_bw
 
     # ---- the step protocol ---------------------------------------------
     def step_begin(self) -> None:
@@ -190,10 +323,15 @@ class StepLedger:
 
     def step_end(self, tokens: Optional[float] = None,
                  flops: Optional[float] = None,
-                 bytes_fed: Optional[float] = None) -> Optional[StepRecord]:
+                 bytes_fed: Optional[float] = None,
+                 bytes_accessed: Optional[float] = None
+                 ) -> Optional[StepRecord]:
         """Close the open step and append its record; returns it (None
         when no step was open).  ``tokens``/``flops``/``bytes_fed``
-        default to declared-FLOPs × tokens and the feed-counter delta."""
+        default to declared-FLOPs × tokens and the feed-counter delta.
+        ``bytes_accessed`` (the step executable's XLA cost-analysis
+        figure, telemetry.compute) adds the bandwidth half of the
+        roofline: ``membw_util`` and the ``bound`` verdict."""
         opened = self._open
         if opened is None:
             return None
@@ -276,9 +414,17 @@ class StepLedger:
                 flops = self._flops_per_token * tokens
         goodput = tokens / wall if tokens else None
         # peak resolution can import jax (device-kind probe): only pay
-        # it when a FLOPs figure actually needs normalizing
+        # it when a figure actually needs normalizing
         peak = self.peak_flops() if flops else None
         mfu = (flops / wall / peak) if (flops and peak) else None
+        peak_bw = self.peak_membw() if bytes_accessed else None
+        membw_util = (bytes_accessed / wall / peak_bw) \
+            if (bytes_accessed and peak_bw) else None
+        bound = None
+        if flops and bytes_accessed and peak and peak_bw:
+            # roofline verdict: arithmetic intensity vs machine balance
+            bound = "memory" if (flops / bytes_accessed) \
+                < (peak / peak_bw) else "compute"
 
         with self._lock:
             self._seq += 1
@@ -294,8 +440,12 @@ class StepLedger:
                 bytes_fed=float(bytes_fed),
                 tokens=float(tokens) if tokens is not None else None,
                 flops=float(flops) if flops is not None else None,
+                bytes_accessed=float(bytes_accessed)
+                if bytes_accessed is not None else None,
                 goodput_tokens_per_s=goodput,
                 mfu=mfu,
+                membw_util=membw_util,
+                bound=bound,
             )
             self._records.append(rec)
         self._publish(rec)
@@ -318,6 +468,12 @@ class StepLedger:
                            rec["goodput_tokens_per_s"])
         if rec["mfu"] is not None:
             core.set_gauge("step", "mfu_pct", 100.0 * rec["mfu"])
+        if rec.get("membw_util") is not None:
+            core.set_gauge("step", "membw_util_pct",
+                           100.0 * rec["membw_util"])
+        if rec.get("bound") is not None:
+            core.set_gauge("step", "memory_bound",
+                           1.0 if rec["bound"] == "memory" else 0.0)
 
     # ---- views ----------------------------------------------------------
     def records(self) -> List[StepRecord]:
@@ -371,7 +527,36 @@ class StepLedger:
                 / max(sum(r["wall_s"] for r in toks), 1e-9))
         mfus = [r["mfu"] for r in recs if r["mfu"] is not None]
         out["mfu"] = sum(mfus) / len(mfus) if mfus else None
+        mbs = [r["membw_util"] for r in recs
+               if r.get("membw_util") is not None]
+        out["membw_util"] = sum(mbs) / len(mbs) if mbs else None
+        out["bound"] = next((r["bound"] for r in reversed(recs)
+                             if r.get("bound") is not None), None)
         return out
+
+    def roofline_summary(self) -> Dict:
+        """The roofline view /compute reports: resolved peaks + the
+        window's utilization figures and latest bound verdict."""
+        recs = self.records()
+        with self._lock:
+            dtype = self._dtype
+        latest = next((r for r in reversed(recs)
+                       if r.get("flops") and r.get("bytes_accessed")),
+                      None)
+        mfus = [r["mfu"] for r in recs if r.get("mfu") is not None]
+        mbs = [r["membw_util"] for r in recs
+               if r.get("membw_util") is not None]
+        return {
+            "dtype": dtype,
+            "peak_flops": self.peak_flops(),
+            "peak_membw_bytes_per_s": self.peak_membw(),
+            "mfu": sum(mfus) / len(mfus) if mfus else None,
+            "membw_util": sum(mbs) / len(mbs) if mbs else None,
+            "intensity": (latest["flops"] / latest["bytes_accessed"])
+            if latest else None,
+            "bound": next((r["bound"] for r in reversed(recs)
+                           if r.get("bound") is not None), None),
+        }
 
     def reset(self) -> None:
         with self._lock:
@@ -397,9 +582,12 @@ def step_begin() -> None:
 
 
 def step_end(tokens: Optional[float] = None, flops: Optional[float] = None,
-             bytes_fed: Optional[float] = None) -> Optional[StepRecord]:
+             bytes_fed: Optional[float] = None,
+             bytes_accessed: Optional[float] = None
+             ) -> Optional[StepRecord]:
     return _default.step_end(tokens=tokens, flops=flops,
-                             bytes_fed=bytes_fed)
+                             bytes_fed=bytes_fed,
+                             bytes_accessed=bytes_accessed)
 
 
 def declare_flops_per_token(flops: float) -> None:
@@ -408,6 +596,10 @@ def declare_flops_per_token(flops: float) -> None:
 
 def declare_peak_flops(flops: Optional[float]) -> None:
     _default.declare_peak_flops(flops)
+
+
+def declare_dtype(dtype: Optional[str]) -> None:
+    _default.declare_dtype(dtype)
 
 
 def reset_steps() -> None:

@@ -67,7 +67,10 @@ PASS_ENVS = [
     "DMLC_FAULT_SPEC", "DMLC_TELEMETRY_MAX_SPANS",
     "DMLC_TELEMETRY_MAX_EVENTS", "DMLC_TELEMETRY_SHIP_TRACE",
     "DMLC_TELEMETRY_MAX_BEAT_BYTES", "DMLC_POSTMORTEM_DIR",
-    "DMLC_STEP_LEDGER_MAX", "DMLC_PEAK_FLOPS", "DMLC_LOCKCHECK",
+    "DMLC_STEP_LEDGER_MAX", "DMLC_PEAK_FLOPS", "DMLC_PEAK_HBM_GBPS",
+    "DMLC_COMPUTE_PROFILE", "DMLC_COMPUTE_TRACE_PHASES",
+    "DMLC_COMPUTE_STORM_WINDOW_S", "DMLC_COMPUTE_STORM_TRACES",
+    "DMLC_LOCKCHECK",
     "DMLC_LOCKCHECK_BLOCK_S", "DMLC_RACECHECK",
     "DMLC_RACECHECK_MAX_SITES", "DMLC_FLASH_BH_BLOCK",
     "DMLC_FLASH_BLOCK_Q", "DMLC_FLASH_BLOCK_K",

@@ -347,10 +347,11 @@ class RabitTracker:
                 self.telemetry, host=host_ip, port=metrics_port,
                 trace_source=self.flight.to_chrome_trace,
                 anomaly_source=self.watchdog.report,
-                resize_handler=self._http_resize)
+                resize_handler=self._http_resize,
+                compute_source=self.watchdog.compute_report)
             self.metrics_port = self.metrics_server.port
-            logger.info("tracker /metrics + /trace + /anomalies on %s:%d",
-                        host_ip, self.metrics_port)
+            logger.info("tracker /metrics + /trace + /anomalies + "
+                        "/compute on %s:%d", host_ip, self.metrics_port)
         logger.info("tracker listening on %s:%d", host_ip, self.port)
 
     def worker_envs(self) -> Dict[str, str]:
@@ -675,6 +676,11 @@ class RabitTracker:
                         # dmlc top) show what the worker DID about a
                         # flagged step, not just that one fired
                         self.watchdog.ingest_remediation(w.rank, sh)
+                    comp = doc.get("compute")
+                    if isinstance(comp, dict):
+                        # compile-ledger status: feeds the watchdog's
+                        # recompile_storm flag and the /compute view
+                        self.watchdog.ingest_compute(w.rank, comp)
                     trace = doc.get("trace")
                     if isinstance(trace, dict):
                         self.flight.ingest(w.rank, trace, host=w.host)

@@ -24,6 +24,12 @@ active violations highlighted.  Against a tracker, serving replicas'
 SLO flags (``slo_ttft``/``slo_tbt``/``slo_error_rate``) appear in the
 per-rank FLAGS column via the heartbeat-shipped status.
 
+Either target also feeds a **compute pane** from ``/compute``: compile
+ledger totals (traces/hits/recompiles), the recompile-storm verdict,
+the step roofline (``mfu``/``membw_util``/``bound``), HBM peak and
+headroom, and the decode phase time shares; against a tracker the same
+pane shows per-rank recompile totals and storm-flagged ranks.
+
 Runs full-screen (curses) when stdout is a TTY; ``--plain`` prints one
 table per refresh instead (pipe-friendly, and what the CI smoke
 drives).  ``--once`` renders a single refresh and exits.
@@ -39,7 +45,8 @@ import sys
 import time
 import urllib.request
 
-__all__ = ["fetch", "render_table", "render_serving_pane", "main"]
+__all__ = ["fetch", "render_table", "render_serving_pane",
+           "render_compute_pane", "main"]
 
 COLUMNS = ("RANK", "STEP ms", "EWMA ms", "GOODPUT", "MFU%", "FEED%",
            "HB AGE", "FLAGS", "REMED")
@@ -70,7 +77,8 @@ def fetch(base_url: str, timeout: float = 5.0) -> dict:
     mid-watch."""
     out = {}
     for key, path in (("anomalies", "/anomalies"), ("healthz", "/healthz"),
-                      ("requests", "/requests"), ("slo", "/slo")):
+                      ("requests", "/requests"), ("slo", "/slo"),
+                      ("compute", "/compute")):
         try:
             with urllib.request.urlopen(base_url + path,
                                         timeout=timeout) as r:
@@ -125,6 +133,64 @@ def render_serving_pane(doc: dict) -> list:
     return lines
 
 
+def render_compute_pane(doc: dict) -> list:
+    """The compute pane lines: compile-ledger totals, the recompile-
+    storm verdict, the roofline verdict and HBM headroom.  Handles both
+    a replica's local ``/compute`` document (``sites``/``roofline``)
+    and the tracker's cluster shape (``ranks``); empty when the target
+    serves neither."""
+    comp = doc.get("compute") or {}
+    if not comp:
+        return []
+
+    def gb(v):
+        return (f"{v / (1 << 30):.2f}GiB"
+                if isinstance(v, (int, float)) else "-")
+
+    lines = []
+    if "sites" in comp:  # replica-local document
+        storm = comp.get("storm") or {}
+        storm_txt = ("STORM " + ",".join(
+            s.get("site", "?") for s in storm.get("sites") or [])
+            if storm.get("active") else "ok")
+        hbm = comp.get("hbm") or {}
+        lines.append(
+            "compute  traces={} hits={} recompiles={} storm={} "
+            "hbm_peak={} headroom={}".format(
+                comp.get("traces_total", 0),
+                comp.get("cache_hits_total", 0),
+                comp.get("recompiles_total", 0), storm_txt,
+                gb(hbm.get("peak_bytes")), gb(hbm.get("headroom_bytes"))))
+        roof = comp.get("roofline") or {}
+        if roof.get("bound"):
+            mfu = roof.get("mfu")
+            bw = roof.get("membw_util")
+            lines.append(
+                "roofline {} bound  mfu={} membw_util={} "
+                "intensity={}".format(
+                    roof["bound"],
+                    _num(mfu * 100 if isinstance(mfu, (int, float))
+                         else None, "{:.1f}%"),
+                    _num(bw * 100 if isinstance(bw, (int, float))
+                         else None, "{:.1f}%"),
+                    _num(roof.get("intensity"), "{:.1f}")))
+        shares = (comp.get("phases") or {}).get("shares") or {}
+        if shares:
+            lines.append("phases   " + "  ".join(
+                f"{p}={v * 100:.0f}%" for p, v in sorted(
+                    shares.items(), key=lambda kv: -kv[1])))
+    elif comp.get("ranks"):  # tracker cluster document
+        storming = comp.get("storming_ranks") or []
+        parts = []
+        for r, st in sorted(comp["ranks"].items(), key=lambda kv: kv[0]):
+            st = st or {}
+            parts.append(f"r{r}:{st.get('recompiles', 0)}")
+        lines.append(
+            "compute  recompiles " + " ".join(parts)
+            + (f"  STORM ranks={storming}" if storming else "  storm=ok"))
+    return lines
+
+
 def render_table(doc: dict, base_url: str = "") -> str:
     """The poll document as fixed-width text (one refresh)."""
     an = doc.get("anomalies") or {}
@@ -167,6 +233,7 @@ def render_table(doc: dict, base_url: str = "") -> str:
         lines.append(f"  ! rank {v.get('rank')} {v.get('kind')}: "
                      f"{v.get('detail', '')}")
     lines.extend(render_serving_pane(doc))
+    lines.extend(render_compute_pane(doc))
     return "\n".join(lines)
 
 

@@ -21,7 +21,14 @@ generator, and asserts the acceptance contract:
     are positive and bounded, per-status HTTP counters land on
     /metrics, each request draws its own row on the Chrome /trace,
     and an injected-delay burst trips EXACTLY one SLO anomaly kind
-    (slo_ttft) through the burn-rate monitor behind /slo.
+    (slo_ttft) through the burn-rate monitor behind /slo,
+  * compute observability (PR 16): a bucket-sweeping warmup absorbs
+    every jit signature, after which the measured load is
+    recompile-free (/compute recompiles_total flat), XLA cost
+    analysis + pinned peaks call decode memory-bound on /compute AND
+    in BENCH_serving.json (decode_membw_util/decode_bound/recompiles/
+    hbm_peak_bytes), the dmlc_compute_* families land on /metrics,
+    and dmlc-top renders the compute pane.
 
 Runs in ~1 min on 2 CPU cores.  Usage: python scripts/serving_smoke.py
 """
@@ -36,6 +43,15 @@ import urllib.request
 # a nominal one (pre-import: telemetry resolves it lazily but env must
 # win).  A real deployment sets this to the accelerator's datasheet.
 os.environ.setdefault("DMLC_PEAK_FLOPS", "5e10")
+# roofline verdict: pin a small bandwidth so the machine balance
+# (5e10/2e9 = 25 flops/byte) sits far above decode's arithmetic
+# intensity (<1 on this tiny model) — decode must read memory-bound
+# regardless of which CPU runs the smoke
+os.environ.setdefault("DMLC_PEAK_HBM_GBPS", "2")
+# the bucket-sweeping warmup legitimately compiles ~9 signatures in
+# well under the 60 s storm window; only an actual per-step churn
+# should trip the storm detector here
+os.environ.setdefault("DMLC_COMPUTE_STORM_TRACES", "16")
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # generous SLOs for the main load phase (nothing should trip); the
 # injected-delay phase below builds its OWN tight monitor
@@ -78,18 +94,30 @@ def main():
     server = ServingHTTPServer(engine, port=0)
     print(f"serving_smoke: endpoint {server.url}")
 
-    # warmup: absorb the prefill/decode jit compiles for the length
-    # buckets the load will hit, so measured TTFT is steady-state
-    warm = LoadGenerator(server.url, n_streams=2, requests_per_stream=1,
-                         prompt_len=(4, 28), max_tokens=4,
-                         vocab=cfg.vocab, seed=99)
-    warm.run()
-    assert not warm.failures, f"warmup failed: {warm.failures[:2]}"
+    # warmup: absorb the prefill/decode jit compiles for EVERY padding
+    # bucket the load can hit (prompts 4..28 pad to {8,16,24,32} with
+    # block_size=8; decode contexts gather in whole 8-token blocks up
+    # to 28+12=40), so the measured phase is steady-state — and, the
+    # PR 16 gate, compiles ZERO new signatures
+    for length in (4, 12, 20, 28):
+        warm = LoadGenerator(server.url, n_streams=1,
+                             requests_per_stream=1,
+                             prompt_len=(length, length),
+                             max_tokens=MAX_TOKENS,
+                             vocab=cfg.vocab, seed=99 + length)
+        warm.run()
+        assert not warm.failures, f"warmup failed: {warm.failures[:2]}"
     # the request ledger must cover the SAME population as the client
     # summary it is joined with in BENCH_serving.json — drop the
     # warmup/compile requests, or the server-side percentiles would
     # exceed the client-side ones they decompose
     engine.requests.reset()
+    # the compile-ledger watermark the steady-state gate compares to
+    comp_warm = json.loads(urllib.request.urlopen(
+        server.url + "/compute", timeout=30).read())
+    recompiles_warm = comp_warm["recompiles_total"]
+    assert comp_warm["traces_total"] >= 2, (
+        "warmup compiled nothing through the profiled jit sites")
 
     gen = LoadGenerator(server.url, n_streams=N_STREAMS,
                         requests_per_stream=REQS_PER_STREAM,
@@ -187,7 +215,13 @@ def main():
                 "dmlc_serving_http_200", "dmlc_serving_kv_occupancy_pct",
                 "dmlc_serving_kv_waste_tokens", "dmlc_slo_burn_rate",
                 "dmlc_slo_violation_active",
-                "dmlc_slo_objective_threshold"):
+                "dmlc_slo_objective_threshold",
+                # PR 16 families: compile ledger + roofline + HBM
+                "dmlc_compute_traces_total",
+                "dmlc_compute_cache_hits_total",
+                "dmlc_compute_recompiles_total",
+                "dmlc_serving_decode_signatures",
+                "dmlc_step_membw_util_pct"):
         assert fam in text, f"{fam} missing from /metrics"
     def scalar(name):
         for line in text.splitlines():
@@ -202,6 +236,42 @@ def main():
         f"mean decode batch {batch_sum / batch_count:.2f} <= 1: requests "
         "were serialized, not continuously batched")
 
+    # compute ledger (PR 16): the warmup swept every padding bucket,
+    # so the measured load must be recompile-free; the XLA cost
+    # analysis + pinned peaks must call decode memory-bound; HBM and
+    # phase accounting must be populated
+    comp = json.loads(urllib.request.urlopen(
+        server.url + "/compute", timeout=30).read())
+    assert comp["enabled"], "/compute reports the profile disabled"
+    for site in ("serving.prefill", "serving.decode"):
+        st = comp["sites"].get(site)
+        assert st and st["traces"] >= 1, f"/compute missing site {site}"
+        assert st["hits"] > 0, f"{site}: no jit cache hits recorded"
+        assert st["last_cost"] and st["last_cost"].get("flops") and \
+            st["last_cost"].get("bytes_accessed"), (
+                f"{site}: XLA cost analysis missing: {st}")
+    assert comp["recompiles_total"] == recompiles_warm, (
+        f"steady-state load recompiled ({recompiles_warm} -> "
+        f"{comp['recompiles_total']}); last signatures: "
+        f"{ {s: v['last_signature'] for s, v in comp['sites'].items()} }")
+    assert not comp["storm"]["active"], (
+        f"recompile storm flagged: {comp['storm']}")
+    roof = comp["roofline"]
+    assert roof["bound"] == "memory", (
+        f"decode must read memory-bound under the pinned peaks: {roof}")
+    assert roof["membw_util"] and roof["mfu"], f"roofline nulls: {roof}"
+    assert comp["hbm"] and comp["hbm"].get("peak_bytes"), (
+        f"HBM accounting empty: {comp.get('hbm')}")
+    shares = comp["phases"]["shares"]
+    assert shares and abs(sum(shares.values()) - 1.0) < 1e-6, (
+        f"phase shares must normalize to 1: {shares}")
+    assert shares.get("attention", 0) > 0 and shares.get("mlp", 0) > 0, (
+        f"estimated device phases missing from shares: {shares}")
+    print("serving_smoke: /compute "
+          f"bound={roof['bound']} membw_util={roof['membw_util']:.3f} "
+          f"recompiles={comp['recompiles_total']} (flat across load) "
+          f"hbm_peak={comp['hbm']['peak_bytes']:,} B")
+
     bench_path = os.path.join(REPO, "BENCH_serving.json")
     doc = gen.emit_bench(bench_path, summary, extra={
         "model": {"d_model": cfg.d_model, "n_layers": cfg.n_layers,
@@ -214,8 +284,16 @@ def main():
                 # surface serving optimisations are judged on
                 "queue_wait_p99_s", "server_ttft_p99_s", "tbt_p50_s",
                 "tbt_p99_s", "preemption_rate", "kv_occupancy",
-                "kv_waste_tokens", "client_server_delta_p50_s"):
+                "kv_waste_tokens", "client_server_delta_p50_s",
+                # PR 16: the roofline/compile-ledger join
+                "decode_membw_util", "decode_bound", "recompiles",
+                "hbm_peak_bytes"):
         assert doc.get(key) is not None, f"BENCH key {key} missing/null"
+    assert doc["decode_bound"] == "memory", (
+        f"BENCH decode_bound {doc['decode_bound']!r} != 'memory'")
+    assert doc["recompiles"] == recompiles_warm, (
+        "BENCH recompiles moved after warmup: "
+        f"{recompiles_warm} -> {doc['recompiles']}")
     # both TTFT p99s now cover the same 24-request population (the
     # ledger was reset after warmup), measured by two independent
     # clocks — they must agree
@@ -236,6 +314,8 @@ def main():
     pane = dmlc_top.render_table(dmlc_top.fetch(server.url), server.url)
     assert "serving " in pane and "slo " in pane, (
         f"dmlc-top serving pane missing:\n{pane}")
+    assert "compute " in pane and "roofline" in pane, (
+        f"dmlc-top compute pane missing:\n{pane}")
     print("serving_smoke: dmlc-top pane:\n"
           + "\n".join(pane.splitlines()[-2:]))
 

@@ -22,7 +22,13 @@ fault-injected (``DMLC_FAULT_SPEC`` delay) to be a straggler — then:
      rows, monotone non-negative clock-corrected timestamps, and the
      watchdog's anomaly marker row;
   6. exports the smoke process's own spans as Chrome trace JSON and
-     validates it is well-formed with >= 1 complete ("X") event.
+     validates it is well-formed with >= 1 complete ("X") event;
+  7. (PR 16) rank 1 churns six fresh shapes through a profiled jit
+     site: the compile ledger's ``compile:smoke.churn`` spans reach
+     the cluster /trace, the heartbeat-shipped compute doc trips a
+     ``recompile_storm`` flag on rank 1 ONLY (/anomalies + the
+     dmlc_anomaly_recompile_storm_flags family + tracker /compute
+     ``storming_ranks``), and dmlc-top renders the compute pane.
 
 Both workers run under ``DMLC_LOCKCHECK=1`` (the runtime lock-order
 watchdog instruments every ``concurrency.make_lock`` lock) AND
@@ -71,6 +77,18 @@ for i in range(20):
 # push + NTP clock sample) and must appear on the tracker's /trace
 with telemetry.span("smoke.work.r%d" % c.rank, stage="smoke"):
     time.sleep(0.05)
+# rank 1 churns shapes through a profiled jit site: each novel shape
+# is a fresh XLA signature, so the compile ledger records the traces
+# (with compile:smoke.churn spans for /trace), the heartbeat ships
+# the compute doc, and the tracker watchdog must flag a
+# recompile_storm on THIS rank only — rank 0 never touches jax and
+# so never even grows a compute doc
+if c.rank == 1:
+    import jax.numpy as jnp
+    from dmlc_tpu.telemetry import compute as _compute
+    churn = _compute.profiled_jit(lambda x: x * 2.0, site="smoke.churn")
+    for n in range(1, 7):
+        churn(jnp.zeros((n,), jnp.float32))
 hb = HeartbeatSender(c, interval=0.2)
 # drive the step ledger: DMLC_FAULT_SPEC delays rank 1's every step,
 # so the tracker watchdog must flag it (and only it) as a straggler
@@ -129,7 +147,10 @@ def validate_merged_trace(url: str) -> None:
         fail(f"/trace has spans from pids {worker_pids} (< 2 worker "
              f"ranks); events:\n{json.dumps(evs)[:2000]}")
     names = {e["name"] for e in evs}
-    for want in ("smoke.work.r0", "smoke.work.r1", "step"):
+    for want in ("smoke.work.r0", "smoke.work.r1", "step",
+                 # rank 1's churned compiles draw real spans: compile
+                 # wall time is attributable on the cluster trace
+                 "compile:smoke.churn"):
         if want not in names:
             fail(f"/trace missing worker span {want!r}; got {sorted(names)}")
     if any(e["ts"] < 0 for e in evs):
@@ -182,6 +203,34 @@ def validate_anomalies(url: str) -> None:
           f"step_time={r1['step_time_s']:.3f}s vs cluster median "
           f"{doc['cluster']['median_step_s']:.3f}s; rank 0 clean)")
 
+    # PR 16: rank 1's shape churn crossed the storm threshold — the
+    # compute doc rode the heartbeats and the watchdog must flag a
+    # recompile_storm on rank 1 (and never on rank 0, which runs no
+    # profiled jit sites at all)
+    while time.time() < deadline:
+        doc = json.loads(urllib.request.urlopen(f"{url}/anomalies").read())
+        flags1 = (doc.get("ranks", {}).get("1", {}) or {}).get("flags", [])
+        if "recompile_storm" in flags1:
+            break
+        time.sleep(0.2)
+    else:
+        fail(f"watchdog never flagged rank 1's recompile storm; "
+             f"/anomalies:\n{json.dumps(doc)[:3000]}")
+    flags0 = (doc.get("ranks", {}).get("0", {}) or {}).get("flags", [])
+    if "recompile_storm" in flags0:
+        fail(f"rank 0 falsely flagged as storming: {flags0}")
+    comp1 = (doc["ranks"]["1"] or {}).get("compute") or {}
+    if not isinstance(comp1.get("traces"), (int, float)) \
+            or comp1["traces"] < 4:
+        fail(f"/anomalies rank 1 compute doc missing traces: {comp1}")
+    cdoc = json.loads(urllib.request.urlopen(f"{url}/compute").read())
+    if cdoc.get("storming_ranks") != [1]:
+        fail(f"tracker /compute storming_ranks != [1]: {cdoc}")
+    if "1" not in (cdoc.get("ranks") or {}):
+        fail(f"tracker /compute lacks rank 1's doc: {cdoc}")
+    print(f"telemetry smoke: /compute OK (rank 1 storm after "
+          f"{comp1['traces']} traces; rank 0 clean)")
+
 
 def validate_dmlc_top(url: str) -> None:
     """One plain-mode ``dmlc top`` refresh against the live tracker."""
@@ -204,8 +253,11 @@ def validate_dmlc_top(url: str) -> None:
     if not straggler_rows:
         fail(f"dmlc-top does not show rank 1's straggler flag:\n"
              f"{out[:2000]}")
+    if "compute " not in out or "STORM ranks=[1]" not in out:
+        fail(f"dmlc-top compute pane missing rank 1's storm:\n"
+             f"{out[:2000]}")
     print("telemetry smoke: dmlc-top OK (one plain refresh, straggler "
-          "flag visible)")
+          "flag + compute storm visible)")
     print("\n".join("    " + line for line in out.splitlines()[:6]))
 
 
@@ -225,6 +277,11 @@ def main() -> None:
     env["DMLC_LOCKCHECK"] = "1"
     # ... and a clean racecheck (attribute→lock pairing) report too
     env["DMLC_RACECHECK"] = "1"
+    # rank 1's shape churn needs a jax backend; CPU keeps it hermetic.
+    # 6 churned shapes against a threshold of 4 traces/window makes the
+    # storm verdict deterministic even if the ambient env raised it
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DMLC_COMPUTE_STORM_TRACES"] = "4"
     workers = [
         subprocess.Popen(
             [sys.executable, "-c",
@@ -265,7 +322,9 @@ def main() -> None:
                  'dmlc_step_goodput_tokens_per_s{rank="1"}',
                  'dmlc_anomaly_active{rank="1",kind="straggler"} 1',
                  'dmlc_anomaly_active{rank="0",kind="straggler"} 0',
-                 'dmlc_anomaly_straggler_flags{rank="tracker"}'):
+                 'dmlc_anomaly_straggler_flags{rank="tracker"}',
+                 'dmlc_anomaly_active{rank="1",kind="recompile_storm"} 1',
+                 'dmlc_anomaly_recompile_storm_flags{rank="tracker"}'):
         if want not in body:
             fail(f"missing {want!r} in /metrics payload")
     print(f"telemetry smoke: /metrics OK ({n} samples, strict exposition)")

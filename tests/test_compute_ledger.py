@@ -1,0 +1,375 @@
+"""Compute observability (telemetry.compute): compile ledger, XLA
+cost/roofline, HBM accounting, phase decomposition (PR 16).
+
+Everything runs on the virtual CPU mesh: the AOT compile path,
+cost_analysis extraction, the host-RSS memory fallback and the storm
+detector are all backend-agnostic, which is exactly the property the
+profiling layer must keep (profiling can never be allowed to break the
+model on ANY backend).
+"""
+
+import importlib.util
+import json
+import logging
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dmlc_tpu import telemetry
+from dmlc_tpu.base import DMLCError
+from dmlc_tpu.telemetry import compute
+from dmlc_tpu.telemetry.anomaly import COMPUTE_KINDS, Watchdog
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    telemetry.reset()
+    telemetry.reset_events()
+    compute.reset_compute()
+    yield
+    telemetry.reset()
+    telemetry.reset_events()
+    compute.reset_compute()
+
+
+def _load_top():
+    spec = importlib.util.spec_from_file_location(
+        "compute_top_fixture", os.path.join(
+            os.path.dirname(__file__), "..", "scripts", "dmlc_top.py"))
+    top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(top)
+    return top
+
+
+# ---------------------------------------------------------------------------
+# compile ledger: hit/trace counting + recompile attribution
+# ---------------------------------------------------------------------------
+
+def test_profiled_jit_counts_hits_and_traces():
+    pj = compute.profiled_jit(lambda x: x * 2.0, site="t.basic")
+    x = jnp.arange(4, dtype=jnp.float32)
+    for _ in range(3):
+        assert float(pj(x)[0]) == 0.0
+    st = pj.stats()
+    assert st["traces"] == 1 and st["hits"] == 2
+    assert st["recompiles"] == 0 and st["signatures"] == 1
+    assert compute.sites()["t.basic"] is pj
+    assert compute.recompiles_total() == 0
+
+
+def test_recompile_attributed_to_signature():
+    pj = compute.profiled_jit(lambda x: x + 1, site="t.attr")
+    pj(jnp.zeros((4,), jnp.float32))
+    pj(jnp.zeros((8,), jnp.float32))     # new shape -> recompile
+    pj(jnp.zeros((8,), jnp.int32))       # new dtype -> recompile
+    st = pj.stats()
+    assert st["traces"] == 3 and st["recompiles"] == 2
+    # the LAST recompile is attributed to the (shape, dtype) that
+    # triggered it, human-readably
+    assert "8" in st["last_signature"] and "int32" in st["last_signature"]
+    assert compute.recompiles_total() == 2
+
+
+def test_static_args_split_signatures():
+    calls = []
+
+    def f(x, n):
+        calls.append(n)
+        return x * n
+
+    pj = compute.profiled_jit(f, site="t.static", static_argnums=(1,))
+    x = jnp.ones((2,), jnp.float32)
+    assert float(pj(x, 2)[0]) == 2.0
+    assert float(pj(x, 3)[0]) == 3.0     # same aval, new static value
+    assert float(pj(x, 2)[0]) == 2.0     # cache hit on the first
+    st = pj.stats()
+    assert st["traces"] == 2 and st["hits"] == 1
+
+
+def test_unhashable_static_falls_back_like_plain_jit():
+    pj = compute.profiled_jit(lambda x, n: x, site="t.unhash",
+                              static_argnums=(1,))
+    with pytest.raises(Exception):  # jax's own unhashable-static error
+        pj(jnp.ones((2,)), [1, 2])
+    assert pj.stats()["aot_fallbacks"] >= 1
+
+
+def test_signature_cap_raises_dmlc_error():
+    pj = compute.profiled_jit(lambda x: x, site="t.cap",
+                              max_signatures=2)
+    pj(jnp.zeros((1,), jnp.float32))
+    pj(jnp.zeros((2,), jnp.float32))
+    with pytest.raises(DMLCError, match="signature cap"):
+        pj(jnp.zeros((3,), jnp.float32))
+    # the capped site still serves its existing signatures
+    assert float(pj(jnp.zeros((2,), jnp.float32))[0]) == 0.0
+
+
+def test_compile_span_lands_on_flight_recorder():
+    pj = compute.profiled_jit(lambda x: x * x, site="t.span")
+    pj(jnp.ones((3,), jnp.float32))
+    trace = json.loads(telemetry.to_chrome_trace_json())
+    names = {e["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "X"}
+    assert "compile:t.span" in names
+
+
+def test_reregister_survives_reset():
+    pj = compute.profiled_jit(lambda x: x, site="t.rereg")
+    pj(jnp.zeros((2,), jnp.float32))
+    compute.reset_compute()
+    assert compute.sites() == {}
+    pj.reregister()   # what the engine's process-wide program cache does
+    assert compute.sites()["t.rereg"] is pj
+    assert pj.stats()["traces"] == 1  # ledger state rode along
+
+
+# ---------------------------------------------------------------------------
+# XLA cost extraction + roofline verdicts
+# ---------------------------------------------------------------------------
+
+def test_cost_extraction_on_cpu():
+    pj = compute.profiled_jit(lambda a, b: a @ b, site="t.cost")
+    a = jnp.ones((16, 16), jnp.float32)
+    pj(a, a)
+    cost = pj.stats()["last_cost"]
+    assert cost is not None
+    # a 16x16x16 matmul is ~2*16^3 = 8192 flops; XLA may fuse a bit
+    # around it but the figure must be in that ballpark, not zero
+    assert cost["flops"] >= 4096
+    assert cost["bytes_accessed"] > 0
+
+
+def test_roofline_both_verdicts():
+    # intensity 100 flops/byte against balance 10 -> compute-bound
+    r = compute.roofline(flops=1e6, bytes_accessed=1e4, wall_s=1.0,
+                         peak_flops=1e7, peak_bw=1e6)
+    assert r["bound"] == "compute"
+    assert r["mfu"] == pytest.approx(0.1)
+    # intensity 0.1 against the same balance -> memory-bound
+    r = compute.roofline(flops=1e3, bytes_accessed=1e4, wall_s=1.0,
+                         peak_flops=1e7, peak_bw=1e6)
+    assert r["bound"] == "memory"
+    assert r["membw_util"] == pytest.approx(0.01)
+    assert r["intensity"] == pytest.approx(0.1)
+
+
+def test_roofline_degrades_to_none():
+    r = compute.roofline(None, None, 1.0, None, None)
+    assert r["bound"] is None and r["mfu"] is None
+    r = compute.roofline(1e6, 1e4, 0.0, 1e7, 1e6)  # bad wall
+    assert r["bound"] is None
+
+
+def test_step_ledger_carries_membw_and_bound(monkeypatch):
+    monkeypatch.setenv("DMLC_PEAK_FLOPS", "1e9")
+    monkeypatch.setenv("DMLC_PEAK_HBM_GBPS", "1")  # 1e9 B/s, balance=1
+    telemetry.reset_steps()
+    telemetry.step_begin()
+    time.sleep(0.001)
+    telemetry.step_end(tokens=128, flops=1e5, bytes_accessed=1e7)
+    summ = telemetry.ledger().summary()
+    assert summ["bound"] == "memory"       # intensity 0.01 < balance 1
+    assert summ["membw_util"] is not None and summ["membw_util"] > 0
+    roof = telemetry.ledger().roofline_summary()
+    assert roof["bound"] == "memory"
+    assert roof["peak_flops"] == pytest.approx(1e9)
+    assert roof["peak_membw_bytes_per_s"] == pytest.approx(1e9)
+
+
+# ---------------------------------------------------------------------------
+# HBM accounting
+# ---------------------------------------------------------------------------
+
+def test_sample_hbm_reports_peak_and_gauges():
+    doc = compute.sample_hbm()
+    assert doc["source"] in ("device", "host_rss")
+    assert doc["peak_bytes"] and doc["peak_bytes"] > 0
+    snap = telemetry.export_json()
+    assert snap["gauges"]["compute"]["hbm_peak_bytes"] > 0
+
+
+def test_sample_hbm_host_rss_fallback(monkeypatch):
+    # a backend whose devices report no memory_stats: the sample must
+    # degrade to the host-RSS proxy, flagged as such, never go dark
+    monkeypatch.setattr(jax, "local_devices",
+                        lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    doc = compute.sample_hbm(publish=False)
+    assert doc["source"] == "host_rss" and not doc["available"]
+    assert doc["peak_bytes"] and doc["peak_bytes"] > 0
+    assert doc["limit_bytes"] and doc["limit_bytes"] > doc["peak_bytes"]
+    assert doc["headroom_bytes"] is not None
+
+
+# ---------------------------------------------------------------------------
+# phase decomposition
+# ---------------------------------------------------------------------------
+
+def test_phase_shares_mix_measured_and_estimated():
+    with compute.phase("gather"):
+        time.sleep(0.002)
+    # analytic split of a 10ms device residual by FLOP fractions
+    compute.phase_estimate({"attention": 3.0, "mlp": 6.0,
+                            "unembed": 1.0}, 0.010)
+    shares = compute.phase_shares()
+    assert set(shares) == set(compute.PHASES)
+    assert sum(shares.values()) == pytest.approx(1.0)
+    assert shares["mlp"] > shares["attention"] > shares["unembed"]
+    assert shares["gather"] > 0
+    assert shares["sampling"] == 0.0
+
+
+def test_phase_estimate_ignores_garbage():
+    compute.phase_estimate({}, 1.0)
+    compute.phase_estimate({"attention": 0.0}, 1.0)
+    compute.phase_estimate({"attention": 1.0}, -1.0)
+    assert compute.phase_shares() == {}
+
+
+def test_decode_phase_flops_sums_to_decode_flops():
+    from dmlc_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=2,
+                                head_dim=8, d_ff=64, n_layers=2,
+                                n_experts=1, dtype="float32")
+    shares = tfm.decode_phase_flops(cfg, ctx=40)
+    assert set(shares) == {"attention", "mlp", "unembed"}
+    assert sum(shares.values()) == pytest.approx(
+        tfm.decode_flops_per_token(cfg, 40))
+
+
+# ---------------------------------------------------------------------------
+# views: status / report / prometheus text
+# ---------------------------------------------------------------------------
+
+def test_status_empty_without_sites():
+    assert compute.status() == {}
+
+
+def test_status_and_report_schema():
+    pj = compute.profiled_jit(lambda x: x + 1, site="t.schema")
+    pj(jnp.zeros((2,), jnp.float32))
+    pj(jnp.zeros((4,), jnp.float32))
+    compute.sample_hbm()
+    st = compute.status()
+    assert st["traces"] == 2 and st["recompiles"] == 1
+    assert "storm" in st and st["hbm_peak_bytes"] > 0
+    rep = compute.report()
+    assert rep["enabled"] and "t.schema" in rep["sites"]
+    assert rep["traces_total"] == 2
+    assert rep["recompiles_total"] == 1
+    assert rep["storm"]["threshold"] >= 1
+    assert rep["hbm"]["peak_bytes"] > 0
+    assert set(rep["phases"]) == {"shares", "estimated", "measured"}
+    assert "bound" in rep["roofline"]
+
+
+def test_storm_detector_trips_on_churn(monkeypatch):
+    monkeypatch.setenv("DMLC_COMPUTE_STORM_TRACES", "3")
+    pj = compute.profiled_jit(lambda x: x, site="t.storm")
+    for n in range(1, 5):
+        pj(jnp.zeros((n,), jnp.float32))
+    storm = compute.status()["storm"]
+    assert storm["active"]
+    assert storm["sites"][0]["site"] == "t.storm"
+    assert storm["sites"][0]["traces_in_window"] == 4
+
+
+def test_prometheus_text_per_site_families():
+    pj = compute.profiled_jit(lambda x: x, site="t.prom")
+    pj(jnp.zeros((2,), jnp.float32))
+    pj(jnp.zeros((3,), jnp.float32))
+    text = compute.prometheus_text()
+    assert '# TYPE dmlc_compute_recompiles_total counter' in text
+    assert 'dmlc_compute_recompiles_total{site="t.prom"} 1' in text
+    assert 'dmlc_compute_traces_total{site="t.prom"} 2' in text
+    assert 'dmlc_compute_cache_hits_total{site="t.prom"} 0' in text
+
+
+# ---------------------------------------------------------------------------
+# dark-cheap contract: DMLC_COMPUTE_PROFILE=0
+# ---------------------------------------------------------------------------
+
+def test_disabled_returns_plain_jit(monkeypatch):
+    monkeypatch.setenv("DMLC_COMPUTE_PROFILE", "0")
+    pj = compute.profiled_jit(lambda x: x * 2.0, site="t.off",
+                              static_argnums=())
+    assert not hasattr(pj, "stats")  # the plain jax.jit object
+    assert float(pj(jnp.ones((2,), jnp.float32))[0]) == 2.0
+    assert compute.sites() == {}     # no registry entry
+    assert compute.status() == {}
+
+
+def test_disabled_phase_scope_accumulates_nothing(monkeypatch):
+    monkeypatch.setenv("DMLC_COMPUTE_PROFILE", "0")
+    with compute.phase("gather"):
+        time.sleep(0.001)
+    compute.phase_estimate({"attention": 1.0}, 1.0)
+    assert compute.phase_shares() == {}
+
+
+# ---------------------------------------------------------------------------
+# watchdog integration + dmlc-top pane
+# ---------------------------------------------------------------------------
+
+def _storm_status_doc(active=True):
+    return {"traces": 6, "hits": 0, "recompiles": 5,
+            "hbm_peak_bytes": 1 << 30,
+            "storm": {"active": active, "window_s": 60.0, "threshold": 4,
+                      "sites": [{"site": "smoke.churn",
+                                 "traces_in_window": 6}]}}
+
+
+def test_watchdog_ingest_compute_flags_and_clears():
+    w = Watchdog(log=logging.getLogger("t"))
+    w.ingest_json(1, json.dumps({"compute": _storm_status_doc()}))
+    rep = w.report()
+    assert rep["ranks"]["1"]["flags"] == ["recompile_storm"]
+    assert rep["ranks"]["1"]["compute"]["recompiles"] == 5
+    assert rep["ranks"]["1"]["compute"]["storm_sites"] == ["smoke.churn"]
+    assert "recompile_storm" in COMPUTE_KINDS
+    creport = w.compute_report()
+    assert creport["storming_ranks"] == [1]
+    assert creport["ranks"]["1"]["traces"] == 6
+    # the worker's window slides past the churn: the flag clears
+    w.ingest_compute(1, _storm_status_doc(active=False))
+    assert w.report()["ranks"]["1"]["flags"] == []
+    assert w.compute_report()["storming_ranks"] == []
+
+
+def test_watchdog_ingest_compute_sanitizes():
+    w = Watchdog(log=logging.getLogger("t"))
+    w.ingest_compute(1, {"traces": "NaN-ish", "recompiles": 2,
+                         "storm": "not-a-dict"})
+    comp = w.report()["ranks"]["1"]["compute"]
+    assert comp == {"recompiles": 2}
+    assert w.report()["ranks"]["1"]["flags"] == []
+    w.ingest_compute(-1, _storm_status_doc())   # bad rank: dropped
+    assert "-1" not in w.report()["ranks"]
+
+
+def test_render_compute_pane_replica_shape():
+    top = _load_top()
+    pj = compute.profiled_jit(lambda x: x, site="t.pane")
+    pj(jnp.zeros((2,), jnp.float32))
+    compute.phase_estimate({"attention": 1.0, "mlp": 2.0}, 0.01)
+    compute.sample_hbm()
+    lines = top.render_compute_pane({"compute": compute.report()})
+    text = "\n".join(lines)
+    assert "compute  traces=1" in text
+    assert "storm=ok" in text
+    assert "phases" in text and "mlp=67%" in text
+
+
+def test_render_compute_pane_tracker_shape():
+    top = _load_top()
+    doc = {"compute": {"ranks": {"0": {"recompiles": 0},
+                                 "1": {"recompiles": 5}},
+                       "storming_ranks": [1]}}
+    (line,) = top.render_compute_pane(doc)
+    assert "r0:0" in line and "r1:5" in line
+    assert "STORM ranks=[1]" in line
+    assert top.render_compute_pane({}) == []
