@@ -432,6 +432,13 @@ KNOBS: Tuple[Knob, ...] = (
        "distinct decode jit signatures (context-length buckets) the "
        "engine may compile before erroring (recompile-storm guard)",
        group="serving"),
+    _k("DMLC_SERVE_PRIORITY_LEVELS", int, 3,
+       "priority classes a /generate request may carry (ints "
+       "0..levels-1; batch/standard/interactive name the defaults)",
+       group="serving"),
+    _k("DMLC_SERVE_PRIORITY_DEFAULT", int, 1,
+       "priority assigned to a request that carries none",
+       group="serving"),
 
     # ---- fleet router (serving/router.py) -----------------------------
     _k("DMLC_ROUTER_HOST", str, "127.0.0.1",
@@ -464,6 +471,44 @@ KNOBS: Tuple[Knob, ...] = (
        "hedge a dispatch outliving this multiple of the router's "
        "observed p99 latency on a second replica (0 = hedging off)",
        group="router"),
+
+    # ---- tenant fairness (serving/router.py TenantGovernor) -----------
+    _k("DMLC_TENANT_RATE", float, 0.0,
+       "per-weight-unit tenant admission rate in req/s; <= 0 means "
+       "accounting-only (per-tenant metrics, never a 429)",
+       group="tenant"),
+    _k("DMLC_TENANT_BURST_S", float, 10.0,
+       "token-bucket depth in seconds of a tenant's own fill rate",
+       group="tenant"),
+    _k("DMLC_TENANT_WEIGHTS", str, None,
+       "per-tenant weights, e.g. paid=4,free=1 (unlisted tenants get "
+       "the default weight)", group="tenant"),
+    _k("DMLC_TENANT_DEFAULT_WEIGHT", float, 1.0,
+       "weight for tenants not named in DMLC_TENANT_WEIGHTS",
+       group="tenant"),
+    _k("DMLC_TENANT_MAX", int, 64,
+       "distinct tenants tracked before new ones fold into the "
+       "overflow pseudo-tenant (label-cardinality bound)",
+       group="tenant"),
+
+    # ---- fleet autoscaler (fleet/autoscaler.py) -----------------------
+    _k("DMLC_AUTOSCALE_INTERVAL_S", float, 2.0,
+       "autoscaler control-loop tick interval", group="fleet"),
+    _k("DMLC_AUTOSCALE_HIGH_WATER", float, 0.8,
+       "aggregate fleet utilization at/above this counts toward "
+       "scale-up", group="fleet"),
+    _k("DMLC_AUTOSCALE_LOW_WATER", float, 0.3,
+       "aggregate fleet utilization at/below this counts toward "
+       "scale-down", group="fleet"),
+    _k("DMLC_AUTOSCALE_HYSTERESIS", int, 3,
+       "consecutive over/under-water ticks required before acting",
+       group="fleet"),
+    _k("DMLC_AUTOSCALE_COOLDOWN_S", float, 30.0,
+       "minimum seconds between two scale actions", group="fleet"),
+    _k("DMLC_AUTOSCALE_MIN_REPLICAS", int, 1,
+       "never scale the fleet below this replica count", group="fleet"),
+    _k("DMLC_AUTOSCALE_MAX_REPLICAS", int, 4,
+       "never scale the fleet above this replica count", group="fleet"),
 
     # ---- serving SLOs (telemetry.slo) ---------------------------------
     _k("DMLC_SLO_TTFT_P99_S", float, None,
@@ -513,6 +558,8 @@ _GROUP_TITLES = (
     ("kernel", "Kernels"),
     ("serving", "Serving"),
     ("router", "Fleet router"),
+    ("tenant", "Tenant fairness"),
+    ("fleet", "Fleet autoscaler"),
     ("slo", "Serving SLOs"),
     ("misc", "Misc"),
 )

@@ -1,0 +1,31 @@
+"""Fleet control plane: the cluster brain over serving + training.
+
+The reference framework's upper layer was cluster arbitration — YARN /
+Mesos / SGE backends deciding which job gets which hosts (SURVEY
+§2.7).  This package is that layer for the co-scheduled fleet this
+repo grew: a latency-sensitive serving fleet behind the router
+(PR 13) sharing hosts with a low-priority background elastic training
+job (PR 7).
+
+  * :class:`Autoscaler` — the closed-loop controller: router
+    utilization + per-replica SLO burn in, hysteresis + cooldown
+    scale decisions out.
+  * :class:`TrainingPreemptingProvider` / :class:`HostProvider` —
+    where scale-up hosts come from: preempt one training rank
+    (kill + ``POST /resize`` with a remove list), gang-launch a
+    replica on the freed host; give it back on scale-down and
+    training regrows with loss parity.
+  * :class:`ResizeClient` — the thin programmatic client for the
+    tracker's elastic resize surface.
+
+The end-to-end CI stage is ``scripts/autoscale_smoke.py``; the HTTP
+surface is the router's ``/fleet`` endpoint plus the hand-rendered
+``dmlc_fleet_*`` Prometheus families.
+"""
+
+from .autoscaler import Autoscaler
+from .preempt import (CallbackProvider, HostProvider, ResizeClient,
+                      TrainingPreemptingProvider)
+
+__all__ = ["Autoscaler", "CallbackProvider", "HostProvider",
+           "ResizeClient", "TrainingPreemptingProvider"]

@@ -43,13 +43,14 @@ from typing import List, Optional
 
 import numpy as np
 
-from .. import telemetry
+from .. import concurrency, telemetry
 from ..base import DMLCError, get_env
 from ..concurrency import BufferPool, make_lock
 from ..models import transformer as tfm
 from .kv_cache import PagedKVCache
 from .scheduler import (ACTIVE, AlreadyFinished,
-                        ContinuousBatchScheduler, Request)
+                        ContinuousBatchScheduler, Request,
+                        coerce_priority)
 
 __all__ = ["InferenceEngine", "AdmissionFull", "EngineDraining"]
 
@@ -142,15 +143,27 @@ def _jitted_programs():
     key = "profiled" if compute.enabled() else "plain"
     progs = _JIT_CACHE.get(key)
     if progs is None:
-        progs = (
-            compute.profiled_jit(tfm.forward_prefill_last,
-                                 site="serving.prefill",
-                                 static_argnums=(3,)),
-            compute.profiled_jit(
-                tfm.forward_decode, site="serving.decode",
-                static_argnums=(6,),
-                max_signatures=get_env("DMLC_SERVE_MAX_DECODE_SIGS", 64)),
-        )
+        # this cache outlives any one engine — if the first engine of
+        # the process is built inside an interleaving-explorer scenario
+        # (analysis.scenarios builds a real engine as a scheduler test
+        # double), the profiled wrappers must NOT capture the
+        # scenario's scheduler-owned SchedLocks: a later engine would
+        # inherit a lock wired to a finished controller
+        prev_hook = concurrency._lock_factory_hook
+        concurrency.set_lock_factory_hook(None)
+        try:
+            progs = (
+                compute.profiled_jit(tfm.forward_prefill_last,
+                                     site="serving.prefill",
+                                     static_argnums=(3,)),
+                compute.profiled_jit(
+                    tfm.forward_decode, site="serving.decode",
+                    static_argnums=(6,),
+                    max_signatures=get_env("DMLC_SERVE_MAX_DECODE_SIGS",
+                                           64)),
+            )
+        finally:
+            concurrency.set_lock_factory_hook(prev_hook)
         _JIT_CACHE[key] = progs
     else:
         for prog in progs:
@@ -190,6 +203,15 @@ class InferenceEngine:
             max_new_tokens if max_new_tokens is not None
             else get_env("DMLC_SERVE_MAX_TOKENS", 64))
         self.eos_id = eos_id
+        # priority classes: admission order and KV-pressure eviction
+        # both prefer low-priority victims (scheduler policy); the
+        # class count and the unlabeled default are knobs so a fleet
+        # can widen the ladder without a code change
+        self.priority_levels = max(1, get_env(
+            "DMLC_SERVE_PRIORITY_LEVELS", 3))
+        self.priority_default = min(
+            max(0, get_env("DMLC_SERVE_PRIORITY_DEFAULT", 1)),
+            self.priority_levels - 1)
         self.cache = PagedKVCache(
             cfg.n_layers, cfg.n_heads, cfg.head_dim,
             n_blocks=(n_blocks if n_blocks is not None
@@ -240,11 +262,21 @@ class InferenceEngine:
     def submit(self, prompt_ids: List[int],
                max_new_tokens: Optional[int] = None,
                timeout: Optional[float] = None,
-               request_id: Optional[str] = None) -> Request:
+               request_id: Optional[str] = None,
+               priority=None, tenant: Optional[str] = None) -> Request:
         """Admit a request or raise: :class:`AdmissionFull` when no
         queue slot frees up within ``timeout`` (default
         ``admit_timeout_s``), ``ValueError`` when the request could
-        never be served (bad ids, context beyond total cache).
+        never be served (bad ids, context beyond total cache, an
+        invalid priority class).
+
+        ``priority`` is a validated class (an int in
+        ``[0, priority_levels)`` or a name from
+        :data:`scheduler.PRIORITY_CLASSES`; None → the configured
+        default): the scheduler admits high classes first and evicts
+        low classes first under KV pressure.  ``tenant`` rides along
+        for per-tenant accounting (the ROUTER enforces tenant
+        fairness; the engine only labels).
 
         ``request_id`` is the client's idempotency key: a duplicate
         submission while the original is live (or successfully finished
@@ -269,7 +301,16 @@ class InferenceEngine:
                 "another replica")
         mnt = (max_new_tokens if max_new_tokens is not None
                else self.default_max_new_tokens)
-        req = Request(prompt_ids, mnt, eos_id=self.eos_id)
+        prio = coerce_priority(priority, self.priority_levels,
+                               self.priority_default)
+        if tenant is None:
+            tenant = "default"
+        elif (not isinstance(tenant, str) or not tenant
+                or len(tenant) > 64):
+            raise ValueError("tenant must be a non-empty string of at "
+                             "most 64 chars")
+        req = Request(prompt_ids, mnt, eos_id=self.eos_id,
+                      priority=prio, tenant=tenant)
         req.client_id = request_id
         if any(t < 0 or t >= self.cfg.vocab for t in req.prompt_ids):
             raise ValueError(

@@ -20,6 +20,16 @@ engine), not smeared by client-side HTTP overhead — and joins them
 with the engine's own ledger view scraped from ``/healthz``, so the
 emitted ``BENCH_serving.json`` carries p50/p99 TTFT, per-user decode
 tokens/s, and decode-step MFU from one run.
+
+**Multi-tenant mode**: pass ``tenants=[{"tenant": "paid", "streams":
+4, "priority": "interactive"}, ...]`` and each stream carries its
+tenant key and priority class on every request (the router's fairness
+gate and the engine's priority scheduler see exactly what a real
+multi-tenant client would send).  The summary then adds a per-tenant
+breakdown — requests, 429s absorbed, TTFT percentiles, tokens/s per
+user — which flows into ``BENCH_serving.json`` unchanged, so fairness
+(who absorbed the backpressure, whose SLO held) is a first-class
+before/after metric.
 """
 
 from __future__ import annotations
@@ -48,9 +58,9 @@ class LoadGenerator:
                  requests_per_stream: int = 4,
                  prompt_len: tuple = (8, 24), max_tokens: int = 16,
                  vocab: int = 128, seed: int = 0,
-                 retry_429_s: float = 0.2, max_retries: int = 50):
+                 retry_429_s: float = 0.2, max_retries: int = 50,
+                 tenants: Optional[List[Dict]] = None):
         self.url = url.rstrip("/")
-        self.n_streams = int(n_streams)
         self.requests_per_stream = int(requests_per_stream)
         self.prompt_len = (int(prompt_len[0]), int(prompt_len[1]))
         self.max_tokens = int(max_tokens)
@@ -58,11 +68,24 @@ class LoadGenerator:
         self.seed = int(seed)
         self.retry_429_s = float(retry_429_s)
         self.max_retries = int(max_retries)
+        # multi-tenant mode: each spec fans out into `streams` synthetic
+        # users all carrying that tenant key (and optional priority);
+        # without specs every stream is the anonymous default tenant
+        self._specs: List[tuple] = []
+        if tenants:
+            for spec in tenants:
+                tname = str(spec["tenant"])
+                for _ in range(int(spec.get("streams", 1))):
+                    self._specs.append((tname, spec.get("priority")))
+        else:
+            self._specs = [(None, None)] * int(n_streams)
+        self.n_streams = len(self._specs)
         self.results: List[Dict] = []
         self.failures: List[Dict] = []
         self.rejections = 0
         self.backoffs_503 = 0
         self.retried_ok = 0
+        self.rejections_by_tenant: Dict[str, int] = {}
         self._lock = make_lock("LoadGenerator._lock")
 
     # ---- one synthetic user --------------------------------------------
@@ -89,6 +112,7 @@ class LoadGenerator:
 
     def _stream(self, sid: int) -> None:
         rng = random.Random(self.seed * 1000 + sid)
+        tenant, priority = self._specs[sid]
         for _ in range(self.requests_per_stream):
             n = rng.randint(*self.prompt_len)
             doc = {"prompt": [rng.randrange(self.vocab) for _ in range(n)],
@@ -97,6 +121,10 @@ class LoadGenerator:
                    # reuse it, so a replica (or the router) that already
                    # accepted the work returns it instead of repeating it
                    "request_id": uuid.uuid4().hex}
+            if tenant is not None:
+                doc["tenant"] = tenant
+            if priority is not None:
+                doc["priority"] = priority
             t0 = time.monotonic()
             out = None
             retried = False
@@ -122,6 +150,10 @@ class LoadGenerator:
                         with self._lock:
                             if e.code == 429:
                                 self.rejections += 1
+                                if tenant is not None:
+                                    self.rejections_by_tenant[tenant] = \
+                                        self.rejections_by_tenant.get(
+                                            tenant, 0) + 1
                             else:
                                 self.backoffs_503 += 1
                         time.sleep(delay)
@@ -137,6 +169,8 @@ class LoadGenerator:
             if out is None:
                 out = {"error": "retry budget exhausted (429/503)"}
             out["stream"] = sid
+            if tenant is not None:
+                out["client_tenant"] = tenant
             out["client_latency_s"] = time.monotonic() - t0
             with self._lock:
                 if out.get("error"):
@@ -169,6 +203,7 @@ class LoadGenerator:
             retried_ok = self.retried_ok
             rejections = self.rejections
             backoffs_503 = self.backoffs_503
+            rej_by_tenant = dict(self.rejections_by_tenant)
         ttfts = [r["ttft_s"] for r in results
                  if r.get("ttft_s") is not None]
         tps = [r["decode_tokens_per_s"] for r in results
@@ -207,6 +242,33 @@ class LoadGenerator:
             "client_server_delta_p50_s": percentile(deltas, 50),
             "client_server_delta_p99_s": percentile(deltas, 99),
         }
+        # per-tenant fairness breakdown (multi-tenant mode only): who
+        # absorbed the 429s and whose latency held is the whole point
+        # of the tenant governor, so it ships in the same summary (and
+        # therefore in BENCH_serving.json) rather than a side channel
+        names = sorted({t for t, _ in self._specs if t is not None}
+                       | set(rej_by_tenant))
+        if names:
+            per: Dict[str, Dict] = {}
+            for name in names:
+                rs = [r for r in results
+                      if r.get("client_tenant") == name]
+                t_ttfts = [r["ttft_s"] for r in rs
+                           if r.get("ttft_s") is not None]
+                t_tps = [r["decode_tokens_per_s"] for r in rs
+                         if r.get("decode_tokens_per_s")]
+                per[name] = {
+                    "n_requests_ok": len(rs),
+                    "n_requests_failed": sum(
+                        1 for f in failures
+                        if f.get("client_tenant") == name),
+                    "n_rejections_429": rej_by_tenant.get(name, 0),
+                    "p50_ttft_s": percentile(t_ttfts, 50),
+                    "p99_ttft_s": percentile(t_ttfts, 99),
+                    "tokens_per_s_per_user": ((sum(t_tps) / len(t_tps))
+                                              if t_tps else None),
+                }
+            out["tenants"] = per
         return out
 
     # ---- artifact -------------------------------------------------------

@@ -44,6 +44,19 @@ for corruption (PR 8) and the training world for shrink (PR 7):
     the router answers 429 with a Retry-After computed from the
     aggregate queue depth and the observed per-request service time,
     not a made-up constant.
+  * **per-tenant fairness**: every request carries a tenant key
+    (``"tenant"`` body field, default ``"default"``); a weighted
+    token bucket per tenant (:class:`TenantGovernor`,
+    ``DMLC_TENANT_RATE`` × per-tenant ``DMLC_TENANT_WEIGHTS``) gates
+    admission BEFORE placement, so one hot tenant's burst absorbs its
+    own 429s — with an honest per-tenant Retry-After (its bucket
+    deficit over its own fill rate) — instead of starving the rest.
+    Rate 0 (the default) is accounting-only: per-tenant labeled
+    metrics without any admission behavior change.
+  * **dynamic registry**: ``add_replica`` / ``remove_replica`` /
+    ``set_draining`` let a controller (``fleet.Autoscaler``) reshape
+    the fleet at runtime; ``utilization()`` is the aggregate load
+    signal it polls.
 
 Fault-injection sites: ``router.dispatch`` (armed error = a torn
 dispatch, exercising the retry path deterministically) and
@@ -72,7 +85,8 @@ from ..concurrency import make_lock
 from ..resilience.fault import fault_point
 from ..telemetry.requests import percentile
 
-__all__ = ["Replica", "Router", "RouterHTTPServer", "discover_replicas",
+__all__ = ["Replica", "Router", "RouterHTTPServer", "TenantGovernor",
+           "discover_replicas", "parse_tenant_weights",
            "HEALTHY", "DOWN", "DRAINING"]
 
 logger = logging.getLogger("dmlc_tpu.serving")
@@ -146,6 +160,202 @@ class Replica:
         }
 
 
+def parse_tenant_weights(spec: Optional[str]) -> Dict[str, float]:
+    """``DMLC_TENANT_WEIGHTS`` parser: ``"paid=4,free=1"`` → dict.
+    Malformed entries are skipped with a warning rather than raising —
+    a typo in one tenant's weight must not take the router down."""
+    out: Dict[str, float] = {}
+    if not spec:
+        return out
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, val = part.partition("=")
+        name = name.strip()
+        try:
+            w = float(val)
+            if not sep or not name or len(name) > 64 or w <= 0:
+                raise ValueError(part)
+        except ValueError:
+            logger.warning("ignoring malformed tenant weight %r", part)
+            continue
+        out[name] = w
+    return out
+
+
+class _TenantState:
+    """One tenant's bucket + counters (mutated under the governor's
+    lock only)."""
+
+    __slots__ = ("name", "weight", "tokens", "last_refill", "requests",
+                 "admitted", "rejected", "tokens_generated")
+
+    def __init__(self, name: str, weight: float, burst: float,
+                 now: float):
+        self.name = name
+        self.weight = weight
+        self.tokens = burst          # buckets start full: no cold 429s
+        self.last_refill = now
+        self.requests = 0
+        self.admitted = 0
+        self.rejected = 0
+        self.tokens_generated = 0
+
+    def view(self) -> Dict:
+        return {"tenant": self.name, "weight": self.weight,
+                "bucket_level": round(self.tokens, 3),
+                "requests": self.requests, "admitted": self.admitted,
+                "rejected": self.rejected,
+                "tokens_generated": self.tokens_generated}
+
+
+class TenantGovernor:
+    """Weighted token-bucket admission per tenant (router front door).
+
+    Each tenant refills at ``weight × rate`` requests/second into a
+    bucket holding ``burst_s`` seconds of its own rate, so a hot
+    tenant rides its burst then gets per-tenant 429s with an HONEST
+    Retry-After (seconds until ITS bucket holds one token) while every
+    other tenant's admission is untouched — noisy-neighbor isolation
+    as an edge verdict instead of a shared-queue lottery.
+
+    ``rate <= 0`` (the default) disables enforcement: the governor
+    still does per-tenant accounting (requests/tokens/labeled metrics)
+    but never rejects, so existing single-tenant deployments see zero
+    behavior change.  Distinct tenant keys are capped at
+    ``max_tenants``; past that, unknown keys fold into the
+    ``"overflow"`` pseudo-tenant — a hostile client minting random
+    keys gets ONE shared bucket and bounded label cardinality, not an
+    unbounded metrics surface.
+    """
+
+    OVERFLOW = "overflow"
+
+    def __init__(self, *, rate: Optional[float] = None,
+                 burst_s: Optional[float] = None,
+                 weights: Optional[Dict[str, float]] = None,
+                 default_weight: Optional[float] = None,
+                 max_tenants: Optional[int] = None):
+        self.rate = (rate if rate is not None
+                     else get_env("DMLC_TENANT_RATE", 0.0))
+        self.burst_s = (burst_s if burst_s is not None
+                        else get_env("DMLC_TENANT_BURST_S", 10.0))
+        self.default_weight = (
+            default_weight if default_weight is not None
+            else get_env("DMLC_TENANT_DEFAULT_WEIGHT", 1.0))
+        self.max_tenants = (max_tenants if max_tenants is not None
+                            else get_env("DMLC_TENANT_MAX", 64))
+        self.weights = (dict(weights) if weights is not None
+                        else parse_tenant_weights(
+                            get_env("DMLC_TENANT_WEIGHTS", None, str)))
+        self._lock = make_lock("TenantGovernor._lock")
+        self._tenants: Dict[str, _TenantState] = {}
+
+    def _burst(self, weight: float) -> float:
+        return max(1.0, weight * max(self.rate, 0.0) * self.burst_s)
+
+    def _state(self, tenant: str, now: float) -> _TenantState:
+        """Lock held.  Configured tenants always get their own bucket;
+        unknown ones fold to overflow past the cardinality cap."""
+        st = self._tenants.get(tenant)
+        if st is not None:
+            return st
+        if (tenant not in self.weights
+                and len(self._tenants) >= self.max_tenants):
+            tenant = self.OVERFLOW
+            st = self._tenants.get(tenant)
+            if st is not None:
+                return st
+        w = self.weights.get(tenant, self.default_weight)
+        st = _TenantState(tenant, w, self._burst(w), now)
+        self._tenants[tenant] = st
+        return st
+
+    def admit(self, tenant: str,
+              now: Optional[float] = None) -> Tuple[bool, float]:
+        """One admission decision: ``(admitted, retry_after_s)``.
+        Refill-then-spend under the lock; the rejection's Retry-After
+        is the seconds until THIS tenant's bucket refills one token —
+        computed from its own weighted rate, never a constant."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            st = self._state(tenant, now)
+            st.requests += 1
+            if self.rate <= 0:
+                st.admitted += 1
+                return True, 0.0
+            fill_rate = st.weight * self.rate
+            st.tokens = min(self._burst(st.weight),
+                            st.tokens + (now - st.last_refill) * fill_rate)
+            st.last_refill = now
+            if st.tokens >= 1.0:
+                st.tokens -= 1.0
+                st.admitted += 1
+                return True, 0.0
+            st.rejected += 1
+            retry = (1.0 - st.tokens) / max(fill_rate, 1e-9)
+        telemetry.inc("router", "tenant_rejections")
+        return False, max(0.1, min(retry, 60.0))
+
+    def observe_completion(self, tenant: str, n_generated: int) -> None:
+        now = time.monotonic()
+        with self._lock:
+            st = self._state(tenant, now)
+            st.tokens_generated += max(0, int(n_generated or 0))
+
+    def views(self) -> List[Dict]:
+        with self._lock:
+            return [st.view() for _, st in sorted(self._tenants.items())]
+
+    def stats(self) -> Dict:
+        return {"rate_per_weight": self.rate, "burst_s": self.burst_s,
+                "enforcing": self.rate > 0,
+                "default_weight": self.default_weight,
+                "tenants": self.views()}
+
+    def prometheus_text(self) -> str:
+        """Hand-rendered ``dmlc_tenant_*`` families with a ``tenant``
+        label (the core registry is label-free — same pattern as the
+        per-replica ``dmlc_router_replica_*`` families)."""
+        views = self.views()
+        if not views:
+            return ""
+
+        def esc(v: str) -> str:
+            return (v.replace("\\", r"\\").replace('"', r'\"')
+                    .replace("\n", r"\n"))
+
+        fams = (
+            ("dmlc_tenant_requests_total", "counter",
+             "requests seen at the router per tenant",
+             lambda v: v["requests"]),
+            ("dmlc_tenant_admitted_total", "counter",
+             "requests admitted past the tenant token bucket",
+             lambda v: v["admitted"]),
+            ("dmlc_tenant_rejected_total", "counter",
+             "per-tenant 429s from the weighted token bucket",
+             lambda v: v["rejected"]),
+            ("dmlc_tenant_tokens_generated_total", "counter",
+             "generated tokens attributed to this tenant",
+             lambda v: v["tokens_generated"]),
+            ("dmlc_tenant_bucket_level", "gauge",
+             "admission tokens currently in the tenant's bucket",
+             lambda v: v["bucket_level"]),
+            ("dmlc_tenant_weight", "gauge",
+             "configured fair-share weight per tenant",
+             lambda v: v["weight"]),
+        )
+        lines = []
+        for name, typ, help_text, getter in fams:
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} {typ}")
+            for v in views:
+                lines.append(
+                    f'{name}{{tenant="{esc(v["tenant"])}"}} {getter(v)}')
+        return "\n".join(lines) + "\n"
+
+
 def _is_timeout(exc: BaseException) -> bool:
     """A dispatch timeout means SLOW, not dead: ``socket.timeout`` is
     ``TimeoutError`` since 3.10, and urllib wraps connect timeouts in
@@ -195,6 +405,7 @@ class Router:
                  request_timeout_s: Optional[float] = None,
                  hedge_after_p99_mult: Optional[float] = None,
                  hedge_min_samples: int = _HEDGE_MIN_SAMPLES,
+                 tenants: Optional[TenantGovernor] = None,
                  start_health_thread: bool = True):
         if not replicas:
             raise ValueError("router needs at least one replica URL")
@@ -202,6 +413,9 @@ class Router:
         self.replicas: List[Replica] = [Replica(u) for u in replicas]
         if len({r.url for r in self.replicas}) != len(self.replicas):
             raise ValueError("duplicate replica URLs")
+        # per-tenant fairness at the front door (accounting-only until
+        # DMLC_TENANT_RATE turns enforcement on)
+        self.tenants = tenants if tenants is not None else TenantGovernor()
         self.health_interval_s = (
             health_interval_s if health_interval_s is not None
             else get_env("DMLC_ROUTER_HEALTH_INTERVAL_S", 1.0))
@@ -236,10 +450,73 @@ class Router:
                 name="router-health")
             self._health_thread.start()
 
+    # ---- dynamic registry (the autoscaler's surface) --------------------
+    def add_replica(self, url: str) -> Replica:
+        """Register a replica at run time (fleet scale-up).  The new
+        replica starts HEALTHY-optimistic exactly like an init-time one
+        — the next health sweep corrects it within one interval — and
+        is eligible for dispatch immediately.  Raises ``ValueError``
+        on a duplicate URL (the caller's registry bug, not a no-op:
+        silently keeping one Replica for two registrations would
+        double-count its load)."""
+        rep = Replica(url)
+        with self._lock:
+            if any(r.url == rep.url for r in self.replicas):
+                raise ValueError(f"replica {rep.url} already registered")
+            self.replicas.append(rep)
+        telemetry.inc("router", "replicas_added")
+        telemetry.record_event("router_replica_added", replica=rep.url)
+        logger.info("router: replica %s registered", rep.url)
+        self._publish_fleet_gauges()
+        return rep
+
+    def remove_replica(self, url: str) -> bool:
+        """Drop a replica from the registry (fleet scale-down, after
+        its drain completed).  In-flight dispatches to it finish on
+        their own threads — removal only stops NEW placement.  Returns
+        False when the URL is unknown (already removed)."""
+        url = url.rstrip("/")
+        with self._lock:
+            for i, r in enumerate(self.replicas):
+                if r.url == url:
+                    del self.replicas[i]
+                    break
+            else:
+                return False
+        telemetry.inc("router", "replicas_removed")
+        telemetry.record_event("router_replica_removed", replica=url)
+        logger.info("router: replica %s removed", url)
+        self._publish_fleet_gauges()
+        return True
+
+    def set_draining(self, url: str) -> bool:
+        """Flip a replica to DRAINING by URL (the autoscaler's
+        scale-down first step: shift traffic BEFORE the engine's
+        begin_drain, so no dispatch races the drain gate).  Returns
+        False when the URL is unknown."""
+        url = url.rstrip("/")
+        with self._lock:
+            rep = next((r for r in self.replicas if r.url == url), None)
+        if rep is None:
+            return False
+        self._mark_draining(rep)
+        return True
+
     # ---- registry views -------------------------------------------------
     def replica_views(self) -> List[Dict]:
         with self._lock:
             return [r.view() for r in self.replicas]
+
+    def utilization(self) -> float:
+        """Aggregate fleet load in [0, ∞): queued+running work over
+        non-DOWN decode capacity (the autoscaler's primary signal;
+        >1 means work is queueing faster than the fleet decodes)."""
+        with self._lock:
+            load = sum(r.live + r.inflight for r in self.replicas
+                       if r.state != DOWN)
+            capacity = sum(r.max_active for r in self.replicas
+                           if r.state != DOWN)
+        return load / capacity if capacity else float(load > 0)
 
     def counts(self) -> Dict[str, int]:
         with self._lock:
@@ -622,10 +899,12 @@ class Router:
             "healthy": c[HEALTHY], "down": c[DOWN],
             "draining": c[DRAINING],
             "aggregate": {"live": agg_live, "inflight": agg_inflight,
-                          "capacity": agg_capacity},
+                          "capacity": agg_capacity,
+                          "utilization": self.utilization()},
             "latency_p50_s": self._latency_pct(50),
             "latency_p99_s": self._latency_pct(99),
             "hedge_after_s": self.hedge_after_s(),
+            "tenants": self.tenants.stats(),
         }
 
     def prometheus_text(self) -> str:
@@ -687,18 +966,28 @@ class RouterHTTPServer:
     router decides placement, retry, and hedging underneath it.
 
     Endpoints:
-      POST /generate   forwarded to the least-loaded healthy replica
-                       (idempotency key injected when absent; retried /
-                       hedged transparently)
+      POST /generate   tenant-fairness gate (weighted token bucket; an
+                       over-budget tenant gets **429** with its own
+                       honest Retry-After) then forwarded to the
+                       least-loaded healthy replica (idempotency key
+                       injected when absent; retried / hedged
+                       transparently).  Body may carry ``"tenant"``
+                       (str ≤64) and ``"priority"`` (validated on the
+                       replica) alongside the prompt
       GET  /healthz    fleet view: per-replica states + aggregates
+                       (utilization, per-tenant admission stats)
       GET  /replicas   the replica registry document alone
+      GET  /fleet      the autoscaler's control-loop document (only
+                       when the server was built with a fleet source —
+                       see ``fleet.Autoscaler``)
       GET  /metrics    router-process Prometheus exposition plus the
                        hand-rendered per-replica ``dmlc_router_replica_*``
-                       labeled families
+                       and per-tenant ``dmlc_tenant_*`` labeled families
+                       (+ ``dmlc_fleet_*`` when a fleet source is wired)
     """
 
     def __init__(self, router: Router, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, fleet_source=None):
         rt = router
 
         class Handler(BaseHTTPRequestHandler):
@@ -723,7 +1012,14 @@ class RouterHTTPServer:
                 path = self.path.split("?", 1)[0]
                 if path == "/metrics":
                     text = (telemetry.to_prometheus_text()
-                            + rt.prometheus_text())
+                            + rt.prometheus_text()
+                            + rt.tenants.prometheus_text())
+                    if fleet_source is not None:
+                        try:
+                            text += fleet_source().prometheus_text()
+                        except Exception as e:  # noqa: BLE001 - no 500s
+                            logger.warning(
+                                "/metrics fleet render failed: %r", e)
                     self._send(200,
                                "text/plain; version=0.0.4; charset=utf-8",
                                text.encode())
@@ -736,6 +1032,16 @@ class RouterHTTPServer:
                 elif path == "/replicas":
                     self._send(200, "application/json",
                                json.dumps(rt.replica_views()).encode())
+                elif path == "/fleet" and fleet_source is not None:
+                    try:
+                        body = json.dumps(
+                            fleet_source().report()).encode()
+                    except Exception as e:  # noqa: BLE001 - no 500s
+                        logger.warning("/fleet render failed: %r", e)
+                        self._send(503, "text/plain",
+                                   b"fleet render failed\n")
+                        return
+                    self._send(200, "application/json", body)
                 else:
                     # GET 404s uncounted: monitors probe optional
                     # endpoints by design (same policy as the replica)
@@ -760,11 +1066,33 @@ class RouterHTTPServer:
                                             or not rid or len(rid) > 128):
                         raise ValueError("request_id must be a non-empty "
                                          "string of at most 128 chars")
+                    tenant = doc.get("tenant")
+                    if tenant is None:
+                        tenant = "default"
+                    if (not isinstance(tenant, str) or not tenant
+                            or len(tenant) > 64):
+                        raise ValueError("tenant must be a non-empty "
+                                         "string of at most 64 chars")
                 except (ValueError, TypeError,
                         json.JSONDecodeError) as e:
                     self._answer(400, {"error": f"bad request: {e}"})
                     return
+                # tenant fairness gate BEFORE placement: an over-budget
+                # tenant is rejected here with the honest per-tenant
+                # Retry-After (bucket deficit / its own fill rate), so
+                # one hot tenant's burst never occupies replica slots
+                # other tenants are entitled to
+                admitted, retry_s = rt.tenants.admit(tenant)
+                if not admitted:
+                    self._answer(
+                        429, {"error": "tenant over budget",
+                              "tenant": tenant},
+                        extra_headers={"Retry-After": f"{retry_s:.1f}"})
+                    return
                 code, out, headers = rt.route(doc)
+                if code == 200 and isinstance(out, dict):
+                    rt.tenants.observe_completion(
+                        tenant, int(out.get("n_generated", 0) or 0))
                 self._answer(code, out, extra_headers=headers)
 
             def log_message(self, fmt, *args):

@@ -9,13 +9,25 @@ one token.  Requests therefore enter and leave the batch mid-flight;
 a long generation never convoys short ones behind it.
 
 Memory pressure is resolved by *preemption with recompute* (the vLLM
-trade): when a decode step cannot extend some sequence's cache, the
-youngest active request is evicted — its blocks return to the free
-list and the request re-enters the FRONT of the wait queue carrying
-the tokens it already generated, so its eventual re-prefill recomputes
+trade): when a decode step cannot extend some sequence's cache, an
+active request is evicted — its blocks return to the free list and the
+request re-enters the FRONT of the wait queue carrying the tokens it
+already generated, so its eventual re-prefill recomputes
 prompt+generated in one pass and generation resumes where it stopped.
-Youngest-first eviction minimizes wasted recompute and cannot starve:
-the oldest request only ever gains blocks.
+The victim is the LOWEST-priority active request, youngest within the
+class: priority encodes who pays for KV pressure (a background batch
+request is recomputed before an interactive one is ever touched), and
+youngest-within-class minimizes wasted recompute and cannot starve —
+the oldest request of the highest class only ever gains blocks.
+
+Priority also orders admission: ``next_prefill`` serves the
+highest-priority waiting request first (FIFO within a class, preserved
+by the same ``(-priority, seq)`` max-heap idiom as
+``concurrency.ConcurrentBlockingQueue(priority=True)``), so a spike of
+background work cannot queue ahead of interactive traffic.  With every
+request at the default priority both policies reduce exactly to the
+original FIFO / youngest-first behavior — output parity is a
+regression-tested invariant.
 
 The scheduler is pure policy + bookkeeping (no jax): the engine owns
 the compute.  All methods are lock-protected; the engine's single step
@@ -37,7 +49,34 @@ from .kv_cache import PagedKVCache
 from ..concurrency import make_lock
 
 __all__ = ["AlreadyFinished", "Request", "ContinuousBatchScheduler",
-           "WAITING", "ACTIVE", "DONE", "FAILED"]
+           "WAITING", "ACTIVE", "DONE", "FAILED",
+           "PRIORITY_CLASSES", "coerce_priority"]
+
+#: named priority classes accepted anywhere a numeric priority is
+#: (``/generate`` bodies, loadgen tenant specs); higher = evicted later
+PRIORITY_CLASSES = {"batch": 0, "standard": 1, "interactive": 2}
+
+
+def coerce_priority(value, levels: int, default: int) -> int:
+    """Validate a client-supplied priority class: None → ``default``,
+    a name from :data:`PRIORITY_CLASSES` or an integer in
+    ``[0, levels)`` → its numeric level.  Raises ``ValueError`` (the
+    HTTP edge's 400) on anything else — an unvalidated priority would
+    let one bad client outrank the whole fleet."""
+    if value is None:
+        return int(default)
+    if isinstance(value, str):
+        if value in PRIORITY_CLASSES:
+            value = PRIORITY_CLASSES[value]
+        else:
+            raise ValueError(
+                f"priority must be one of {sorted(PRIORITY_CLASSES)} "
+                f"or an int in [0, {levels})")
+    if not isinstance(value, int) or isinstance(value, bool):
+        raise ValueError("priority must be an int or a named class")
+    if not 0 <= value < levels:
+        raise ValueError(f"priority {value} out of range [0, {levels})")
+    return value
 
 class AlreadyFinished(DMLCError):
     """Raised by :meth:`ContinuousBatchScheduler.finish` when the
@@ -67,7 +106,8 @@ class Request:
     """
 
     def __init__(self, prompt_ids: List[int], max_new_tokens: int,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None, priority: int = 1,
+                 tenant: str = "default"):
         if not prompt_ids:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
@@ -77,6 +117,8 @@ class Request:
         self.prompt_ids = [int(t) for t in prompt_ids]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
+        self.priority = int(priority)
+        self.tenant = str(tenant)
         self.submit_t = time.monotonic()
         # state/error/finish_t transition under the owning scheduler's
         # lock (a cross-object guard the race pass cannot see); the
@@ -163,6 +205,8 @@ class Request:
             "latency_s": self.latency_s,
             "decode_tokens_per_s": self.decode_tokens_per_s,
             "preemptions": self.preemptions,
+            "priority": self.priority,
+            "tenant": self.tenant,
         }
         if self.client_id is not None:
             out["request_id"] = self.client_id
@@ -215,14 +259,24 @@ class ContinuousBatchScheduler:
         """Pop the next admissible request: there is an active slot and
         the free list covers its context plus one decode slot (the
         iteration-level admission test — checked against the cache NOW,
-        so a freed block is reusable on the very next iteration)."""
+        so a freed block is reusable on the very next iteration).
+
+        Selection is highest-priority-first, FIFO within a class
+        (``max`` returns the FIRST maximal element, i.e. the class's
+        front-most queue entry — so a preempted request, re-queued at
+        the front, still resumes before fresh peers of its class).
+        The head-of-line-blocking contract is per-POLICY, not
+        per-deque: when the selected request does not fit, nothing is
+        admitted this iteration — skipping past it to a smaller,
+        lower-priority request would starve exactly the request the
+        priority says to serve first."""
         with self._lock:
             if len(self._active) >= self.max_active or not self._waiting:
                 return None
-            req = self._waiting[0]
+            req = max(self._waiting, key=lambda r: r.priority)
             if not self.cache.can_reserve(len(req.context_ids()) + 1):
                 return None
-            self._waiting.popleft()
+            self._waiting.remove(req)
             telemetry.set_gauge("serving", "queue_depth",
                                 len(self._waiting))
             return req
@@ -273,13 +327,18 @@ class ContinuousBatchScheduler:
 
     # ---- eviction -------------------------------------------------------
     def preempt_youngest(self) -> Optional[Request]:
-        """Evict the youngest active request (free its blocks, requeue
-        it at the FRONT of the wait queue for prompt resumption).
-        Returns it, or None when nothing is active to evict."""
+        """Evict the lowest-priority active request — youngest within
+        the class — (free its blocks, requeue it at the FRONT of the
+        wait queue for prompt resumption).  Returns it, or None when
+        nothing is active to evict.  A higher-priority request is NEVER
+        evicted while any lower-priority one holds blocks; with uniform
+        priorities this is exactly the original youngest-first policy
+        (and the name keeps that lineage)."""
         with self._lock:
             if not self._active:
                 return None
-            req = max(self._active, key=lambda r: (r.submit_t, r.id))
+            req = max(self._active,
+                      key=lambda r: (-r.priority, r.submit_t, r.id))
             self._active.remove(req)
             req.state = WAITING
             req.preemptions += 1

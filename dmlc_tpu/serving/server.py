@@ -21,8 +21,13 @@ another replica), lets active decodes finish within
 generations are never dropped by the shutdown notice itself.
 
 Endpoints:
-  POST /generate   {"prompt": [int, ...], "max_tokens": int?}
-                   → request result document (scheduler.Request.result)
+  POST /generate   {"prompt": [int, ...], "max_tokens": int?,
+                    "priority": int|class-name?, "tenant": str?}
+                   → request result document (scheduler.Request.result).
+                   priority is a validated class (scheduler
+                   PRIORITY_CLASSES or an int under
+                   DMLC_SERVE_PRIORITY_LEVELS): admission and
+                   KV-pressure eviction prefer low-priority victims
   GET  /metrics    local Prometheus exposition (serving + step-ledger +
                    hand-rendered dmlc_slo_* families)
   GET  /healthz    engine stats: queues, KV pool, ledger + request
@@ -188,6 +193,8 @@ class ServingHTTPServer:
                     if request_id is not None \
                             and not isinstance(request_id, str):
                         raise ValueError("request_id must be a string")
+                    priority = doc.get("priority")
+                    tenant = doc.get("tenant")
                 except (KeyError, ValueError, TypeError,
                         json.JSONDecodeError) as e:
                     self._answer(400, {"error": f"bad request: {e}"})
@@ -196,9 +203,11 @@ class ServingHTTPServer:
                     # request_id is the idempotency key: a duplicate of
                     # a live or recently finished request returns the
                     # SAME request (no second generation) — see
-                    # InferenceEngine.submit
+                    # InferenceEngine.submit.  priority/tenant are
+                    # validated inside submit (ValueError → 400 below)
                     req = eng.submit(prompt, max_new_tokens=max_tokens,
-                                     request_id=request_id)
+                                     request_id=request_id,
+                                     priority=priority, tenant=tenant)
                 except AdmissionFull as e:
                     self._answer(429, {"error": str(e)},
                                  extra_headers={"Retry-After": "1"})

@@ -44,7 +44,7 @@ from ..base import get_env
 from ..concurrency import make_lock
 from .slo import SLO_KINDS
 
-__all__ = ["Watchdog", "ANOMALY_KINDS", "COMPUTE_KINDS"]
+__all__ = ["Watchdog", "ANOMALY_KINDS", "COMPUTE_KINDS", "FLEET_KINDS"]
 
 logger = logging.getLogger("dmlc_tpu.tracker")
 
@@ -55,6 +55,12 @@ ANOMALY_KINDS = ("straggler", "regression", "feed_stall",
 # (telemetry.compute.status); like the SLO kinds they apply/clear
 # directly from each shipped verdict — no consecutive-step gating
 COMPUTE_KINDS = ("recompile_storm",)
+
+# fleet-controller kinds ride the heartbeat ``fleet`` sub-doc (the
+# autoscaler's status); the saturation verdict is the controller's own
+# hysterized decision (scale-up wanted but no host/replica headroom),
+# so flags apply/clear directly — no consecutive-step gating
+FLEET_KINDS = ("fleet_saturated",)
 
 # per-rank recent-step window used for the cluster median/MAD view
 _RECENT = 32
@@ -141,6 +147,9 @@ class Watchdog:
             comp = doc.get("compute")
             if isinstance(comp, dict):
                 self.ingest_compute(rank, comp)
+            fleet = doc.get("fleet")
+            if isinstance(fleet, dict):
+                self.ingest_fleet(rank, fleet)
             trace = doc.get("trace")
             if not isinstance(trace, dict):
                 return
@@ -238,6 +247,34 @@ class Watchdog:
                               f"worker-reported recompile storm "
                               f"(sites {clean.get('storm_sites')})"))
             elif not storming and kind in st.active:
+                st.active.discard(kind)
+                st.active_since.pop(kind, None)
+                self._log.info("anomaly cleared: rank %d %s", rank, kind)
+        for kind, detail in fresh:
+            self._flag(rank, kind, detail, {}, step_gated=False)
+
+    def ingest_fleet(self, rank: int, doc: Dict) -> None:
+        """Mirror a fleet controller's shipped status (the heartbeat
+        ``fleet`` sub-doc from ``fleet.Autoscaler.status``) into this
+        rank's anomaly flags under :data:`FLEET_KINDS`.  Saturation is
+        the controller's own hysterized verdict — scale-up wanted but
+        no host/replica headroom left — so flags apply/clear directly,
+        no consecutive-step gating."""
+        if rank < 0 or not isinstance(doc, dict):
+            return
+        saturated = bool(doc.get("saturated"))
+        why = doc.get("detail")
+        fresh = []
+        with self._lock:
+            st = self._ranks.setdefault(rank, _RankState())
+            kind = "fleet_saturated"
+            if saturated and kind not in st.active:
+                st.active.add(kind)
+                st.active_since[kind] = time.time()
+                fresh.append((kind,
+                              "controller-reported fleet saturation "
+                              f"({why or 'scale-up wanted, no headroom'})"))
+            elif not saturated and kind in st.active:
                 st.active.discard(kind)
                 st.active_since.pop(kind, None)
                 self._log.info("anomaly cleared: rank %d %s", rank, kind)
@@ -473,7 +510,8 @@ class Watchdog:
             items = [(r, sorted(st.active))
                      for r, st in sorted(self._ranks.items())]
         for r, kinds in items:
-            for kind in ANOMALY_KINDS + SLO_KINDS + COMPUTE_KINDS:
+            for kind in (ANOMALY_KINDS + SLO_KINDS + COMPUTE_KINDS
+                         + FLEET_KINDS):
                 val = 1 if kind in kinds else 0
                 lines.append(
                     f'dmlc_anomaly_active{{rank="{r}",kind="{kind}"}} '

@@ -236,6 +236,34 @@ METRIC_NAMES = frozenset({
     "dmlc_router_replica_queue_depth",
     "dmlc_router_replica_dispatches",
     "dmlc_router_replica_failures",
+    # dynamic replica registry (autoscaler surface on the router)
+    "dmlc_router_replicas_added",
+    "dmlc_router_replicas_removed",
+    # per-tenant fairness (TenantGovernor): router-registry counter +
+    # hand-rendered tenant-labeled families
+    "dmlc_router_tenant_rejections",
+    "dmlc_tenant_requests_total",
+    "dmlc_tenant_admitted_total",
+    "dmlc_tenant_rejected_total",
+    "dmlc_tenant_tokens_generated_total",
+    "dmlc_tenant_bucket_level",
+    "dmlc_tenant_weight",
+    # fleet autoscaler (fleet/autoscaler.py): hand-rendered label-free
+    # control-loop families on the router /metrics
+    "dmlc_fleet_replicas",
+    "dmlc_fleet_owned_replicas",
+    "dmlc_fleet_utilization",
+    "dmlc_fleet_slo_hot",
+    "dmlc_fleet_high_streak",
+    "dmlc_fleet_low_streak",
+    "dmlc_fleet_cooldown_remaining_s",
+    "dmlc_fleet_saturated",
+    "dmlc_fleet_ticks_total",
+    "dmlc_fleet_scale_ups_total",
+    "dmlc_fleet_scale_downs_total",
+    "dmlc_fleet_saturations_total",
+    # fleet_saturated anomaly flag events (Watchdog._flag counter)
+    "dmlc_anomaly_fleet_saturated_flags",
     # serving SLO monitor (telemetry.slo): counter + hand-rendered
     # labeled gauge families on the serving /metrics
     "dmlc_slo_violations",
@@ -294,6 +322,8 @@ NON_METRIC_TOKENS = frozenset({
     "dmlc_serve",         # bin/dmlc-serve launcher name in prose
     "dmlc_router",        # prose prefix for the dmlc_router_* family
     "dmlc_router_replica",  # prose prefix: dmlc_router_replica_<field>
+    "dmlc_tenant",        # prose prefix for the dmlc_tenant_* family
+    "dmlc_fleet",         # prose prefix for the dmlc_fleet_* family
     "dmlc_slo",           # prose prefix for the dmlc_slo_* family
     "dmlc_serving_http",  # prose prefix: dmlc_serving_http_<code>
     "dmlc_recordio_spans",  # native ABI symbol (dmlc_native.cc)

@@ -459,15 +459,31 @@ class RabitTracker:
 
     def _http_resize(self, doc: Dict) -> Dict:
         """POST /resize handler: {'world': N} grows (or re-targets) the
-        world; survivors learn via the heartbeat generation piggyback."""
+        world; an optional {'remove': [rank, ...]} list names ranks to
+        evict from the next generation (the fleet autoscaler's
+        preemption path: the victim is killed first, then named here so
+        the shrink opens deterministically instead of waiting out the
+        miss window).  Survivors learn via the heartbeat generation
+        piggyback."""
         world = doc.get("world")
         if world is not None:
-            world = int(world)
+            if isinstance(world, bool) or not isinstance(world, int):
+                raise ValueError("world must be an integer")
             if not 0 < world <= 65536:
                 raise ValueError(f"world {world} out of range")
-        gen = self.request_resize(world=world,
+        remove = doc.get("remove", ())
+        if remove:
+            if (not isinstance(remove, list)
+                    or not all(isinstance(r, int)
+                               and not isinstance(r, bool)
+                               for r in remove)):
+                raise ValueError("remove must be a list of ranks")
+            if not all(0 <= r < 65536 for r in remove):
+                raise ValueError(f"remove ranks {remove} out of range")
+        gen = self.request_resize(world=world, remove=remove,
                                   reason=str(doc.get("reason", "operator")))
         return {"requested": True, "gen": gen, "world_target": world,
+                "remove": sorted(set(remove)) if remove else [],
                 "current_world": self._world}
 
     def _apply_pending_resize(self) -> None:

@@ -1,0 +1,608 @@
+#!/usr/bin/env python
+"""Cluster-brain end-to-end smoke (ci.sh stage 13): SLO-driven
+autoscaling funded by training preemption, plus per-tenant fairness.
+
+The full ISSUE 17 acceptance flow in one process tree:
+
+  1. a 2-worker **background elastic training job** (the deterministic
+     full-batch linear model from elastic_smoke, loss trajectory
+     world-size invariant) trains under a real tracker; two gated
+     holds keep it mid-flight while the fleet reshapes around it;
+  2. **2 serving replicas** (real InferenceEngine + ServingHTTPServer
+     subprocesses) sit behind the Router; the Autoscaler watches
+     utilization + /slo burn on a control thread;
+  3. a **loadgen spike** pushes utilization over the high-water mark:
+     the controller preempts training rank 1 (SIGKILL + POST /resize
+     with the remove list), gang-launches a third replica on the
+     "freed host", registers it with the router — scale-to-3 with the
+     spike's p99 TTFT still bounded;
+  4. the spike ends: after cooldown the controller flips the scaled
+     replica DRAINING, drains it (SIGTERM → clean REPLICA_DRAINED
+     exit), gives the host back (fresh training worker + grow resize)
+     — a light tail load running through the transition sees ZERO
+     client-visible failures and zero 503s;
+  5. training resumes to completion in the regrown world and rank 0's
+     loss trajectory must match the uninterrupted single-process
+     oracle within float tolerance;
+  6. a **two-tenant phase** (paid weight 50 vs free weight 1 under an
+     enforcing token bucket) shows free absorbing every 429 while
+     paid takes none and its p99 TTFT holds;
+  7. the router's /metrics is strict-Prometheus with the dmlc_fleet_*
+     and dmlc_tenant_* families, and /fleet reports the controller's
+     counters.
+
+Exit 0 on success, 1 with a diagnostic on any failure.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# training job shape (same world-size-invariant math as elastic_smoke)
+N_FEATURES = 7
+N_RECORDS = 240
+STEPS = 60
+HOLD1 = 20           # held here until the scale-up completed
+HOLD2 = 40           # held here until the scale-down/regrow posted
+LR = 0.05
+PACE_S = 0.2
+MISS_WINDOW_S = 2.0
+GRACE_S = 2.0
+
+# serving shape
+MAX_TOKENS = 12
+P99_TTFT_BOUND_S = 30.0
+BOOT_TIMEOUT_S = 180.0
+
+REPLICA_PROG = r"""
+import os, sys, time
+sys.path.insert(0, os.environ["FLEET_REPO"])
+import jax
+from dmlc_tpu.models import transformer as tfm
+from dmlc_tpu.serving import InferenceEngine, ServingHTTPServer
+
+cfg = tfm.TransformerConfig(
+    vocab=128, d_model=32, n_heads=2, head_dim=8, d_ff=64,
+    n_layers=2, n_experts=1, microbatches=1, dtype="float32")
+params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+engine = InferenceEngine(params, cfg, n_blocks=128, block_size=8,
+                         max_active=4, queue_depth=32,
+                         admit_timeout_s=5.0)
+engine.start()
+server = ServingHTTPServer(engine, port=int(os.environ["FLEET_PORT"]))
+server.install_drain_handler()
+print("REPLICA_URL", server.url, flush=True)
+while not engine.draining:
+    time.sleep(0.1)
+server.wait_drained(120)
+print("REPLICA_DRAINED", flush=True)
+"""
+
+
+def fail(msg: str) -> None:
+    print(f"autoscale smoke FAIL: {msg}", file=sys.stderr)
+    sys.exit(1)
+
+
+# ---------------------------------------------------------------------------
+# shared model math (worker and oracle run the SAME code)
+# ---------------------------------------------------------------------------
+
+def make_data(path: str):
+    import numpy as np
+
+    from dmlc_tpu.io.recordio import RecordIOWriter
+    from dmlc_tpu.io.stream import Stream
+
+    rng = np.random.default_rng(42)
+    w_true = rng.standard_normal(N_FEATURES)
+    X = rng.standard_normal((N_RECORDS, N_FEATURES))
+    y = X @ w_true + 0.01 * rng.standard_normal(N_RECORDS)
+    with Stream.create(path, "w") as s:
+        w = RecordIOWriter(s)
+        for i in range(N_RECORDS):
+            row = np.concatenate([X[i], [y[i]]]).astype(np.float32)
+            w.write_record(row.tobytes())
+    return X.astype(np.float64), y.astype(np.float64)
+
+
+def grad_and_loss(X, y, w):
+    import numpy as np
+
+    r = X @ w - y
+    return np.concatenate([X.T @ r, [float(len(y)), 0.5 * float(r @ r)]])
+
+
+def oracle_trajectory(X, y):
+    import numpy as np
+
+    w = np.zeros(N_FEATURES)
+    losses = {}
+    for step in range(1, STEPS + 1):
+        tot = grad_and_loss(X, y, w)
+        w = w - LR * tot[:N_FEATURES] / tot[N_FEATURES]
+        losses[step] = tot[N_FEATURES + 1] / tot[N_FEATURES]
+    return losses, w
+
+
+# ---------------------------------------------------------------------------
+# training worker (run as: autoscale_smoke.py --worker)
+# ---------------------------------------------------------------------------
+
+def worker_main() -> None:
+    import numpy as np
+
+    from dmlc_tpu.checkpoint import CheckpointManager
+    from dmlc_tpu.io import input_split
+    from dmlc_tpu.telemetry import HeartbeatSender
+    from dmlc_tpu.tracker.client import TrackerClient, WorldResized
+
+    uri = os.environ["AS_SMOKE_DATA"]
+    log_path = os.environ["AS_SMOKE_LOG"]
+    mapdir = os.environ["AS_SMOKE_MAPDIR"]
+    holds = ((HOLD1, os.environ["AS_SMOKE_RESUME1"]),
+             (HOLD2, os.environ["AS_SMOKE_RESUME2"]))
+    manager = CheckpointManager(os.environ["AS_SMOKE_CKPT"],
+                                max_to_keep=3)
+
+    def load_part(rank, world):
+        split = input_split.create(uri, rank, world, "recordio",
+                                   threaded=False)
+        rows = [np.frombuffer(bytes(r), np.float32).astype(np.float64)
+                for r in split]
+        split.close()
+        if not rows:
+            return (np.zeros((0, N_FEATURES)), np.zeros(0))
+        m = np.stack(rows)
+        return m[:, :N_FEATURES], m[:, N_FEATURES]
+
+    c = TrackerClient().start()
+    hb = HeartbeatSender(c, interval=0.2)
+    hb.send_once()
+    w = np.zeros(N_FEATURES)
+    step = 0
+    X, y = load_part(c.rank, c.world_size)
+    need_sync = True
+    while step < STEPS:
+        try:
+            if need_sync:
+                if c.rank == 0:
+                    got_step, restored = manager.restore_latest({"w": w})
+                    if got_step is not None:
+                        w, step = restored["w"].astype(np.float64), \
+                            got_step
+                    payload = np.concatenate([w, [float(step)]])
+                else:
+                    payload = np.zeros(N_FEATURES + 1)
+                payload = c.broadcast(payload, root=0)
+                w, step = payload[:N_FEATURES], int(payload[N_FEATURES])
+                X, y = load_part(c.rank, c.world_size)
+                with open(os.path.join(mapdir, f"rank.{c.rank}"),
+                          "w") as f:
+                    f.write(str(os.getpid()))
+                need_sync = False
+            # gated holds: the job parks mid-flight (heartbeats still
+            # flowing) while the harness preempts / restores around it;
+            # check_resized keeps resize generations serviced in-hold
+            for hold_step, resume in holds:
+                while step == hold_step and not os.path.exists(resume):
+                    c.check_resized()
+                    time.sleep(0.1)
+            c.check_resized()
+            tot = c.allreduce_sum(grad_and_loss(X, y, w))
+        except WorldResized:
+            c.resize()
+            need_sync = True
+            continue
+        w = w - LR * tot[:N_FEATURES] / tot[N_FEATURES]
+        loss = tot[N_FEATURES + 1] / tot[N_FEATURES]
+        step += 1
+        if c.rank == 0:
+            manager.save(step, {"w": w})
+            with open(log_path, "a") as f:
+                f.write(f"{step} {loss:.12e}\n")
+        time.sleep(PACE_S)
+    if c.rank == 0:
+        np.save(os.environ["AS_SMOKE_WOUT"], w)
+    hb.close()
+    c.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# serving replica subprocess
+# ---------------------------------------------------------------------------
+
+class ReplicaProc:
+    def __init__(self, port: int):
+        self.port = port
+        self.url = f"http://127.0.0.1:{port}"
+        env = dict(os.environ, FLEET_REPO=REPO, FLEET_PORT=str(port),
+                   JAX_PLATFORMS="cpu")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-c", REPLICA_PROG], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        self.lines = []
+        threading.Thread(target=self._read, daemon=True).start()
+
+    def _read(self):
+        for line in self.proc.stdout:
+            self.lines.append(line.rstrip())
+
+    def wait_ready(self, timeout_s: float = BOOT_TIMEOUT_S) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if any(ln.startswith("REPLICA_URL") for ln in self.lines):
+                return
+            if self.proc.poll() is not None:
+                raise AssertionError(
+                    f"replica :{self.port} died at boot:\n"
+                    + "\n".join(self.lines[-20:]))
+            time.sleep(0.1)
+        raise AssertionError(f"replica :{self.port} never came up")
+
+    def stop(self):
+        if self.proc.poll() is None:
+            self.proc.kill()
+            self.proc.wait(10)
+
+
+def fetch(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def _log_steps(log_path):
+    losses = {}
+    if os.path.exists(log_path):
+        for line in open(log_path):
+            parts = line.split()
+            if len(parts) == 2:
+                losses[int(parts[0])] = float(parts[1])  # last wins
+    return losses
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+
+def main() -> None:
+    import numpy as np
+
+    from dmlc_tpu import telemetry
+    from dmlc_tpu.fleet import (Autoscaler, ResizeClient,
+                                TrainingPreemptingProvider)
+    from dmlc_tpu.serving import LoadGenerator
+    from dmlc_tpu.serving.router import (Router, RouterHTTPServer,
+                                         TenantGovernor)
+    from dmlc_tpu.telemetry.exporters import validate_exposition_text
+    from dmlc_tpu.tracker import RabitTracker
+    from dmlc_tpu.tracker.rendezvous import free_port
+
+    telemetry.reset()
+    tmpdir = tempfile.TemporaryDirectory()
+    tmp = tmpdir.name
+    data = os.path.join(tmp, "data.rec")
+    X, y = make_data(data)
+    oracle, oracle_w = oracle_trajectory(X, y)
+    log_path = os.path.join(tmp, "loss.log")
+    resume1 = os.path.join(tmp, "resume1")
+    resume2 = os.path.join(tmp, "resume2")
+
+    # --- background elastic training job (world 2) ---------------------
+    tracker = RabitTracker("127.0.0.1", 2, metrics_port=0,
+                           miss_window_s=MISS_WINDOW_S, elastic=True,
+                           elastic_grace_s=GRACE_S)
+    tracker.start(2)
+    wenv = dict(
+        os.environ,
+        DMLC_TRACKER_URI="127.0.0.1",
+        DMLC_TRACKER_PORT=str(tracker.port),
+        DMLC_CLIENT_OP_TIMEOUT_S="120",
+        AS_SMOKE_DATA=data,
+        AS_SMOKE_CKPT=os.path.join(tmp, "ckpt"),
+        AS_SMOKE_LOG=log_path,
+        AS_SMOKE_MAPDIR=tmp,
+        AS_SMOKE_RESUME1=resume1,
+        AS_SMOKE_RESUME2=resume2,
+        AS_SMOKE_WOUT=os.path.join(tmp, "w_final.npy"),
+    )
+
+    def spawn_worker(task_id):
+        env = dict(wenv, DMLC_TASK_ID=str(task_id))
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            env=env)
+
+    workers = [spawn_worker(i) for i in range(2)]
+    deadline = time.monotonic() + 120
+    while not (os.path.exists(os.path.join(tmp, "rank.0"))
+               and os.path.exists(os.path.join(tmp, "rank.1"))
+               and _log_steps(log_path)):
+        if time.monotonic() > deadline:
+            fail("training job never reached its first step")
+        if tracker.error is not None:
+            fail(f"tracker died: {tracker.error}")
+        time.sleep(0.2)
+    print("autoscale smoke: training job up (world 2, stepping)",
+          flush=True)
+
+    # --- serving fleet: 2 replicas + router + autoscaler ---------------
+    reps = [ReplicaProc(free_port()) for _ in range(2)]
+    for rp in reps:
+        rp.wait_ready()
+    for rp in reps:
+        warm = LoadGenerator(rp.url, n_streams=2, requests_per_stream=1,
+                             prompt_len=(4, 24), max_tokens=4,
+                             vocab=128, seed=99)
+        warm.run()
+        if warm.failures:
+            fail(f"replica warmup failed: {warm.failures[:2]}")
+    print("autoscale smoke: 2 replicas warmed", flush=True)
+
+    gov = TenantGovernor(rate=0.0, burst_s=1.0,
+                         weights={"paid": 50.0, "free": 1.0})
+    router = Router([rp.url for rp in reps], health_interval_s=0.2,
+                    probe_base_s=0.2, probe_max_s=2.0, retries=3,
+                    dispatch_timeout_s=120.0, request_timeout_s=240.0,
+                    tenants=gov)
+
+    victim_proc = {}
+    scaled = {}
+
+    def kill_rank(rank):
+        pid = int(open(os.path.join(tmp, f"rank.{rank}")).read())
+        victim_proc["pid"] = pid
+        os.kill(pid, signal.SIGKILL)
+
+    def launch_replica(rank):
+        rp = ReplicaProc(free_port())
+        rp.wait_ready()
+        warm = LoadGenerator(rp.url, n_streams=2, requests_per_stream=1,
+                             prompt_len=(4, 24), max_tokens=4,
+                             vocab=128, seed=98)
+        warm.run()
+        if warm.failures:
+            fail(f"scaled replica warmup failed: {warm.failures[:2]}")
+        scaled[rp.url] = rp
+        return rp.url
+
+    def stop_replica(url):
+        rp = scaled[url]
+        rp.proc.send_signal(signal.SIGTERM)
+        rc = rp.proc.wait(120)
+        if rc != 0:
+            fail(f"drained replica exited rc={rc}")
+        if not any("REPLICA_DRAINED" in ln for ln in rp.lines):
+            fail("drained replica never reported a clean drain:\n"
+                 + "\n".join(rp.lines[-10:]))
+
+    def relaunch_rank(rank):
+        workers.append(spawn_worker(10 + rank))
+
+    provider = TrainingPreemptingProvider(
+        ResizeClient(f"http://127.0.0.1:{tracker.metrics_port}"),
+        full_world=2, kill_rank=kill_rank, launch_replica=launch_replica,
+        stop_replica=stop_replica, relaunch_rank=relaunch_rank,
+        min_world=1)
+    scaler = Autoscaler(router, provider, interval_s=0.3,
+                        high_water=0.7, low_water=0.15, hysteresis=2,
+                        cooldown_s=3.0, min_replicas=2, max_replicas=3)
+    server = RouterHTTPServer(router, port=0, fleet_source=lambda: scaler)
+    scaler.start()
+    print(f"autoscale smoke: router at {server.url}, controller on",
+          flush=True)
+
+    try:
+        run(tracker, router, server, scaler, gov, workers, victim_proc,
+            log_path, resume1, resume2, oracle, oracle_w, wenv,
+            LoadGenerator, validate_exposition_text, np)
+    finally:
+        scaler.close()
+        server.close()
+        router.close()
+        for rp in list(reps) + list(scaled.values()):
+            rp.stop()
+        for p in workers:
+            if p.poll() is None:
+                p.kill()
+        tracker.close()
+        tmpdir.cleanup()
+    print("autoscale smoke OK")
+
+
+def run(tracker, router, server, scaler, gov, workers, victim_proc,
+        log_path, resume1, resume2, oracle, oracle_w, wenv,
+        LoadGenerator, validate_exposition_text, np):
+    def healthz():
+        return json.loads(fetch(server.url + "/healthz"))
+
+    def elastic():
+        return json.loads(fetch(
+            f"http://127.0.0.1:{tracker.metrics_port}/healthz"))["elastic"]
+
+    # --- phase 1: spike -> scale-to-3 via training preemption ----------
+    spike = LoadGenerator(server.url, n_streams=12,
+                          requests_per_stream=5, prompt_len=(4, 24),
+                          max_tokens=MAX_TOKENS, vocab=128, seed=0)
+    summary = {}
+    runner = threading.Thread(
+        target=lambda: summary.update(spike.run()), daemon=True)
+    runner.start()
+    deadline = time.monotonic() + 180
+    while scaler.report()["counters"]["scale_ups"] < 1:
+        if time.monotonic() > deadline:
+            fail(f"spike never triggered a scale-up: "
+                 f"{json.dumps(scaler.report())}")
+        if not runner.is_alive() and not summary:
+            fail("spike loadgen died before the scale-up")
+        time.sleep(0.2)
+    rep = scaler.report()
+    if rep["replicas"] != 3 or len(rep["owned"]) != 1:
+        fail(f"scale-up did not land 3 routed replicas: {rep}")
+    st = provider_stats = rep["provider"]
+    if st["training_world"] != 1 or st["preemptions"] != 1:
+        fail(f"training was not preempted to world 1: {provider_stats}")
+    el = elastic()
+    if el["world"] != 1:
+        fail(f"tracker world != 1 after preemption: {el}")
+    print(f"autoscale smoke: scale-up OK — training preempted to "
+          f"world 1, fleet at 3 (gen {el['gen']})", flush=True)
+    # rank 0 may resume through the shrink now
+    open(resume1, "w").close()
+    runner.join(240)
+    if runner.is_alive():
+        fail("spike loadgen wedged")
+    want = 12 * 5
+    if summary.get("n_requests_ok") != want \
+            or summary.get("n_requests_failed", 1) != 0:
+        fail(f"spike leaked client-visible failures: "
+             f"{json.dumps(summary)[:500]}; {spike.failures[:3]}")
+    if not summary["p99_ttft_s"] or summary["p99_ttft_s"] > \
+            P99_TTFT_BOUND_S:
+        fail(f"spike p99 TTFT {summary['p99_ttft_s']}s over the "
+             f"{P99_TTFT_BOUND_S}s bound")
+    print(f"autoscale smoke: spike absorbed (p99_ttft="
+          f"{summary['p99_ttft_s']:.2f}s, ok={summary['n_requests_ok']})",
+          flush=True)
+
+    # --- phase 2: spike over -> drain-based scale-down + regrow --------
+    tail = LoadGenerator(server.url, n_streams=2,
+                         requests_per_stream=10, prompt_len=(4, 16),
+                         max_tokens=6, vocab=128, seed=1)
+    s2 = {}
+    runner = threading.Thread(target=lambda: s2.update(tail.run()),
+                              daemon=True)
+    runner.start()
+    deadline = time.monotonic() + 180
+    while scaler.report()["counters"]["scale_downs"] < 1:
+        if time.monotonic() > deadline:
+            fail(f"scale-down never fired: {json.dumps(scaler.report())}")
+        time.sleep(0.2)
+    deadline = time.monotonic() + 60
+    while elastic()["gen"] < 2:
+        if time.monotonic() > deadline:
+            fail(f"grow generation never opened: {elastic()}")
+        time.sleep(0.2)
+    # rank 0 may resume through the grow; the fresh joiner syncs in
+    open(resume2, "w").close()
+    runner.join(240)
+    if runner.is_alive():
+        fail("tail loadgen wedged through the scale-down")
+    if s2.get("n_requests_ok") != 20 or s2.get("n_requests_failed",
+                                               1) != 0:
+        fail(f"scale-down leaked client-visible failures: "
+             f"{json.dumps(s2)[:400]}; {tail.failures[:3]}")
+    if s2.get("n_backoffs_503"):
+        fail(f"{s2['n_backoffs_503']} 503(s) reached clients during "
+             f"the drain")
+    rep = scaler.report()
+    if rep["replicas"] != 2 or rep["owned"]:
+        fail(f"fleet did not return to 2 operator replicas: {rep}")
+    print("autoscale smoke: scale-down OK — replica drained with zero "
+          "client-visible failures, host returned", flush=True)
+
+    # --- phase 3: training regrows and finishes with loss parity -------
+    deadline = time.monotonic() + 120
+    while elastic()["world"] != 2:
+        if time.monotonic() > deadline:
+            fail(f"training never regrew to world 2: {elastic()}")
+        time.sleep(0.2)
+    print(f"autoscale smoke: training regrown (gen "
+          f"{elastic()['gen']}, world 2)", flush=True)
+    exits = {}
+    deadline = time.monotonic() + 240
+    for p in workers:
+        try:
+            exits[p.pid] = p.wait(timeout=max(1, deadline -
+                                              time.monotonic()))
+        except subprocess.TimeoutExpired:
+            fail(f"training worker pid {p.pid} never finished "
+                 f"(log at step {max(_log_steps(log_path), default=0)})")
+    vp = victim_proc.get("pid")
+    if vp is None or vp not in exits:
+        fail(f"victim pid {vp} not among workers {list(exits)}")
+    if exits[vp] not in (-9, 137):
+        fail(f"victim exited {exits[vp]}, want SIGKILL")
+    clean = [rc for pid, rc in exits.items() if pid != vp]
+    if clean != [0, 0]:
+        fail(f"surviving workers exited {clean} (want two clean exits)")
+    losses = _log_steps(log_path)
+    missing = [s for s in range(1, STEPS + 1) if s not in losses]
+    if missing:
+        fail(f"loss log missing steps {missing[:10]}")
+    worst = max(abs(losses[s] - oracle[s]) / max(abs(oracle[s]), 1e-12)
+                for s in range(1, STEPS + 1))
+    if worst > 1e-6:
+        fail(f"loss trajectory diverged from the uninterrupted oracle: "
+             f"max rel err {worst:.3e}")
+    w_final = np.load(wenv["AS_SMOKE_WOUT"])
+    if not np.allclose(w_final, oracle_w, rtol=1e-6, atol=1e-9):
+        fail(f"final weights diverged: {w_final} vs {oracle_w}")
+    print(f"autoscale smoke: loss parity through preempt+regrow over "
+          f"{STEPS} steps (max rel err {worst:.2e})", flush=True)
+
+    # --- phase 4: two-tenant fairness under an enforcing bucket --------
+    gov.rate = 2.0   # tokens/s per unit weight: free=2/s, paid=100/s
+    fair = LoadGenerator(
+        server.url, prompt_len=(4, 12), max_tokens=4, vocab=128,
+        seed=2, requests_per_stream=8,
+        tenants=[{"tenant": "paid", "streams": 3,
+                  "priority": "interactive"},
+                 {"tenant": "free", "streams": 3, "priority": "batch"}])
+    s3 = fair.run()
+    gov.rate = 0.0
+    per = s3.get("tenants") or {}
+    if set(per) < {"paid", "free"}:
+        fail(f"per-tenant summary missing: {json.dumps(s3)[:400]}")
+    if s3.get("n_requests_failed"):
+        fail(f"tenant phase leaked failures: {fair.failures[:3]}")
+    if per["free"]["n_rejections_429"] < 1:
+        fail(f"over-budget tenant absorbed no 429s: {json.dumps(per)}")
+    if per["paid"]["n_rejections_429"] != 0:
+        fail(f"in-budget tenant was rejected: {json.dumps(per)}")
+    if not per["paid"]["p99_ttft_s"] or \
+            per["paid"]["p99_ttft_s"] > P99_TTFT_BOUND_S:
+        fail(f"paid tenant SLO broke: {json.dumps(per['paid'])}")
+    print(f"autoscale smoke: fairness OK — free absorbed "
+          f"{per['free']['n_rejections_429']} 429(s), paid took 0 "
+          f"(paid p99_ttft={per['paid']['p99_ttft_s']:.2f}s)",
+          flush=True)
+
+    # --- exposition: strict /metrics + /fleet --------------------------
+    text = fetch(server.url + "/metrics").decode()
+    validate_exposition_text(text)
+    for needle in ("dmlc_fleet_replicas 2", "dmlc_fleet_owned_replicas 0",
+                   "dmlc_fleet_scale_ups_total 1",
+                   "dmlc_fleet_scale_downs_total 1",
+                   'dmlc_tenant_admitted_total{tenant="paid"}',
+                   'dmlc_tenant_rejected_total{tenant="free"}',
+                   "dmlc_router_requests"):
+        if needle not in text:
+            fail(f"{needle} missing from router /metrics")
+    if f'dmlc_tenant_rejected_total{{tenant="paid"}} 0' not in text:
+        fail("paid tenant shows rejections on /metrics")
+    fleet_doc = json.loads(fetch(server.url + "/fleet"))
+    if fleet_doc["counters"]["scale_ups"] != 1 \
+            or fleet_doc["counters"]["scale_downs"] != 1:
+        fail(f"/fleet counters wrong: {json.dumps(fleet_doc)[:400]}")
+    print("autoscale smoke: /metrics strict-Prometheus with "
+          "dmlc_fleet_* + dmlc_tenant_* families; /fleet consistent",
+          flush=True)
+
+
+if __name__ == "__main__":
+    if "--worker" in sys.argv:
+        worker_main()
+    else:
+        main()

@@ -79,6 +79,17 @@
 #      tail hedging races two replicas without double-serving, and a
 #      graceful-drain (SIGTERM) phase shifts traffic with zero 503s
 #      reaching clients while the drained replica exits cleanly
+#  13. autoscale smoke: the cluster brain end to end — a loadgen spike
+#      against 2 replicas drives the SLO-aware autoscaler to preempt a
+#      live background elastic training job (SIGKILL rank 1 + shrink
+#      resize) and gang-launch a third replica on the freed host with
+#      p99 TTFT bounded through the transition; the spike's end
+#      triggers a drain-based scale-down with ZERO client-visible
+#      failures, the training job grows back and its loss trajectory
+#      matches the uninterrupted oracle; a two-tenant phase shows the
+#      over-budget tenant absorbing every 429 while the in-budget
+#      tenant's SLO holds; dmlc_fleet_* + dmlc_tenant_* families
+#      asserted on the router's strict-Prometheus /metrics
 #
 # Usage: scripts/ci.sh [pytest-args...]
 set -u
@@ -288,6 +299,10 @@ echo "== stage 12: fleet smoke (router failover, hedging, drain) =="
 timeout -k 10 480 env JAX_PLATFORMS=cpu python scripts/fleet_smoke.py \
     || { echo "FAIL: fleet smoke"; exit 1; }
 
+echo "== stage 13: autoscale smoke (cluster brain end to end) =="
+timeout -k 10 600 env JAX_PLATFORMS=cpu python scripts/autoscale_smoke.py \
+    || { echo "FAIL: autoscale smoke"; exit 1; }
+
 echo "== CI OK (native=$NATIVE_OK tsan=$TSAN_OK asan=$ASAN_OK" \
      "ubsan=$UBSAN_OK telemetry=1 chaos=1 perf=1 serving=1 elastic=1" \
-     "integrity=1 fleet=1) =="
+     "integrity=1 fleet=1 autoscale=1) =="

@@ -30,6 +30,12 @@ the step roofline (``mfu``/``membw_util``/``bound``), HBM peak and
 headroom, and the decode phase time shares; against a tracker the same
 pane shows per-rank recompile totals and storm-flagged ranks.
 
+Pointed at a **router** with an autoscaler wired, ``/fleet`` feeds a
+fleet pane: replica count, aggregate utilization, the controller's
+hysteresis streaks / cooldown / last decision (with ``SATURATED``
+highlighted), and a per-tenant admission line (weight, admitted,
+rejected) from the router ``/healthz`` tenants block.
+
 Runs full-screen (curses) when stdout is a TTY; ``--plain`` prints one
 table per refresh instead (pipe-friendly, and what the CI smoke
 drives).  ``--once`` renders a single refresh and exits.
@@ -46,7 +52,7 @@ import time
 import urllib.request
 
 __all__ = ["fetch", "render_table", "render_serving_pane",
-           "render_compute_pane", "main"]
+           "render_compute_pane", "render_fleet_pane", "main"]
 
 COLUMNS = ("RANK", "STEP ms", "EWMA ms", "GOODPUT", "MFU%", "FEED%",
            "HB AGE", "FLAGS", "REMED")
@@ -78,7 +84,7 @@ def fetch(base_url: str, timeout: float = 5.0) -> dict:
     out = {}
     for key, path in (("anomalies", "/anomalies"), ("healthz", "/healthz"),
                       ("requests", "/requests"), ("slo", "/slo"),
-                      ("compute", "/compute")):
+                      ("compute", "/compute"), ("fleet", "/fleet")):
         try:
             with urllib.request.urlopen(base_url + path,
                                         timeout=timeout) as r:
@@ -191,6 +197,40 @@ def render_compute_pane(doc: dict) -> list:
     return lines
 
 
+def render_fleet_pane(doc: dict) -> list:
+    """The fleet pane lines (empty unless the target is a router with
+    an autoscaler wired — i.e. it serves ``/fleet``): the control
+    loop's live verdict plus per-tenant admission shares from the
+    router /healthz tenants block."""
+    fl = doc.get("fleet") or {}
+    lines = []
+    if fl.get("config"):
+        util = fl.get("utilization")
+        sat = " SATURATED" if fl.get("saturated") else ""
+        hot = " slo_hot" if fl.get("slo_hot") else ""
+        counters = fl.get("counters") or {}
+        lines.append(
+            "fleet    replicas={} owned={} util={} streaks={}↑/{}↓ "
+            "cooldown={}s last={}{}{}  (ups={} downs={})".format(
+                fl.get("replicas", 0), len(fl.get("owned") or []),
+                _num(util, "{:.2f}"), fl.get("high_streak", 0),
+                fl.get("low_streak", 0),
+                _num(fl.get("cooldown_remaining_s"), "{:.0f}"),
+                fl.get("last_decision", "-"), sat, hot,
+                counters.get("scale_ups", 0),
+                counters.get("scale_downs", 0)))
+    tenants = ((doc.get("healthz") or {}).get("tenants") or {}).get(
+        "tenants") or []
+    if tenants:
+        parts = []
+        for t in tenants:
+            parts.append("{}:w{:g} ok={} rej={}".format(
+                t.get("tenant"), t.get("weight", 1),
+                t.get("admitted", 0), t.get("rejected", 0)))
+        lines.append("tenants  " + "  ".join(parts))
+    return lines
+
+
 def render_table(doc: dict, base_url: str = "") -> str:
     """The poll document as fixed-width text (one refresh)."""
     an = doc.get("anomalies") or {}
@@ -234,6 +274,7 @@ def render_table(doc: dict, base_url: str = "") -> str:
                      f"{v.get('detail', '')}")
     lines.extend(render_serving_pane(doc))
     lines.extend(render_compute_pane(doc))
+    lines.extend(render_fleet_pane(doc))
     return "\n".join(lines)
 
 

@@ -338,6 +338,77 @@ def test_http_resize_endpoint():
     plain.close()
 
 
+def test_http_resize_handler_validates_world_and_remove():
+    """_http_resize contract (handler-level): bad worlds and bad
+    remove lists are ValueErrors (the HTTP edge's 400), good requests
+    echo the merged plan."""
+    tracker = _elastic_tracker(1)
+    try:
+        for bad_world in (0, -3, 70000, "two", 2.5, True, False):
+            with pytest.raises(ValueError):
+                tracker._http_resize({"world": bad_world})
+        for bad_remove in ("1", {"rank": 1}, [1, "2"], [True],
+                          [1.5], [-1], [70000]):
+            with pytest.raises(ValueError):
+                tracker._http_resize({"remove": bad_remove})
+        doc = tracker._http_resize({"world": 3, "remove": [2, 2, 1],
+                                    "reason": "contract-test"})
+        assert doc["requested"] is True
+        assert doc["world_target"] == 3
+        assert doc["remove"] == [1, 2]          # deduped, sorted
+        assert isinstance(doc["gen"], int)
+        assert doc["current_world"] == 1
+        # remove-only request (the autoscaler's preemption shape)
+        doc = tracker._http_resize({"remove": [0]})
+        assert doc["requested"] is True and doc["world_target"] is None
+    finally:
+        tracker.close()
+
+    plain = RabitTracker("127.0.0.1", 1)
+    plain.start(1)
+    try:
+        with pytest.raises(RuntimeError):
+            plain._http_resize({"world": 2})
+    finally:
+        plain.close()
+
+
+def test_http_resize_bad_requests_are_400s():
+    tracker = _elastic_tracker(1, metrics_port=0)
+    url = f"http://127.0.0.1:{tracker.metrics_port}/resize"
+    try:
+        for bad in ({"world": 0}, {"world": -1}, {"world": "two"},
+                    {"world": 123456}, {"remove": "1"},
+                    {"remove": [True]}, {"remove": [-1]}):
+            req = urllib.request.Request(
+                url, data=json.dumps(bad).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 400, bad
+    finally:
+        tracker.close()
+
+
+def test_http_resize_retargets_unformed_world():
+    """A resize posted BEFORE any worker announces re-targets the
+    initial world size: the tracker was started expecting 2 but a
+    single worker forms a world of 1."""
+    tracker = _elastic_tracker(2, metrics_port=0)
+    body = json.dumps({"world": 1, "reason": "pre-start"}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{tracker.metrics_port}/resize", data=body,
+        headers={"Content-Type": "application/json"})
+    doc = json.loads(urllib.request.urlopen(req, timeout=10).read())
+    assert doc["requested"] is True and doc["current_world"] == 2
+    c = _client(tracker, "rt0").start()
+    assert c.world_size == 1 and c.rank == 0
+    assert float(c.allreduce_sum(np.asarray([2.0], np.float64))[0]) == 2.0
+    c.shutdown()
+    tracker.join(timeout=30)
+    tracker.close()
+
+
 def test_late_replacement_joins_as_scale_up():
     """A rank evicted past grace whose process finally comes back
     (recover@old-gen) is re-admitted as a scale-up join with a fresh

@@ -1,0 +1,311 @@
+"""Fleet control plane: the autoscaler's control law, the
+training-preempting host provider's sequencing, and the watchdog's
+fleet-saturation ingest (ISSUE 17 tentpole).
+
+All deterministic: the router is faked, ``tick(now=...)`` injects the
+clock, and the preemption transport is recorded callables.
+"""
+
+import json
+import time
+
+import pytest
+
+from dmlc_tpu import telemetry
+from dmlc_tpu.fleet import (Autoscaler, CallbackProvider, ResizeClient,
+                            TrainingPreemptingProvider)
+from dmlc_tpu.telemetry.anomaly import FLEET_KINDS, Watchdog
+from dmlc_tpu.telemetry.exporters import validate_exposition_text
+
+
+class FakeRouter:
+    """Just enough Router for the control law: a utilization dial and
+    a recording registry."""
+
+    def __init__(self, n=1, util=0.0):
+        self.util = util
+        self._urls = [f"http://seed-{i}:1" for i in range(n)]
+        self.calls = []
+
+    def utilization(self):
+        return self.util
+
+    def replica_views(self):
+        return [{"url": u, "state": "healthy"} for u in self._urls]
+
+    def add_replica(self, url):
+        self.calls.append(("add", url))
+        self._urls.append(url)
+
+    def set_draining(self, url):
+        self.calls.append(("drain", url))
+        return url in self._urls
+
+    def remove_replica(self, url):
+        self.calls.append(("remove", url))
+        if url in self._urls:
+            self._urls.remove(url)
+            return True
+        return False
+
+
+def _mk(router, capacity=8, **kw):
+    counter = [0]
+
+    def acquire():
+        counter[0] += 1
+        return f"http://scaled-{counter[0]}:1"
+
+    prov = CallbackProvider(acquire, lambda url: None, capacity=capacity)
+    kw.setdefault("interval_s", 0.01)
+    kw.setdefault("high_water", 0.8)
+    kw.setdefault("low_water", 0.3)
+    kw.setdefault("hysteresis", 3)
+    kw.setdefault("cooldown_s", 10.0)
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("slo_poll", lambda url: {})
+    return Autoscaler(router, prov, **kw)
+
+
+# ---------------------------------------------------------------------------
+# control law
+# ---------------------------------------------------------------------------
+
+def test_autoscaler_config_validation():
+    r = FakeRouter()
+    with pytest.raises(ValueError):
+        _mk(r, high_water=0.3, low_water=0.8)
+    with pytest.raises(ValueError):
+        _mk(r, min_replicas=3, max_replicas=2)
+
+
+def test_hysteresis_cooldown_and_scale_cycle():
+    r = FakeRouter(n=1, util=0.95)
+    a = _mk(r)
+    # hysteresis: two over-water ticks hold, the third scales up
+    assert a.tick(now=0.0) == "hold"
+    assert a.tick(now=1.0) == "hold"
+    assert a.tick(now=2.0) == "scale_up"
+    assert r.calls == [("add", "http://scaled-1:1")]
+    # cooldown: still overloaded, but no second action inside 10 s
+    assert a.tick(now=3.0) == "hold"
+    assert a.tick(now=4.0) == "hold"
+    # the streak kept building through the cooldown, so the first
+    # post-cooldown tick acts at once
+    assert a.tick(now=13.0) == "scale_up"
+    assert len(r._urls) == 3
+    # load drops: underloaded streak drains the NEWEST owned replica
+    r.util = 0.1
+    assert a.tick(now=26.0) == "hold"
+    assert a.tick(now=27.0) == "hold"
+    assert a.tick(now=28.0) == "scale_down"
+    # drain at the router FIRST (no new work), removal last
+    assert r.calls[-2:] == [("drain", "http://scaled-2:1"),
+                            ("remove", "http://scaled-2:1")]
+    assert r._urls == ["http://seed-0:1", "http://scaled-1:1"]
+    rep = a.report()
+    assert rep["counters"]["scale_ups"] == 2
+    assert rep["counters"]["scale_downs"] == 1
+    assert rep["owned"] == ["http://scaled-1:1"]
+
+
+def test_scale_down_never_touches_unowned_or_min_replicas():
+    # two seed replicas, idle forever: nothing is owned, nothing drains
+    r = FakeRouter(n=2, util=0.0)
+    a = _mk(r, hysteresis=1)
+    for i in range(5):
+        assert a.tick(now=float(i)) == "hold"
+    assert r.calls == []
+    # one owned replica, but the fleet sits AT min_replicas: held
+    r2 = FakeRouter(n=1, util=0.95)
+    a2 = _mk(r2, hysteresis=1, min_replicas=2, cooldown_s=1.0)
+    assert a2.tick(now=0.0) == "scale_up"     # fleet now 2 == min
+    r2.util = 0.0
+    assert a2.tick(now=5.0) == "hold"
+    assert len(r2._urls) == 2
+
+
+def test_saturation_flags_once_and_clears_with_pressure():
+    r = FakeRouter(n=1, util=0.95)
+    a = _mk(r, hysteresis=1, max_replicas=1, cooldown_s=0.0)
+    assert a.tick(now=0.0) == "saturated"
+    assert a.tick(now=1.0) == "saturated"
+    rep = a.report()
+    assert rep["saturated"] is True
+    assert rep["counters"]["saturations"] == 1   # transition-gated
+    assert a.status()["saturated"] is True
+    # pressure gone: the verdict clears without an action
+    r.util = 0.5
+    assert a.tick(now=2.0) == "hold"
+    assert a.report()["saturated"] is False
+    # provider exhaustion saturates too (capacity 0)
+    prov = CallbackProvider(lambda: None, lambda u: None, capacity=0)
+    a2 = Autoscaler(FakeRouter(n=1, util=0.95), prov, hysteresis=1,
+                    cooldown_s=0.0, high_water=0.8, low_water=0.3,
+                    max_replicas=4, slo_poll=lambda url: {})
+    assert a2.tick(now=0.0) == "saturated"
+
+
+def test_slo_burn_marks_fleet_hot_despite_low_utilization():
+    polled = []
+
+    def slo_poll(url):
+        polled.append(url)
+        return {"active": ["slo_ttft"]}
+
+    r = FakeRouter(n=1, util=0.1)   # well under water by queue depth
+    a = _mk(r, hysteresis=1, slo_poll=slo_poll)
+    assert a.tick(now=0.0) == "scale_up"
+    assert polled == ["http://seed-0:1"]
+    assert a.report()["slo_hot"] is True
+
+
+def test_report_status_and_prometheus_text():
+    r = FakeRouter(n=1, util=0.95)
+    a = _mk(r, hysteresis=1)
+    a.tick(now=0.0)
+    rep = a.report()
+    assert rep["replicas"] == 2 and rep["owned"] == ["http://scaled-1:1"]
+    assert rep["config"]["hysteresis"] == 1
+    assert rep["provider"] == {"kind": "callback", "capacity": 8,
+                               "leased": 1}
+    st = a.status()
+    assert st["replicas"] == 2 and "owned" in st["detail"]
+    text = a.prometheus_text()
+    validate_exposition_text(text)
+    for fam in ("dmlc_fleet_replicas 2", "dmlc_fleet_owned_replicas 1",
+                "dmlc_fleet_ticks_total 1", "dmlc_fleet_scale_ups_total 1",
+                "dmlc_fleet_saturated 0"):
+        assert fam in text, f"{fam} missing:\n{text}"
+
+
+def test_autoscaler_thread_lifecycle():
+    r = FakeRouter(n=1, util=0.0)
+    a = _mk(r, interval_s=0.01)
+    a.start()
+    a.start()   # idempotent
+    deadline = 200
+    while a.report()["counters"]["ticks"] < 3 and deadline:
+        deadline -= 1
+        time.sleep(0.01)
+    a.close()
+    assert a.report()["counters"]["ticks"] >= 3
+    assert a._thread is None
+
+
+# ---------------------------------------------------------------------------
+# host providers
+# ---------------------------------------------------------------------------
+
+def test_callback_provider_capacity_bound():
+    made = []
+    p = CallbackProvider(lambda: (made.append(1), f"u{len(made)}")[1],
+                         lambda u: None, capacity=2)
+    assert p.acquire() == "u1"
+    assert p.acquire() == "u2"
+    assert p.acquire() is None          # capacity exhausted
+    p.release("u1")
+    assert p.acquire() == "u3"
+    assert p.stats() == {"kind": "callback", "capacity": 2, "leased": 2}
+
+
+class _RecordingResize:
+    def __init__(self):
+        self.calls = []
+
+    def resize(self, world, remove=None):
+        self.calls.append(("resize", world, remove))
+        return {"requested": True, "world_target": world}
+
+
+def test_training_preemption_kills_then_resizes_then_launches():
+    rz = _RecordingResize()
+    seq = []
+    p = TrainingPreemptingProvider(
+        rz, full_world=3,
+        kill_rank=lambda r: seq.append(("kill", r)),
+        launch_replica=lambda r: (seq.append(("launch", r)),
+                                  f"http://freed-{r}:1")[1],
+        stop_replica=lambda u: seq.append(("stop", u)),
+        relaunch_rank=lambda r: seq.append(("relaunch", r)),
+        min_world=1)
+    url = p.acquire()
+    assert url == "http://freed-2:1"
+    # the contract: victim killed FIRST, then shrink WITH remove list,
+    # then the replica launch on the freed host
+    assert seq == [("kill", 2), ("launch", 2)]
+    assert rz.calls == [("resize", 2, [2])]
+    assert seq.index(("kill", 2)) == 0
+    url2 = p.acquire()
+    assert url2 == "http://freed-1:1"
+    assert p.stats()["training_world"] == 1
+    assert p.acquire() is None          # min_world floor: rank 0 stays
+    # release reverses: drain replica, relaunch worker, grow resize
+    seq.clear()
+    rz.calls.clear()
+    p.release(url2)
+    assert seq == [("stop", "http://freed-1:1"), ("relaunch", 1)]
+    assert rz.calls == [("resize", 2, None)]
+    with pytest.raises(KeyError):
+        p.release("http://never-leased:1")
+    st = p.stats()
+    assert st["preemptions"] == 2 and st["restores"] == 1
+    assert st["leases"] == {"http://freed-2:1": 2}
+
+
+def test_training_preemption_validates_worlds():
+    rz = _RecordingResize()
+    with pytest.raises(ValueError):
+        TrainingPreemptingProvider(rz, full_world=0, kill_rank=None,
+                                   launch_replica=None, stop_replica=None,
+                                   relaunch_rank=None)
+    with pytest.raises(ValueError):
+        TrainingPreemptingProvider(rz, full_world=2, kill_rank=None,
+                                   launch_replica=None, stop_replica=None,
+                                   relaunch_rank=None, min_world=3)
+
+
+def test_resize_client_against_elastic_tracker():
+    from dmlc_tpu.tracker import RabitTracker
+
+    tracker = RabitTracker("127.0.0.1", 1, metrics_port=0, elastic=True)
+    tracker.start(1)
+    try:
+        rc = ResizeClient(f"http://127.0.0.1:{tracker.metrics_port}")
+        doc = rc.resize(2)
+        assert doc["requested"] is True and doc["world_target"] == 2
+        doc = rc.resize(2, remove=[1])
+        assert doc["remove"] == [1]
+        el = rc.elastic_status()
+        assert el.get("enabled") is True or "gen" in el
+    finally:
+        tracker.close()
+
+
+# ---------------------------------------------------------------------------
+# watchdog ingest
+# ---------------------------------------------------------------------------
+
+def test_watchdog_ingest_fleet_flags_and_clears():
+    assert FLEET_KINDS == ("fleet_saturated",)
+    wd = Watchdog(window=3)
+    before = telemetry.snapshot()["counters"].get(
+        "anomaly", {}).get("fleet_saturated_flags", 0)
+    wd.ingest_json(0, json.dumps(
+        {"fleet": {"saturated": True, "detail": "replica cap reached"}}))
+    rep = wd.report()
+    assert rep["ranks"]["0"]["flags"] == ["fleet_saturated"]
+    assert any(a["kind"] == "fleet_saturated" for a in rep["active"])
+    after = telemetry.snapshot()["counters"]["anomaly"][
+        "fleet_saturated_flags"]
+    assert after == before + 1
+    assert 'kind="fleet_saturated"' in wd.prometheus_text()
+    # verdict withdrawn: clears without re-counting
+    wd.ingest_fleet(0, {"saturated": False})
+    assert wd.report()["ranks"]["0"]["flags"] == []
+    assert telemetry.snapshot()["counters"]["anomaly"][
+        "fleet_saturated_flags"] == after
+    # malformed docs are dropped, never raise
+    wd.ingest_fleet(-1, {"saturated": True})
+    wd.ingest_fleet(0, "nope")

@@ -21,7 +21,9 @@ import pytest
 from dmlc_tpu import telemetry
 from dmlc_tpu.resilience import fault
 from dmlc_tpu.serving.router import (DOWN, DRAINING, HEALTHY, Router,
-                                     RouterHTTPServer, discover_replicas)
+                                     RouterHTTPServer, TenantGovernor,
+                                     discover_replicas,
+                                     parse_tenant_weights)
 
 
 class FakeReplica:
@@ -78,6 +80,7 @@ class FakeReplica:
                 else:
                     self._send(200, {"state": "done",
                                      "output_ids": [1, 2, 3],
+                                     "n_generated": 3,
                                      "served": fake.name,
                                      "ttft_s": 0.01,
                                      "request_id": doc.get("request_id")})
@@ -447,3 +450,212 @@ def test_router_rejects_empty_or_duplicate_fleets():
     with pytest.raises(ValueError):
         Router(["http://h:1", "http://h:1/"],
                start_health_thread=False)
+
+
+# ---------------------------------------------------------------------------
+# per-tenant fairness (TenantGovernor)
+# ---------------------------------------------------------------------------
+
+def test_parse_tenant_weights_skips_malformed_entries():
+    assert parse_tenant_weights(None) == {}
+    assert parse_tenant_weights("") == {}
+    assert parse_tenant_weights("paid=4, free=1") == {
+        "paid": 4.0, "free": 1.0}
+    # malformed entries are dropped, valid ones survive
+    assert parse_tenant_weights(
+        "paid=4,broken,=2,neg=-1,zero=0,free=nan3,ok=2") == {
+        "paid": 4.0, "ok": 2.0}
+
+
+def test_tenant_governor_accounting_only_by_default():
+    g = TenantGovernor(rate=0.0, burst_s=10.0)
+    for _ in range(500):
+        admitted, retry = g.admit("anyone")
+        assert admitted and retry == 0.0
+    by_name = {v["tenant"]: v for v in g.views()}
+    assert by_name["anyone"]["requests"] == 500
+    assert by_name["anyone"]["admitted"] == 500
+    assert by_name["anyone"]["rejected"] == 0
+    assert g.stats()["enforcing"] is False
+
+
+def test_tenant_governor_weighted_rejection_and_honest_retry_after():
+    g = TenantGovernor(rate=1.0, burst_s=2.0,
+                       weights={"paid": 4.0, "free": 1.0})
+    t0 = 1000.0
+    # drain free's bucket at one instant (burst = 1*1*2 = 2 tokens)
+    n_ok = 0
+    while g.admit("free", now=t0)[0]:
+        n_ok += 1
+    assert n_ok == 2
+    admitted, retry = g.admit("free", now=t0)
+    assert not admitted
+    # honest Retry-After: free refills at 1 token/s, bucket is empty
+    assert retry == pytest.approx(1.0, abs=0.05)
+    # paid's bucket (burst 8, fill 4/s) is untouched by free's storm
+    assert g.admit("paid", now=t0)[0]
+    # after 0.5 s free has half a token → retry is the remaining half
+    admitted, retry = g.admit("free", now=t0 + 0.5)
+    assert not admitted and retry == pytest.approx(0.5, abs=0.05)
+    # a full second later one token is available again
+    assert g.admit("free", now=t0 + 1.6)[0]
+    by_name = {v["tenant"]: v for v in g.views()}
+    assert by_name["free"]["rejected"] == 3  # loop exit + 2 probes
+    assert by_name["paid"]["rejected"] == 0
+
+
+def test_tenant_governor_retry_after_is_clamped():
+    g = TenantGovernor(rate=0.001, burst_s=1000.0, default_weight=1.0)
+    t0 = 0.0
+    while g.admit("slow", now=t0)[0]:
+        pass
+    admitted, retry = g.admit("slow", now=t0)
+    # 1 token at 0.001/s would be 1000 s — clamped to the 60 s cap
+    assert not admitted and retry == 60.0
+
+
+def test_tenant_governor_overflow_folds_unknown_tenants():
+    g = TenantGovernor(rate=0.0, max_tenants=2,
+                       weights={"vip": 4.0})
+    g.admit("t1")
+    g.admit("t2")
+    for i in range(10):
+        g.admit(f"minted-{i}")   # hostile key minting
+    # configured tenants always get their own bucket, even past the cap
+    g.admit("vip")
+    by_name = {v["tenant"]: v for v in g.views()}
+    assert set(by_name) == {"t1", "t2", TenantGovernor.OVERFLOW, "vip"}
+    assert by_name[TenantGovernor.OVERFLOW]["requests"] == 10
+    assert by_name["vip"]["weight"] == 4.0
+
+
+def test_tenant_governor_prometheus_text_is_strict_and_labeled():
+    from dmlc_tpu.telemetry.exporters import validate_exposition_text
+
+    g = TenantGovernor(rate=1.0, burst_s=2.0, weights={"paid": 4.0})
+    assert g.prometheus_text() == ""   # no tenants yet → no families
+    g.admit("paid")
+    g.admit("free")
+    g.observe_completion("paid", 7)
+    text = g.prometheus_text()
+    validate_exposition_text(text)
+    assert 'dmlc_tenant_requests_total{tenant="paid"} 1' in text
+    assert 'dmlc_tenant_tokens_generated_total{tenant="paid"} 7' in text
+    assert 'dmlc_tenant_weight{tenant="paid"} 4.0' in text
+    assert 'dmlc_tenant_weight{tenant="free"} 1.0' in text
+
+
+# ---------------------------------------------------------------------------
+# dynamic registry (the autoscaler's surface)
+# ---------------------------------------------------------------------------
+
+def test_dynamic_registry_add_remove_and_draining(fleet):
+    a, b, r = fleet
+    c = FakeReplica("c")
+    try:
+        rep = r.add_replica(c.url)
+        assert rep.state == HEALTHY          # optimistic until next sweep
+        assert len(r.replica_views()) == 3
+        with pytest.raises(ValueError):
+            r.add_replica(c.url + "/")       # duplicate is a caller bug
+        assert r.set_draining(c.url)
+        assert r.counts()[DRAINING] == 1
+        # DRAINING sheds new placement to the remaining healthy pair
+        for i in range(6):
+            code, out, _ = r.route({"prompt": [1], "request_id": f"d{i}"})
+            assert code == 200 and out["served"] in ("a", "b")
+        assert not c.hits
+        assert r.remove_replica(c.url)
+        assert len(r.replica_views()) == 2
+        assert not r.remove_replica(c.url)   # already gone → False
+        assert not r.set_draining("http://nowhere:1")
+        cnt = _counters()
+        assert cnt.get("replicas_added", 0) >= 1
+        assert cnt.get("replicas_removed", 0) >= 1
+    finally:
+        c.close()
+
+
+def test_utilization_tracks_live_load_over_capacity(fleet):
+    a, b, r = fleet
+    assert r.utilization() == 0.0
+    a.waiting = 6                     # live_requests=6 over 2×4 slots
+    r.poll_once()
+    assert r.utilization() == pytest.approx(6 / 8)
+    b.mode = "die"                    # DOWN capacity leaves the pool
+    r.poll_once()
+    assert r.utilization() == pytest.approx(6 / 4)
+
+
+# ---------------------------------------------------------------------------
+# HTTP tenant gate + /fleet endpoint
+# ---------------------------------------------------------------------------
+
+class _FakeFleetSource:
+    """Stands in for the Autoscaler on the router's HTTP surface."""
+
+    def report(self):
+        return {"replicas": 2, "owned": [], "saturated": False}
+
+    def prometheus_text(self):
+        return ("# HELP dmlc_fleet_replicas replicas the router routes to\n"
+                "# TYPE dmlc_fleet_replicas gauge\n"
+                "dmlc_fleet_replicas 2\n")
+
+
+def test_http_tenant_gate_and_fleet_endpoint():
+    a = FakeReplica("a")
+    gov = TenantGovernor(rate=1.0, burst_s=1.0,
+                         weights={"paid": 100.0, "free": 1.0})
+    r = Router([a.url], health_interval_s=3600, retries=2,
+               dispatch_timeout_s=5.0, request_timeout_s=10.0,
+               tenants=gov, start_health_thread=False)
+    r.poll_once()
+    fleet_src = _FakeFleetSource()
+    srv = RouterHTTPServer(r, port=0, fleet_source=lambda: fleet_src)
+
+    def post(doc):
+        req = urllib.request.Request(
+            srv.url + "/generate", data=json.dumps(doc).encode(),
+            headers={"Content-Type": "application/json"})
+        return json.loads(urllib.request.urlopen(req, timeout=10).read())
+
+    try:
+        # free's bucket holds one token (burst floor): 1 admit, then 429
+        doc = post({"prompt": [1, 2], "tenant": "free"})
+        assert doc["state"] == "done"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            post({"prompt": [1, 2], "tenant": "free"})
+        assert e.value.code == 429
+        assert float(e.value.headers["Retry-After"]) >= 0.1
+        body = json.loads(e.value.read())
+        assert body["tenant"] == "free" and "over budget" in body["error"]
+        # paid rides its own bucket, unaffected by free's rejection
+        for i in range(3):
+            assert post({"prompt": [1], "tenant": "paid",
+                         "request_id": f"p{i}"})["state"] == "done"
+        # invalid tenant keys are 400s, not silent folds
+        for bad in (42, "", "x" * 65):
+            with pytest.raises(urllib.error.HTTPError) as e:
+                post({"prompt": [1], "tenant": bad})
+            assert e.value.code == 400
+        # completion accounting flowed back per tenant (3 tokens/req)
+        by_name = {v["tenant"]: v for v in gov.views()}
+        assert by_name["paid"]["tokens_generated"] == 9
+        assert by_name["free"]["tokens_generated"] == 3
+        # /fleet renders the fleet_source report
+        fl = json.loads(urllib.request.urlopen(
+            srv.url + "/fleet", timeout=5).read())
+        assert fl == {"replicas": 2, "owned": [], "saturated": False}
+        # /metrics concatenates router + tenant + fleet families
+        from dmlc_tpu.telemetry.exporters import validate_exposition_text
+
+        text = urllib.request.urlopen(
+            srv.url + "/metrics", timeout=5).read().decode()
+        validate_exposition_text(text)
+        assert 'dmlc_tenant_rejected_total{tenant="free"} 1' in text
+        assert "dmlc_fleet_replicas 2" in text
+    finally:
+        srv.close()
+        r.close()
+        a.close()

@@ -27,7 +27,8 @@ from dmlc_tpu.serving import (
     RequestTooLarge,
     ServingHTTPServer,
 )
-from dmlc_tpu.serving.scheduler import ACTIVE, DONE, WAITING
+from dmlc_tpu.serving.scheduler import (ACTIVE, DONE, WAITING,
+                                        PRIORITY_CLASSES, coerce_priority)
 
 
 # ---------------------------------------------------------------------------
@@ -236,6 +237,67 @@ def test_scheduler_preempts_youngest_and_requeues_front():
     assert young.context_ids() == [3, 4, 7]  # last token not yet consumed
 
 
+def test_coerce_priority_contract():
+    assert PRIORITY_CLASSES == {"batch": 0, "standard": 1, "interactive": 2}
+    assert coerce_priority(None, 3, 1) == 1          # None → default
+    assert coerce_priority("interactive", 3, 1) == 2
+    assert coerce_priority("batch", 3, 1) == 0
+    assert coerce_priority(0, 3, 1) == 0
+    assert coerce_priority(2, 3, 1) == 2
+    # a named class above the configured level count is out of range
+    with pytest.raises(ValueError):
+        coerce_priority("interactive", 2, 0)
+    for bad in ("gold", "", 3, -1, True, False, 1.5, [1], {"p": 1}):
+        with pytest.raises(ValueError):
+            coerce_priority(bad, 3, 1)
+
+
+def test_scheduler_never_evicts_high_priority_over_low():
+    """Satellite regression: a high-priority request is NEVER the
+    eviction victim while any lower-priority request holds blocks,
+    even when the high-priority one is the youngest."""
+    cache = _mk_cache(n_blocks=16, block_size=4)
+    sched = ContinuousBatchScheduler(cache, max_active=4)
+    lo_old = Request([1, 2], 4, priority=0)
+    lo_young = Request([3, 4], 4, priority=0)
+    hi = Request([5, 6], 4, priority=2)       # youngest of the three
+    lo_young.submit_t = lo_old.submit_t + 1.0
+    hi.submit_t = lo_old.submit_t + 2.0
+    for r in (lo_old, lo_young, hi):
+        sched.enqueue(r)
+    for _ in range(3):
+        r = sched.next_prefill()
+        assert cache.allocate(r.id, 2)
+        sched.activate(r)
+    # victims: youngest within the LOWEST class first, high class last
+    assert sched.preempt_youngest() is lo_young
+    assert hi.state == ACTIVE
+    assert sched.preempt_youngest() is lo_old
+    assert hi.state == ACTIVE, "high priority evicted before low"
+    assert sched.preempt_youngest() is hi    # only when nothing lower
+    assert sched.preempt_youngest() is None
+
+
+def test_scheduler_admits_high_priority_first_fifo_within_class():
+    cache = _mk_cache(n_blocks=16, block_size=4)
+    sched = ContinuousBatchScheduler(cache, max_active=4)
+    lo1 = Request([1], 4, priority=0)
+    hi1 = Request([2], 4, priority=2)
+    lo2 = Request([3], 4, priority=0)
+    hi2 = Request([4], 4, priority=2)
+    for r in (lo1, hi1, lo2, hi2):
+        sched.enqueue(r)
+    order = []
+    while True:
+        r = sched.next_prefill()
+        if r is None:
+            break
+        assert cache.allocate(r.id, 1)
+        sched.activate(r)
+        order.append(r)
+    assert order == [hi1, hi2, lo1, lo2]
+
+
 # ---------------------------------------------------------------------------
 # engine + model (real jitted compute, tiny config)
 # ---------------------------------------------------------------------------
@@ -395,6 +457,64 @@ def test_engine_rejects_oversized_and_overflowing_requests():
     after = telemetry.snapshot()["counters"]["serving"]["rejected"]
     assert after == before + 1
     eng.close()
+
+
+def test_engine_priority_and_tenant_validation_and_plumbing():
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=32, block_size=4,
+                          max_active=3, queue_depth=8, admit_timeout_s=2.0)
+    try:
+        # invalid classes are the client's ValueError (HTTP 400)
+        for bad_prio in ("gold", 7, -1, True):
+            with pytest.raises(ValueError):
+                eng.submit([1, 2], max_new_tokens=2, priority=bad_prio)
+        for bad_tenant in ("", 42, "x" * 65):
+            with pytest.raises(ValueError):
+                eng.submit([1, 2], max_new_tokens=2, tenant=bad_tenant)
+        r = eng.submit([1, 2, 3], max_new_tokens=2,
+                       priority="interactive", tenant="paid")
+        while not r.wait(0):
+            eng.step()
+        doc = r.result()
+        assert doc["priority"] == 2 and doc["tenant"] == "paid"
+        # defaults: configured default class + the "default" tenant
+        r2 = eng.submit([4, 5], max_new_tokens=1)
+        assert r2.priority == eng.priority_default
+        assert r2.tenant == "default"
+    finally:
+        eng.close()
+
+
+def test_jit_program_cache_ignores_scenario_lock_hook():
+    """The process-wide prefill/decode jit cache outlives any one
+    engine: if the first engine of the process is built inside an
+    interleaving-explorer scenario (the explorer's lock-factory hook
+    active), the cached profiled wrappers must NOT capture
+    scheduler-owned SchedLocks — a later engine would inherit a lock
+    wired to a finished controller and park forever."""
+    from dmlc_tpu import concurrency
+    from dmlc_tpu.serving import engine as eng_mod
+
+    offered = []
+
+    def hook(name, reentrant):
+        offered.append(name)
+        return None
+
+    saved = dict(eng_mod._JIT_CACHE)
+    eng_mod._JIT_CACHE.clear()
+    concurrency.set_lock_factory_hook(hook)
+    try:
+        eng_mod._jitted_programs()
+        assert offered == [], (
+            f"program-cache locks were offered to the scenario lock "
+            f"hook: {offered}")
+        # and the hook is back in place afterwards for the scenario
+        assert concurrency._lock_factory_hook is hook
+    finally:
+        concurrency.set_lock_factory_hook(None)
+        eng_mod._JIT_CACHE.clear()
+        eng_mod._JIT_CACHE.update(saved)
 
 
 def test_engine_close_fails_pending_requests():
