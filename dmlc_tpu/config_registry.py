@@ -355,6 +355,19 @@ KNOBS: Tuple[Knob, ...] = (
     _k("DMLC_COMPUTE_STORM_TRACES", int, 4,
        "jit traces within the storm window that flag a jit site as a "
        "recompile storm", ship=True, group="telemetry"),
+    _k("DMLC_TRACE_FLEET", bool, False,
+       "fleet-wide distributed tracing: X-DMLC-Trace propagation, "
+       "per-attempt router spans, cross-process trace assembly "
+       "(0 = zero per-request overhead)", ship=True, group="telemetry"),
+    _k("DMLC_TRACE_FLEET_MAX_SPANS", int, 16384,
+       "router-side per-source span store capacity for fleet trace "
+       "assembly", group="telemetry"),
+    _k("DMLC_TRACE_MAX_DECISIONS", int, 1024,
+       "cluster-brain decision audit ring capacity (GET /decisions)",
+       group="telemetry"),
+    _k("DMLC_TRACE_EXEMPLARS", int, 16,
+       "exemplar trace ids retained per latency signal / SLO "
+       "objective", ship=True, group="telemetry"),
 
     # ---- lock-order watchdog ------------------------------------------
     _k("DMLC_LOCKCHECK", bool, False,

@@ -48,6 +48,7 @@ from typing import Dict, Optional
 
 from ..base import get_env
 from ..concurrency import make_lock
+from ..telemetry.tracecontext import record_decision
 from .preempt import HostProvider
 
 __all__ = ["Autoscaler"]
@@ -171,7 +172,19 @@ class Autoscaler:
             want_down = (self._low_streak >= self.hysteresis
                          and not cooling and bool(self._owned)
                          and n_replicas > self.min_replicas)
+            high_streak, low_streak = self._high_streak, self._low_streak
 
+        if want_up or want_down:
+            # the verdict that STARTS an action chain, with the signal
+            # inputs that justified it — /decisions shows why the fleet
+            # moved, not just that it did (hold ticks are not logged:
+            # the audit log records decisions, not heartbeats)
+            record_decision(
+                "autoscale_verdict",
+                verdict="scale_up" if want_up else "scale_down",
+                util=round(util, 4), slo_hot=slo_hot,
+                high_streak=high_streak, low_streak=low_streak,
+                replicas=n_replicas)
         if want_up:
             return self._scale_up(now, n_replicas, util)
         if want_down:
@@ -202,6 +215,8 @@ class Autoscaler:
                     "%d replicas) but %s", util, n_replicas, why)
                 telemetry.record_event("fleet_saturated", detail=why,
                                        replicas=n_replicas)
+                record_decision("fleet_saturated", detail=why,
+                                replicas=n_replicas, util=round(util, 4))
             return "saturated"
         self.router.add_replica(url)
         with self._lock:
@@ -214,6 +229,8 @@ class Autoscaler:
         self._log.info("fleet scale-up: %s registered (now %d replicas)",
                        url, len(self.router.replica_views()))
         telemetry.record_event("fleet_scale_up", replica=url)
+        record_decision("scale_up", replica=url,
+                        replicas=len(self.router.replica_views()))
         return "scale_up"
 
     def _scale_down(self, now: float) -> str:
@@ -236,6 +253,8 @@ class Autoscaler:
                        "(now %d replicas)", url,
                        len(self.router.replica_views()))
         telemetry.record_event("fleet_scale_down", replica=url)
+        record_decision("scale_down", replica=url,
+                        replicas=len(self.router.replica_views()))
         return "scale_down"
 
     # ---- lifecycle ------------------------------------------------------

@@ -31,6 +31,7 @@ import urllib.request
 from typing import Callable, Dict, List, Optional
 
 from ..concurrency import make_lock
+from ..telemetry.tracecontext import record_decision
 
 __all__ = ["HostProvider", "CallbackProvider", "ResizeClient",
            "TrainingPreemptingProvider"]
@@ -192,17 +193,27 @@ class TrainingPreemptingProvider(HostProvider):
         self._log.info("fleet preempt: evicting training rank %d "
                        "(world %d -> %d) to fund a replica",
                        victim, world, new_world)
+        # the decision chain below mirrors the action sequence step by
+        # step so GET /decisions replays a preemption in causal order:
+        # acquire intent -> victim killed -> world shrunk -> replica up
+        record_decision("preempt_acquire", victim_rank=victim,
+                        world=world, new_world=new_world)
         # kill FIRST: the resize generation machinery clamps the world
         # target to the live-rank count, so a live victim cannot be
         # resized away — eviction is kill + shrink-with-remove
         self._kill_rank(victim)
+        record_decision("preempt_kill_rank", victim_rank=victim)
         self.resize.resize(new_world, remove=[victim])
+        record_decision("preempt_resize", world=new_world,
+                        removed=[victim])
         url = self._launch_replica(victim)
         with self._lock:
             self._leases[url] = victim
             self._preemptions += 1
         telemetry.record_event("fleet_preempt", rank=victim,
                                world=new_world, replica=url)
+        record_decision("preempt_replica_added", replica=url,
+                        victim_rank=victim)
         return url
 
     def release(self, url: str) -> None:
@@ -212,9 +223,13 @@ class TrainingPreemptingProvider(HostProvider):
             if url not in self._leases:
                 raise KeyError(f"no lease for replica {url}")
             victim = self._leases[url]
-        # drain + stop the replica before the host is re-purposed
+        # drain + stop the replica before the host is re-purposed; the
+        # restore chain is audited like the acquire chain
+        record_decision("preempt_release", replica=url,
+                        victim_rank=victim)
         self._stop_replica(url)
         self._relaunch_rank(victim)
+        record_decision("preempt_relaunch_rank", victim_rank=victim)
         with self._lock:
             del self._leases[url]
             new_world = self._training_world()
@@ -224,6 +239,8 @@ class TrainingPreemptingProvider(HostProvider):
         self.resize.resize(new_world)
         telemetry.record_event("fleet_restore", rank=victim,
                                world=new_world, replica=url)
+        record_decision("preempt_restore_resize", world=new_world,
+                        replica=url)
 
     def stats(self) -> Dict:
         with self._lock:

@@ -263,7 +263,8 @@ class InferenceEngine:
                max_new_tokens: Optional[int] = None,
                timeout: Optional[float] = None,
                request_id: Optional[str] = None,
-               priority=None, tenant: Optional[str] = None) -> Request:
+               priority=None, tenant: Optional[str] = None,
+               trace_id: Optional[str] = None) -> Request:
         """Admit a request or raise: :class:`AdmissionFull` when no
         queue slot frees up within ``timeout`` (default
         ``admit_timeout_s``), ``ValueError`` when the request could
@@ -284,7 +285,13 @@ class InferenceEngine:
         request instead of starting a second generation — the
         primitive the fleet router's retry and hedging rely on.  The
         dedupe lookup runs before the drain gate, so a retry of
-        already-admitted work resolves even on a draining replica."""
+        already-admitted work resolves even on a draining replica.
+
+        ``trace_id`` is the fleet trace id from the ``X-DMLC-Trace``
+        context (DMLC_TRACE_FLEET): stamped onto the request and its
+        ledger rows so this replica's queue → prefill → decode story
+        joins the router's dispatch spans in one cross-process
+        trace."""
         t_submit = time.perf_counter()
         if request_id is not None:
             if (not isinstance(request_id, str) or not request_id
@@ -312,6 +319,8 @@ class InferenceEngine:
         req = Request(prompt_ids, mnt, eos_id=self.eos_id,
                       priority=prio, tenant=tenant)
         req.client_id = request_id
+        if trace_id is not None:
+            req.trace_id = str(trace_id)
         if any(t < 0 or t >= self.cfg.vocab for t in req.prompt_ids):
             raise ValueError(
                 f"prompt ids out of range for vocab {self.cfg.vocab}")
@@ -344,7 +353,8 @@ class InferenceEngine:
         telemetry.inc("serving", "requests")
         # ledger entry opens at the submit stamp, so queue_s includes
         # the admission-slot wait a saturated server imposes
-        self.requests.on_submit(req.id, req.n_prompt, mnt, t=t_submit)
+        self.requests.on_submit(req.id, req.n_prompt, mnt, t=t_submit,
+                                trace_id=req.trace_id)
         self.scheduler.enqueue(req)
         if self._stop.is_set():
             # close() can finish its sweep between our slot acquire and

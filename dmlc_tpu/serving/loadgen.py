@@ -12,7 +12,13 @@ are reported separately (``n_requests_retried_ok``) so a run that
 survived on retries is tellable from one that never backpressured.
 Every logical request carries a fresh ``request_id`` idempotency key,
 so a retry against the same replica (or through the fleet router) can
-never double-generate.
+never double-generate.  With ``DMLC_TRACE_FLEET=1`` every attempt of
+a logical request also carries the SAME ``X-DMLC-Trace`` trace id
+(minted from that request_id; fresh span id per attempt), so client
+retries join one fleet trace instead of shattering across several —
+and the summary reports the client-inclusive end-to-end latency
+(``e2e_latency_p50_s``/``p99``: first attempt through final outcome,
+backoffs included) next to the server-side numbers.
 
 The summary aggregates the *server-reported* per-request timings —
 TTFT is measured where it is defined (submit → first token inside the
@@ -43,6 +49,7 @@ import urllib.request
 import uuid
 from typing import Dict, List, Optional
 from ..concurrency import make_lock
+from ..telemetry import tracecontext
 # one shared nearest-rank percentile for client AND server summaries:
 # the smoke compares the two against each other, so they must never
 # drift onto different conventions
@@ -89,11 +96,14 @@ class LoadGenerator:
         self._lock = make_lock("LoadGenerator._lock")
 
     # ---- one synthetic user --------------------------------------------
-    def _post(self, doc: Dict) -> Dict:
+    def _post(self, doc: Dict,
+              headers: Optional[Dict[str, str]] = None) -> Dict:
         body = json.dumps(doc).encode()
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
         req = urllib.request.Request(
-            self.url + "/generate", data=body,
-            headers={"Content-Type": "application/json"})
+            self.url + "/generate", data=body, headers=hdrs)
         with urllib.request.urlopen(req, timeout=600) as resp:
             return json.loads(resp.read())
 
@@ -125,12 +135,25 @@ class LoadGenerator:
                 doc["tenant"] = tenant
             if priority is not None:
                 doc["priority"] = priority
+            # ONE trace identity per logical request, minted here at the
+            # true origin and sent on every attempt: a client retry is
+            # the same user journey, so its backoff + re-dispatch must
+            # land inside the same fleet trace rather than minting a
+            # fresh id per HTTP attempt.  The span id is fresh per
+            # attempt (each hop is its own parent).
+            trace_id = (tracecontext.mint_trace_id(doc["request_id"])
+                        if tracecontext.enabled() else None)
             t0 = time.monotonic()
             out = None
             retried = False
             for _attempt in range(self.max_retries):
+                headers = None
+                if trace_id is not None:
+                    headers = {tracecontext.TRACE_HEADER:
+                               tracecontext.format_header(
+                                   trace_id, tracecontext.new_span_id())}
                 try:
-                    out = self._post(doc)
+                    out = self._post(doc, headers)
                     break
                 except urllib.error.HTTPError as e:
                     retryable_503 = (
@@ -171,6 +194,12 @@ class LoadGenerator:
             out["stream"] = sid
             if tenant is not None:
                 out["client_tenant"] = tenant
+            if trace_id is not None:
+                out.setdefault("trace_id", trace_id)
+            # the TRUE end-to-end latency of the logical request: first
+            # attempt through final outcome, backoffs and retries
+            # included — what the user waited, not what one HTTP
+            # round-trip took
             out["client_latency_s"] = time.monotonic() - t0
             with self._lock:
                 if out.get("error"):
@@ -219,6 +248,8 @@ class LoadGenerator:
                   for r in results
                   if r.get("latency_s") is not None
                   and r.get("client_latency_s") is not None]
+        e2e = [r["client_latency_s"] for r in results
+               if r.get("client_latency_s") is not None]
         out = {
             "n_streams": self.n_streams,
             "n_requests_ok": len(results),
@@ -237,6 +268,12 @@ class LoadGenerator:
             "p50_latency_s": percentile(
                 [r["latency_s"] for r in results
                  if r.get("latency_s") is not None], 50),
+            # client-inclusive end-to-end percentiles over LOGICAL
+            # requests (retries + backoff folded in): the number the
+            # user actually experienced, reported alongside the
+            # server-side latency rather than instead of it
+            "e2e_latency_p50_s": percentile(e2e, 50),
+            "e2e_latency_p99_s": percentile(e2e, 99),
             "preemptions": sum(r.get("preemptions", 0)
                                for r in results),
             "client_server_delta_p50_s": percentile(deltas, 50),

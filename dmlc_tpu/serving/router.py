@@ -83,6 +83,7 @@ from .. import telemetry
 from ..base import get_env
 from ..concurrency import make_lock
 from ..resilience.fault import fault_point
+from ..telemetry import tracecontext
 from ..telemetry.requests import percentile
 
 __all__ = ["Replica", "Router", "RouterHTTPServer", "TenantGovernor",
@@ -441,6 +442,13 @@ class Router:
             else get_env("DMLC_ROUTER_HEDGE_AFTER_P99_MULT", 0.0))
         self.hedge_min_samples = max(1, int(hedge_min_samples))
         self._latencies: List[float] = []  # bounded ring (see _record)
+        # fleet trace assembly (DMLC_TRACE_FLEET=1): the health sweep
+        # pulls every replica's span increments into this store, so a
+        # replica's history survives its own death (the post-SIGKILL
+        # trace is exactly the point)
+        self.trace_store: Optional[tracecontext.FleetTraceStore] = (
+            tracecontext.FleetTraceStore()
+            if tracecontext.enabled() else None)
         self._stop = threading.Event()
         self._publish_fleet_gauges()
         self._health_thread: Optional[threading.Thread] = None
@@ -560,6 +568,33 @@ class Router:
         for t in threads:
             t.join(timeout=self.probe_timeout_s + 2.0)
         self._publish_fleet_gauges()
+        self.pull_spans_once()
+
+    # ---- fleet trace assembly (DMLC_TRACE_FLEET) ------------------------
+    def pull_spans_once(self) -> None:
+        """One trace sweep: the router's own span ring plus every
+        non-DOWN replica's ``GET /spans?since=N`` increment into the
+        fleet trace store.  Riding the health interval keeps a killed
+        replica's spans captured up to within one sweep of its death.
+        No-op when tracing is off."""
+        store = self.trace_store
+        if store is None:
+            return
+        try:
+            store.ingest_local()
+        except Exception as e:  # noqa: BLE001 - sweep must not die
+            logger.debug("trace self-ingest failed: %r", e)
+        with self._lock:
+            urls = [r.url for r in self.replicas if r.state != DOWN]
+        for url in urls:
+            try:
+                since = store.cursor(url)
+                with urllib.request.urlopen(
+                        f"{url}/spans?since={since}",
+                        timeout=self.probe_timeout_s) as resp:
+                    store.ingest(url, json.loads(resp.read()))
+            except (urllib.error.URLError, OSError, ValueError):
+                pass  # replica died mid-pull: its captured history stays
 
     def _probe_one(self, rep: Replica) -> None:
         try:
@@ -600,6 +635,7 @@ class Router:
         if recovered:
             telemetry.inc("router", "probe_recoveries")
             telemetry.record_event("router_replica_up", replica=rep.url)
+            self._trace_instant("router.circuit_close", rep.url)
             logger.info("router: replica %s recovered", rep.url)
 
     def _mark_down(self, rep: Replica, error: str) -> None:
@@ -618,6 +654,8 @@ class Router:
             telemetry.inc("router", "replica_down_total")
             telemetry.record_event("router_replica_down",
                                    replica=rep.url, error=error)
+            self._trace_instant("router.circuit_open", rep.url,
+                                error=str(error)[:200])
             logger.warning("router: replica %s marked down (%s)",
                            rep.url, error)
         self._publish_fleet_gauges()
@@ -632,9 +670,21 @@ class Router:
             telemetry.inc("router", "drain_shifts")
             telemetry.record_event("router_replica_draining",
                                    replica=rep.url)
+            self._trace_instant("router.drain_shift", rep.url)
             logger.info("router: replica %s draining; shifting traffic",
                         rep.url)
         self._publish_fleet_gauges()
+
+    @staticmethod
+    def _trace_instant(name: str, replica: str, **fields) -> None:
+        """Zero-duration control-plane span (circuit open/close, drain
+        shift) into the span ring — trace-visible context for why a
+        request's attempt pattern changed.  Off with tracing."""
+        if not tracecontext.enabled():
+            return
+        t = time.perf_counter()
+        telemetry.record_span(name, stage="router", t0=t, t1=t,
+                              args={"replica": replica, **fields})
 
     # ---- placement ------------------------------------------------------
     def pick(self, exclude: Optional[set] = None) -> Optional[Replica]:
@@ -694,21 +744,34 @@ class Router:
 
     # ---- dispatch -------------------------------------------------------
     def _attempt(self, rep: Replica, kind: str, payload: bytes,
-                 timeout_s: float, out_q: "queue.Queue") -> None:
+                 timeout_s: float, out_q: "queue.Queue",
+                 trace_id: Optional[str] = None) -> None:
         """One POST to one replica; the outcome (success, HTTP error,
         or transport failure) is posted to the route() waiter.  Runs on
-        a daemon thread so a wedged replica cannot wedge the router."""
+        a daemon thread so a wedged replica cannot wedge the router.
+        With tracing on, every attempt carries the trace id and a
+        FRESH span id in ``X-DMLC-Trace`` and leaves a
+        ``router.dispatch`` span (replica, kind, outcome, status)."""
         with self._lock:
             rep.inflight += 1
             rep.dispatches += 1
         telemetry.inc("router", "dispatches")
+        headers = {"Content-Type": "application/json"}
+        span_t0 = 0.0
+        if trace_id is not None:
+            headers[tracecontext.TRACE_HEADER] = \
+                tracecontext.format_header(trace_id,
+                                           tracecontext.new_span_id())
+            span_t0 = time.perf_counter()
+        outcome: str = "transport"
+        status: Optional[int] = None
         try:
             fault_point("router.dispatch", replica=rep.url, attempt=kind)
             req = urllib.request.Request(
-                rep.url + "/generate", data=payload,
-                headers={"Content-Type": "application/json"})
+                rep.url + "/generate", data=payload, headers=headers)
             with urllib.request.urlopen(req, timeout=timeout_s) as resp:
                 doc = json.loads(resp.read())
+            outcome, status = "ok", 200
             out_q.put(_Outcome(rep, kind, ok=True, code=200, doc=doc))
         except urllib.error.HTTPError as e:
             body = e.read()[:4096]
@@ -716,40 +779,61 @@ class Router:
                 doc = json.loads(body)
             except ValueError:
                 doc = {"error": body.decode(errors="replace")}
+            outcome, status = "http_error", e.code
             out_q.put(_Outcome(
                 rep, kind, code=e.code, doc=doc,
                 retry_after=e.headers.get("Retry-After"),
                 error=f"HTTP {e.code}: {doc.get('error')}"))
         except (urllib.error.URLError, OSError, ValueError) as e:
+            outcome = "timeout" if _is_timeout(e) else "transport"
             out_q.put(_Outcome(rep, kind, transport=True,
                                timed_out=_is_timeout(e),
                                error=f"dispatch failed: {e!r}"))
         finally:
             with self._lock:
                 rep.inflight -= 1
+            if trace_id is not None:
+                telemetry.record_span(
+                    "router.dispatch", stage="router",
+                    t0=span_t0, t1=time.perf_counter(),
+                    args={"trace_id": trace_id, "replica": rep.url,
+                          "kind": kind, "outcome": outcome,
+                          "status": status})
 
     def _launch(self, rep: Replica, kind: str, payload: bytes,
-                deadline: float, out_q: "queue.Queue") -> None:
+                deadline: float, out_q: "queue.Queue",
+                trace_id: Optional[str] = None) -> None:
         timeout_s = max(0.05, min(self.dispatch_timeout_s,
                                   deadline - time.monotonic()))
         threading.Thread(
             target=self._attempt, args=(rep, kind, payload, timeout_s,
-                                        out_q),
+                                        out_q, trace_id),
             daemon=True, name=f"router-dispatch-{kind}").start()
 
     def route(self, body: Dict,
-              timeout_s: Optional[float] = None
+              timeout_s: Optional[float] = None,
+              trace_parent: Optional[str] = None
               ) -> Tuple[int, Dict, Dict[str, str]]:
         """Route one /generate body: returns ``(status, doc, headers)``
         for the client.  Guarantees: at most one 200 is ever returned
         per call (first-wins across hedges), a replica that dies
         mid-dispatch is retried elsewhere under the same idempotency
-        key, and a saturation verdict carries an honest Retry-After."""
+        key, and a saturation verdict carries an honest Retry-After.
+
+        ``trace_parent`` is the inbound ``X-DMLC-Trace`` value, if any;
+        with ``DMLC_TRACE_FLEET=1`` it (or, absent/malformed, a trace
+        id derived from the idempotency key) rides every dispatch
+        attempt, so retries and hedges of one request are one trace."""
         t0 = time.monotonic()
         rid = body.get("request_id")
         if rid is None:
             rid = uuid.uuid4().hex
             body = dict(body, request_id=rid)
+        trace_id: Optional[str] = None
+        if tracecontext.enabled():
+            parsed = tracecontext.parse_header(trace_parent)
+            trace_id = parsed[0] if parsed \
+                else tracecontext.mint_trace_id(rid)
         payload = json.dumps(body).encode()
         deadline = t0 + (timeout_s if timeout_s is not None
                          else self.request_timeout_s)
@@ -760,7 +844,8 @@ class Router:
         if primary is None:
             return self._no_replica_verdict()
         tried.add(primary.url)
-        self._launch(primary, "primary", payload, deadline, out_q)
+        self._launch(primary, "primary", payload, deadline, out_q,
+                     trace_id)
         last_launch = time.monotonic()
         pending = 1
         retries_left = max(0, int(self.retries))
@@ -796,8 +881,15 @@ class Router:
                         telemetry.record_event("router_hedge",
                                                request_id=rid,
                                                replica=rep2.url)
+                        if trace_id is not None:
+                            tn = time.perf_counter()
+                            telemetry.record_span(
+                                "router.hedge", stage="router",
+                                t0=tn, t1=tn,
+                                args={"trace_id": trace_id,
+                                      "replica": rep2.url})
                         self._launch(rep2, "hedge", payload, deadline,
-                                     out_q)
+                                     out_q, trace_id)
                         pending += 1
                     continue
                 wait = min(wait, until_hedge)
@@ -807,7 +899,12 @@ class Router:
                 continue
             pending -= 1
             if out.ok:
-                return self._win(out, rid, t0)
+                if pending > 0:
+                    # a hedge race was lost somewhere: observe the
+                    # stragglers off-thread so abandoned work is counted
+                    self._reap_stragglers(out_q, pending, trace_id,
+                                          out.replica.url)
+                return self._win(out, rid, t0, trace_id)
             # ---- a failed attempt ---------------------------------------
             last_error = out.error
             if out.code in (400, 404, 413):
@@ -843,7 +940,8 @@ class Router:
                                            request_id=rid,
                                            from_replica=out.replica.url,
                                            to_replica=nxt.url)
-                self._launch(nxt, "retry", payload, deadline, out_q)
+                self._launch(nxt, "retry", payload, deadline, out_q,
+                             trace_id)
                 last_launch = time.monotonic()
                 pending += 1
                 continue
@@ -860,7 +958,57 @@ class Router:
                           "request_id": rid, "last_error": last_error},
                     {"Retry-After": "5"})
 
-    def _win(self, out: _Outcome, rid: str, t0: float
+    def _reap_stragglers(self, out_q: "queue.Queue", pending: int,
+                         trace_id: Optional[str],
+                         winner_url: str) -> None:
+        """After a win with attempts still in flight (a hedge race),
+        drain the losers off-thread: an abandoned hedge loser that
+        completed anyway did real decode work — count its generated
+        tokens (``dmlc_router_hedge_abandoned_tokens``, from its own
+        ledger-derived response) and mark its span abandoned, so
+        wasted fleet work is measurable instead of invisible."""
+        timeout = self.dispatch_timeout_s + 5.0
+
+        def _reap() -> None:
+            left = pending
+            deadline = time.monotonic() + timeout
+            while left > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return
+                try:
+                    out = out_q.get(timeout=remaining)
+                except queue.Empty:
+                    return
+                left -= 1
+                if not out.ok:
+                    continue
+                tokens = 0
+                if isinstance(out.doc, dict):
+                    try:
+                        tokens = max(0, int(out.doc.get(
+                            "n_generated", 0) or 0))
+                    except (TypeError, ValueError):
+                        tokens = 0
+                telemetry.inc("router", "hedge_abandoned")
+                if tokens:
+                    telemetry.inc("router", "hedge_abandoned_tokens",
+                                  tokens)
+                if trace_id is not None:
+                    tn = time.perf_counter()
+                    telemetry.record_span(
+                        "router.hedge_abandoned", stage="router",
+                        t0=tn, t1=tn,
+                        args={"trace_id": trace_id,
+                              "replica": out.replica.url,
+                              "winner": winner_url,
+                              "abandoned": True, "tokens": tokens})
+
+        threading.Thread(target=_reap, daemon=True,
+                         name="router-hedge-reap").start()
+
+    def _win(self, out: _Outcome, rid: str, t0: float,
+             trace_id: Optional[str] = None
              ) -> Tuple[int, Dict, Dict[str, str]]:
         elapsed = time.monotonic() - t0
         self._record_latency(elapsed)
@@ -869,8 +1017,16 @@ class Router:
         doc = dict(out.doc or {})
         doc.setdefault("request_id", rid)
         doc["served_by"] = out.replica.url
+        if trace_id is not None:
+            doc.setdefault("trace_id", trace_id)
         if out.kind == "hedge":
             telemetry.inc("router", "hedge_wins")
+            if trace_id is not None:
+                tn = time.perf_counter()
+                telemetry.record_span(
+                    "router.hedge_win", stage="router", t0=tn, t1=tn,
+                    args={"trace_id": trace_id,
+                          "replica": out.replica.url})
         ttft = doc.get("ttft_s")
         if isinstance(ttft, (int, float)):
             telemetry.observe_duration("router", "ttft", float(ttft))
@@ -980,6 +1136,17 @@ class RouterHTTPServer:
       GET  /fleet      the autoscaler's control-loop document (only
                        when the server was built with a fleet source —
                        see ``fleet.Autoscaler``)
+      GET  /decisions  the cluster-brain decision audit log
+                       (``?since=N&limit=M`` incremental export —
+                       autoscaler verdicts, preemption chains, tenant
+                       rejections; always on)
+      GET  /traces     per-trace summaries, slowest first (dmlc-top's
+                       traces pane; ``DMLC_TRACE_FLEET=1``)
+      GET  /trace      the merged fleet Chrome trace (router +
+                       replica spans joined by trace id, with
+                       ``ph:"s"/"f"`` flow arrows)
+      GET  /trace/<id> one request's cross-process causal timeline
+                       as JSON (spans + linked decisions)
       GET  /metrics    router-process Prometheus exposition plus the
                        hand-rendered per-replica ``dmlc_router_replica_*``
                        and per-tenant ``dmlc_tenant_*`` labeled families
@@ -1007,6 +1174,17 @@ class RouterHTTPServer:
                 self._send(code, "application/json",
                            json.dumps(doc).encode(),
                            extra_headers=extra_headers)
+
+            def _qs_int(self, key: str, default: int) -> int:
+                _, _, qs = self.path.partition("?")
+                for part in qs.split("&"):
+                    k, _, v = part.partition("=")
+                    if k == key:
+                        try:
+                            return int(v)
+                        except ValueError:
+                            return default
+                return default
 
             def do_GET(self):  # noqa: N802 - http.server API
                 path = self.path.split("?", 1)[0]
@@ -1041,6 +1219,37 @@ class RouterHTTPServer:
                         self._send(503, "text/plain",
                                    b"fleet render failed\n")
                         return
+                    self._send(200, "application/json", body)
+                elif path == "/decisions":
+                    # the cluster-brain audit log: incremental export
+                    # with the RequestLedger records_since contract
+                    recs, last = tracecontext.decision_log() \
+                        .records_since(self._qs_int("since", 0),
+                                       self._qs_int("limit", 256))
+                    self._send(200, "application/json",
+                               json.dumps({"decisions": recs,
+                                           "last_seq": last}).encode())
+                elif path == "/traces":
+                    store = rt.trace_store
+                    doc = {"enabled": store is not None, "traces": []}
+                    if store is not None:
+                        rt.pull_spans_once()
+                        doc["traces"] = store.trace_summaries(
+                            self._qs_int("limit", 32))
+                        doc["sources"] = store.sources()
+                    self._send(200, "application/json",
+                               json.dumps(doc).encode())
+                elif path == "/trace" and rt.trace_store is not None:
+                    rt.pull_spans_once()
+                    body = json.dumps(
+                        rt.trace_store.to_chrome_trace()).encode()
+                    self._send(200, "application/json", body)
+                elif path.startswith("/trace/") \
+                        and rt.trace_store is not None:
+                    rt.pull_spans_once()
+                    tid = path[len("/trace/"):]
+                    body = json.dumps(
+                        rt.trace_store.timeline(tid)).encode()
                     self._send(200, "application/json", body)
                 else:
                     # GET 404s uncounted: monitors probe optional
@@ -1084,12 +1293,27 @@ class RouterHTTPServer:
                 # other tenants are entitled to
                 admitted, retry_s = rt.tenants.admit(tenant)
                 if not admitted:
+                    fields = {"tenant": tenant,
+                              "retry_after_s": round(retry_s, 3)}
+                    if tracecontext.enabled():
+                        parsed = tracecontext.parse_header(
+                            self.headers.get(tracecontext.TRACE_HEADER))
+                        rid0 = doc.get("request_id")
+                        tid = parsed[0] if parsed else (
+                            tracecontext.mint_trace_id(rid0)
+                            if rid0 else None)
+                        if tid:
+                            fields["trace_id"] = tid
+                    tracecontext.record_decision("tenant_rejected",
+                                                 **fields)
                     self._answer(
                         429, {"error": "tenant over budget",
                               "tenant": tenant},
                         extra_headers={"Retry-After": f"{retry_s:.1f}"})
                     return
-                code, out, headers = rt.route(doc)
+                code, out, headers = rt.route(
+                    doc, trace_parent=self.headers.get(
+                        tracecontext.TRACE_HEADER))
                 if code == 200 and isinstance(out, dict):
                     rt.tenants.observe_completion(
                         tenant, int(out.get("n_generated", 0) or 0))

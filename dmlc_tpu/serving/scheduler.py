@@ -136,6 +136,7 @@ class Request:
         self.crash_requeues = 0  # engine-iteration crashes survived
         self.slot = None  # admission token (engine's BufferPool buffer)
         self.client_id: Optional[str] = None  # idempotency key, if any
+        self.trace_id: Optional[str] = None  # fleet trace (X-DMLC-Trace)
         self._done = threading.Event()
 
     # ---- views ----------------------------------------------------------
@@ -210,6 +211,8 @@ class Request:
         }
         if self.client_id is not None:
             out["request_id"] = self.client_id
+        if self.trace_id is not None:
+            out["trace_id"] = self.trace_id
         return out
 
 

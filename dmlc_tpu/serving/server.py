@@ -46,6 +46,11 @@ Endpoints:
                    instant markers (tracker-launched replicas ALSO ship
                    the same spans via heartbeats onto the merged
                    cluster /trace)
+  GET  /spans      incremental span export (``?since=N&limit=M`` →
+                   spans + last_seq + anchor_epoch) — what the
+                   router's fleet trace assembler polls to join this
+                   replica's request lifecycles into cross-process
+                   journeys (DMLC_TRACE_FLEET)
 
 Every ``/generate`` response increments a per-status-code counter
 (``dmlc_serving_http_<code>``), so admission pressure (429), oversize
@@ -67,6 +72,7 @@ from typing import Optional
 
 from .. import telemetry
 from ..telemetry import core as _tcore
+from ..telemetry import tracecontext
 from ..telemetry.exporters import to_chrome_trace
 from .engine import (AdmissionFull, EngineDraining, InferenceEngine,
                      RequestTooLarge)
@@ -157,6 +163,26 @@ class ServingHTTPServer:
                                    b"trace render failed\n")
                         return
                     self._send(200, "application/json", body)
+                elif path == "/spans":
+                    # incremental span export for the fleet trace
+                    # assembler (router pull): resume from last_seq,
+                    # place on the wall clock via anchor_epoch
+                    since = limit = 0
+                    _, _, qs = self.path.partition("?")
+                    for part in qs.split("&"):
+                        k, _, v = part.partition("=")
+                        try:
+                            if k == "since":
+                                since = int(v)
+                            elif k == "limit":
+                                limit = int(v)
+                        except ValueError:
+                            pass
+                    spans, last = _tcore.spans_since(
+                        since, limit=limit or 4096)
+                    self._send_json(200, {
+                        "spans": spans, "last_seq": last,
+                        "anchor_epoch": _tcore.anchor_epoch()})
                 else:
                     # GET 404s are NOT counted: monitoring tools probe
                     # optional endpoints by design (dmlc-top polls
@@ -199,6 +225,17 @@ class ServingHTTPServer:
                         json.JSONDecodeError) as e:
                     self._answer(400, {"error": f"bad request: {e}"})
                     return
+                trace_id = None
+                if tracecontext.enabled():
+                    # the fleet trace context rides X-DMLC-Trace; when
+                    # the upstream sent none, derive it from the
+                    # idempotency key so both ends agree anyway
+                    parsed = tracecontext.parse_header(
+                        self.headers.get(tracecontext.TRACE_HEADER))
+                    if parsed:
+                        trace_id = parsed[0]
+                    elif request_id:
+                        trace_id = tracecontext.mint_trace_id(request_id)
                 try:
                     # request_id is the idempotency key: a duplicate of
                     # a live or recently finished request returns the
@@ -207,7 +244,8 @@ class ServingHTTPServer:
                     # validated inside submit (ValueError → 400 below)
                     req = eng.submit(prompt, max_new_tokens=max_tokens,
                                      request_id=request_id,
-                                     priority=priority, tenant=tenant)
+                                     priority=priority, tenant=tenant,
+                                     trace_id=trace_id)
                 except AdmissionFull as e:
                     self._answer(429, {"error": str(e)},
                                  extra_headers={"Retry-After": "1"})

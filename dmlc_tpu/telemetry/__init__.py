@@ -37,6 +37,12 @@ counters, which remains as a compatible shim over this package):
                    (hit/trace/recompile counting, storm detection),
                    XLA cost/roofline accounting, per-device HBM
                    gauges, decode phase decomposition behind /compute
+  * ``tracecontext`` fleet-wide distributed tracing: X-DMLC-Trace
+                   context propagation (trace ids deterministic from
+                   idempotency request_ids), the cluster-brain
+                   decision audit log behind the router's /decisions,
+                   and cross-process trace assembly (/trace,
+                   /trace/<id>, /traces) behind DMLC_TRACE_FLEET=1
   * ``metric_names`` the checked-in metric-name contract registry
                    (scripts/lint.py enforces it)
 
@@ -65,6 +71,7 @@ from . import (  # noqa: F401
     requests,
     slo,
     steps,
+    tracecontext,
 )
 from .anomaly import Watchdog  # noqa: F401
 from .clock import ClockOffsetEstimator  # noqa: F401
@@ -94,6 +101,12 @@ from .events import (  # noqa: F401
     reset_events,
 )
 from .flight import FlightRecorder  # noqa: F401
+from .tracecontext import (  # noqa: F401
+    DecisionLog,
+    FleetTraceStore,
+    decision_log,
+    record_decision,
+)
 from .requests import RequestLedger  # noqa: F401
 from .slo import SLOMonitor  # noqa: F401
 from .exporters import (  # noqa: F401
@@ -129,6 +142,8 @@ __all__ = [
     "ClockOffsetEstimator",
     "DEFAULT_BOUNDS",
     "DEFAULT_STRAGGLER_KEYS",
+    "DecisionLog",
+    "FleetTraceStore",
     "FlightRecorder",
     "Histogram",
     "HeartbeatSender",
@@ -141,6 +156,7 @@ __all__ = [
     "anchor_epoch",
     "annotate",
     "counters_snapshot",
+    "decision_log",
     "declare_dtype",
     "declare_flops_per_token",
     "declare_peak_flops",
@@ -154,6 +170,7 @@ __all__ = [
     "observe_duration",
     "open_spans",
     "profiled_jit",
+    "record_decision",
     "record_event",
     "record_span",
     "reset",

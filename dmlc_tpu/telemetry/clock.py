@@ -101,3 +101,15 @@ class ClockOffsetEstimator:
         with self._lock:
             self._samples.pop(rank, None)
             self._best.pop(rank, None)
+
+    def remap_ranks(self, mapping: Dict[int, int]) -> None:
+        """Renumber the per-rank estimates into a new generation's rank
+        space (elastic resize); ranks absent from ``mapping`` are
+        dropped.  The clock relation belongs to the surviving *process*,
+        which keeps its physical clock across renumbering — so the
+        estimate travels with it rather than restarting from zero."""
+        with self._lock:
+            self._samples = {mapping[r]: s for r, s in self._samples.items()
+                             if r in mapping}
+            self._best = {mapping[r]: s for r, s in self._best.items()
+                          if r in mapping}

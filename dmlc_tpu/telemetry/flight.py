@@ -146,8 +146,32 @@ class FlightRecorder:
         with self._lock:
             self._spans.pop(rank, None)
             self._anchor.pop(rank, None)
+            self._host.pop(rank, None)
             self._last_seq.pop(rank, None)
         self.clock.drop(rank)
+
+    def remap_ranks(self, mapping: Dict[int, int]) -> None:
+        """Atomically renumber every per-rank store into a new
+        generation's rank space (elastic resize; same contract as
+        ``TelemetryAggregator.remap_ranks``): ranks absent from
+        ``mapping`` are dropped.  Span *contents* are untouched — a
+        request-row tid (``1<<48 + req_id``) or a span's ``trace_id``
+        names a logical entity, not a rank, so both survive renumbering
+        verbatim; only the store key (→ merged-trace pid) moves.
+        Without this, a survivor's spans would render under a pid now
+        owned by a different process — or collide with the rank that
+        inherited its old number."""
+        with self._lock:
+            self._spans = {mapping[r]: s for r, s in self._spans.items()
+                           if r in mapping}
+            self._anchor = {mapping[r]: a for r, a in self._anchor.items()
+                            if r in mapping}
+            self._host = {mapping[r]: h for r, h in self._host.items()
+                          if r in mapping}
+            self._last_seq = {mapping[r]: q
+                              for r, q in self._last_seq.items()
+                              if r in mapping}
+        self.clock.remap_ranks(mapping)
 
     # ---- views ----------------------------------------------------------
     def ranks(self) -> List[int]:

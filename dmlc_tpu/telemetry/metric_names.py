@@ -216,6 +216,10 @@ METRIC_NAMES = frozenset({
     "dmlc_router_failovers_total",
     "dmlc_router_hedges",
     "dmlc_router_hedge_wins",
+    # hedge losers reaped after the winner returned: count + their
+    # wasted generated tokens (satellite of the fleet-tracing PR)
+    "dmlc_router_hedge_abandoned",
+    "dmlc_router_hedge_abandoned_tokens",
     "dmlc_router_drain_shifts",
     "dmlc_router_replica_down_total",
     "dmlc_router_probe_recoveries",

@@ -90,11 +90,12 @@ class _Live:
                  "state", "queue_s", "prefill_t0", "prefill_s", "ttft_s",
                  "first_token_t", "last_token_t", "decode_t0",
                  "n_generated", "decode_s", "tbt_sum", "tbt_max",
-                 "n_tbt", "preemptions", "resumes")
+                 "n_tbt", "preemptions", "resumes", "trace_id")
 
     def __init__(self, req_id: int, n_prompt: int, max_new: Optional[int],
-                 t: float):
+                 t: float, trace_id: Optional[str] = None):
         self.id = req_id
+        self.trace_id = trace_id
         self.submit_t = t
         self.submit_wall = time.time()
         self.n_prompt = int(n_prompt)
@@ -129,6 +130,8 @@ class _Live:
             "preemptions": self.preemptions,
             "resumes": self.resumes,
         }
+        if self.trace_id is not None:
+            out["trace_id"] = self.trace_id
         if now is not None:
             out["age_s"] = now - self.submit_t
         return out
@@ -169,13 +172,24 @@ class RequestLedger:
     # ---- lifecycle hooks (engine-driven) -------------------------------
     def on_submit(self, req_id: int, n_prompt: int,
                   max_new_tokens: Optional[int] = None,
-                  t: Optional[float] = None) -> None:
+                  t: Optional[float] = None,
+                  trace_id: Optional[str] = None) -> None:
         """An admitted request enters the ledger; ``t`` should be the
         stamp taken at the top of the engine's submit path so queue
-        wait includes the admission-slot wait."""
+        wait includes the admission-slot wait.  ``trace_id`` (fleet
+        trace context, DMLC_TRACE_FLEET) stamps every trace row and
+        the finish record; a traced request additionally leaves an
+        instant ``serving.admitted`` marker at once, so its presence
+        on this replica is pullable before the first phase completes
+        (a replica killed mid-request still shows in the fleet trace)."""
         t = time.perf_counter() if t is None else t
         with self._lock:
-            self._live[req_id] = _Live(req_id, n_prompt, max_new_tokens, t)
+            st = _Live(req_id, n_prompt, max_new_tokens, t,
+                       trace_id=trace_id)
+            self._live[req_id] = st
+        if trace_id is not None:
+            self._row(st, "serving.admitted", t, t,
+                      args={"n_prompt": int(n_prompt)})
 
     def on_prefill_begin(self, req_id: int, t: Optional[float] = None,
                          resume: bool = False) -> None:
@@ -210,7 +224,7 @@ class RequestLedger:
         self._row(st, "serving.prefill", st.prefill_t0, t,
                   args={"tokens": st.n_prompt})
         if self._slo is not None and st.ttft_s is not None:
-            self._slo.observe_ttft(st.ttft_s)
+            self._slo.observe_ttft(st.ttft_s, trace_id=st.trace_id)
 
     def on_prefill_end(self, req_id: int,
                        t: Optional[float] = None) -> None:
@@ -254,7 +268,7 @@ class RequestLedger:
         if gap is not None:
             core.observe_duration("serving", "tbt", gap)
             if self._slo is not None:
-                self._slo.observe_tbt(gap)
+                self._slo.observe_tbt(gap, trace_id=st.trace_id)
 
     def on_preempt(self, req_id: int, t: Optional[float] = None) -> None:
         t = time.perf_counter() if t is None else t
@@ -317,6 +331,8 @@ class RequestLedger:
                 "preemptions": st.preemptions,
                 "resumes": st.resumes,
             }
+            if st.trace_id is not None:
+                rec["trace_id"] = st.trace_id
             self._done.append(rec)
         if t0 is not None:
             self._row(st, "serving.decode", t0, t,
@@ -324,7 +340,7 @@ class RequestLedger:
         if failed:
             core.inc("serving", "failed_" + slug)
         if self._slo is not None:
-            self._slo.observe_outcome(not failed)
+            self._slo.observe_outcome(not failed, trace_id=st.trace_id)
         return rec
 
     def on_iteration(self, active: int, waiting: int, preempted: int = 0,
@@ -358,6 +374,8 @@ class RequestLedger:
         if not self.trace_rows:
             return
         a = {"req": st.id}
+        if st.trace_id is not None:
+            a["trace_id"] = st.trace_id
         if args:
             a.update(args)
         core.record_span(name, stage="serving", t0=t0, t1=t1,

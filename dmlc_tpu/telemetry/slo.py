@@ -70,9 +70,11 @@ MIN_EVENTS = 5
 
 class _Objective:
     __slots__ = ("name", "kind", "threshold", "budget", "events",
-                 "burn_fast", "burn_slow", "n_fast", "n_slow")
+                 "burn_fast", "burn_slow", "n_fast", "n_slow",
+                 "exemplars")
 
-    def __init__(self, name: str, threshold: float, budget: float):
+    def __init__(self, name: str, threshold: float, budget: float,
+                 max_exemplars: int = 16):
         self.name = name
         self.kind = _OBJECTIVE_KIND[name]
         self.threshold = float(threshold)
@@ -82,6 +84,10 @@ class _Objective:
         self.burn_slow = 0.0
         self.n_fast = 0
         self.n_slow = 0
+        #: last-N *bad* observations that carried a fleet trace id —
+        #: {"trace_id", "v", "t"} — the hop from "the p99 is burning"
+        #: to "here is a concrete request journey to open"
+        self.exemplars: deque = deque(maxlen=max(1, int(max_exemplars)))
 
     def burn_thresholds(self, fast_burn: float, slow_burn: float) -> tuple:
         """Effective per-objective burn thresholds: burn is capped at
@@ -127,16 +133,17 @@ class SLOMonitor:
                           else get_env("DMLC_SLO_SLOW_BURN", 6.0))
         self.min_eval_interval_s = float(min_eval_interval_s)
         self._lock = make_lock("SLOMonitor._lock")
+        n_ex = get_env("DMLC_TRACE_EXEMPLARS", 16, int)
         self._objectives: Dict[str, _Objective] = {}
         if ttft_p99_s is not None and ttft_p99_s > 0:
             self._objectives["ttft_p99"] = _Objective(
-                "ttft_p99", ttft_p99_s, 0.01)
+                "ttft_p99", ttft_p99_s, 0.01, max_exemplars=n_ex)
         if tbt_p99_s is not None and tbt_p99_s > 0:
             self._objectives["tbt_p99"] = _Objective(
-                "tbt_p99", tbt_p99_s, 0.01)
+                "tbt_p99", tbt_p99_s, 0.01, max_exemplars=n_ex)
         if error_rate is not None and error_rate > 0:
             self._objectives["error_rate"] = _Objective(
-                "error_rate", error_rate, error_rate)
+                "error_rate", error_rate, error_rate, max_exemplars=n_ex)
         self._active: set = set()
         self._active_since: Dict[str, float] = {}
         self._violations: deque = deque(maxlen=_MAX_VIOLATIONS)
@@ -147,27 +154,38 @@ class SLOMonitor:
         return bool(self._objectives)
 
     # ---- observations ---------------------------------------------------
-    def _observe(self, name: str, bad: bool,
-                 t: Optional[float] = None) -> None:
+    def _observe(self, name: str, bad: bool, t: Optional[float] = None,
+                 trace_id: Optional[str] = None,
+                 value: Optional[float] = None) -> None:
         obj = self._objectives.get(name)
         if obj is None:
             return
         t = time.monotonic() if t is None else t
         with self._lock:
             obj.events.append((t, bool(bad)))
+            if bad and trace_id is not None:
+                ex = {"trace_id": str(trace_id), "t": time.time()}
+                if value is not None:
+                    ex["v"] = round(float(value), 6)
+                obj.exemplars.append(ex)
 
-    def observe_ttft(self, ttft_s: float, t: Optional[float] = None) -> None:
+    def observe_ttft(self, ttft_s: float, t: Optional[float] = None,
+                     trace_id: Optional[str] = None) -> None:
         obj = self._objectives.get("ttft_p99")
         if obj is not None:
-            self._observe("ttft_p99", ttft_s > obj.threshold, t)
+            self._observe("ttft_p99", ttft_s > obj.threshold, t,
+                          trace_id=trace_id, value=ttft_s)
 
-    def observe_tbt(self, gap_s: float, t: Optional[float] = None) -> None:
+    def observe_tbt(self, gap_s: float, t: Optional[float] = None,
+                    trace_id: Optional[str] = None) -> None:
         obj = self._objectives.get("tbt_p99")
         if obj is not None:
-            self._observe("tbt_p99", gap_s > obj.threshold, t)
+            self._observe("tbt_p99", gap_s > obj.threshold, t,
+                          trace_id=trace_id, value=gap_s)
 
-    def observe_outcome(self, ok: bool, t: Optional[float] = None) -> None:
-        self._observe("error_rate", not ok, t)
+    def observe_outcome(self, ok: bool, t: Optional[float] = None,
+                        trace_id: Optional[str] = None) -> None:
+        self._observe("error_rate", not ok, t, trace_id=trace_id)
 
     # ---- evaluation -----------------------------------------------------
     def maybe_evaluate(self, now: Optional[float] = None) -> None:
@@ -222,7 +240,12 @@ class SLOMonitor:
                     v = {"kind": obj.kind, "objective": name,
                          "detail": detail, "t": time.time(),
                          "burn_fast": obj.burn_fast,
-                         "burn_slow": obj.burn_slow}
+                         "burn_slow": obj.burn_slow,
+                         # recent offending fleet trace ids (may be
+                         # empty when tracing is off): the violation
+                         # is directly openable as request journeys
+                         "exemplar_trace_ids": [
+                             e["trace_id"] for e in obj.exemplars]}
                     self._violations.append(v)
                     fired.append((obj.kind, detail))
                 elif not violating and obj.kind in self._active:
@@ -265,6 +288,7 @@ class SLOMonitor:
                     "events_fast": obj.n_fast,
                     "events_slow": obj.n_slow,
                     "violating": obj.kind in self._active,
+                    "exemplars": list(obj.exemplars),
                 }
             return {
                 "enabled": bool(self._objectives),
@@ -338,6 +362,7 @@ class SLOMonitor:
         with self._lock:
             for obj in self._objectives.values():
                 obj.events.clear()
+                obj.exemplars.clear()
                 obj.burn_fast = obj.burn_slow = 0.0
                 obj.n_fast = obj.n_slow = 0
             self._active.clear()

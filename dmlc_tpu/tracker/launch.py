@@ -70,6 +70,7 @@ PASS_ENVS = [
     "DMLC_STEP_LEDGER_MAX", "DMLC_PEAK_FLOPS", "DMLC_PEAK_HBM_GBPS",
     "DMLC_COMPUTE_PROFILE", "DMLC_COMPUTE_TRACE_PHASES",
     "DMLC_COMPUTE_STORM_WINDOW_S", "DMLC_COMPUTE_STORM_TRACES",
+    "DMLC_TRACE_FLEET", "DMLC_TRACE_EXEMPLARS",
     "DMLC_LOCKCHECK",
     "DMLC_LOCKCHECK_BLOCK_S", "DMLC_RACECHECK",
     "DMLC_RACECHECK_MAX_SITES", "DMLC_FLASH_BH_BLOCK",

@@ -572,6 +572,10 @@ class RabitTracker:
         # age must not be split between its old and new rank ids (the
         # failure detector would re-declare phantom deaths)
         self.telemetry.remap_ranks(rank_map)
+        # span stores + clock relations move with the surviving process
+        # too — else /trace renders a survivor's history under a pid a
+        # different worker now owns (see FlightRecorder.remap_ranks)
+        self.flight.remap_ranks(rank_map)
         for old, new in rank_map.items():
             if old != new:
                 self.watchdog.drop(old)

@@ -29,7 +29,13 @@ The full ISSUE 17 acceptance flow in one process tree:
      paid takes none and its p99 TTFT holds;
   7. the router's /metrics is strict-Prometheus with the dmlc_fleet_*
      and dmlc_tenant_* families, and /fleet reports the controller's
-     counters.
+     counters;
+  8. the cluster-brain **decision audit log** (``GET /decisions``)
+     replays the whole preemption chain in causal order — hot verdict
+     -> acquire -> kill rank -> shrink resize -> replica added ->
+     scale_up — plus the restore chain and the tenant-governor 429s,
+     with the ``since`` cursor honoring the incremental-export
+     contract.
 
 Exit 0 on success, 1 with a diagnostic on any failure.
 """
@@ -599,6 +605,59 @@ def run(tracker, router, server, scaler, gov, workers, victim_proc,
     print("autoscale smoke: /metrics strict-Prometheus with "
           "dmlc_fleet_* + dmlc_tenant_* families; /fleet consistent",
           flush=True)
+
+    # --- phase 5: the decision audit log replays the preemption chain --
+    doc = json.loads(fetch(server.url + "/decisions"))
+    dec = doc.get("decisions") or []
+    seqs = [d.get("seq") for d in dec]
+    if seqs != sorted(seqs):
+        fail(f"/decisions not in seq order: {seqs}")
+    # the full acquire chain must appear as an in-order subsequence:
+    # hot verdict -> acquire -> kill -> shrink -> replica up -> done
+    chain = ("autoscale_verdict", "preempt_acquire",
+             "preempt_kill_rank", "preempt_resize",
+             "preempt_replica_added", "scale_up")
+    idx = 0
+    hits = []
+    for d in dec:
+        if idx == len(chain):
+            break
+        if d.get("kind") != chain[idx]:
+            continue
+        if chain[idx] == "autoscale_verdict" \
+                and d.get("verdict") != "scale_up":
+            continue
+        hits.append({"kind": d["kind"], "seq": d["seq"]})
+        idx += 1
+    if idx != len(chain):
+        fail(f"preemption chain incomplete on /decisions: wanted "
+             f"{chain}, matched {hits}; log="
+             f"{json.dumps([d.get('kind') for d in dec])}")
+    verdict = next(d for d in dec if d.get("kind") == "autoscale_verdict"
+                   and d.get("verdict") == "scale_up")
+    if "util" not in verdict or "high_streak" not in verdict:
+        fail(f"scale-up verdict lacks its signal inputs: {verdict}")
+    kill = next(d for d in dec if d.get("kind") == "preempt_kill_rank")
+    if kill.get("victim_rank") != 1:
+        fail(f"audit log blames the wrong victim: {kill}")
+    # restore chain + tenant-governor 429s also audited
+    for kind in ("preempt_release", "preempt_relaunch_rank",
+                 "preempt_restore_resize", "scale_down",
+                 "tenant_rejected"):
+        if not any(d.get("kind") == kind for d in dec):
+            fail(f"decision kind {kind} missing from /decisions: "
+                 f"{json.dumps([d.get('kind') for d in dec])}")
+    rej = next(d for d in dec if d.get("kind") == "tenant_rejected")
+    if rej.get("tenant") != "free":
+        fail(f"429 audit blames the wrong tenant: {rej}")
+    # incremental-export contract: since=last_seq yields nothing new
+    last = doc.get("last_seq")
+    doc2 = json.loads(fetch(server.url + f"/decisions?since={last}"))
+    if doc2.get("decisions"):
+        fail(f"since={last} re-served history: {doc2['decisions'][:3]}")
+    print(f"autoscale smoke: /decisions replayed the preemption chain "
+          f"in causal order ({len(dec)} records, chain seqs "
+          f"{[h['seq'] for h in hits]})", flush=True)
 
 
 if __name__ == "__main__":

@@ -34,7 +34,11 @@ Pointed at a **router** with an autoscaler wired, ``/fleet`` feeds a
 fleet pane: replica count, aggregate utilization, the controller's
 hysteresis streaks / cooldown / last decision (with ``SATURATED``
 highlighted), and a per-tenant admission line (weight, admitted,
-rejected) from the router ``/healthz`` tenants block.
+rejected) from the router ``/healthz`` tenants block.  With
+``DMLC_TRACE_FLEET=1`` a **traces pane** (``/traces`` +
+``/decisions``) adds the slowest recent fleet traces — trace id, TTFT
+decomposition, dispatch-attempt count, replicas touched — the tail of
+the cluster-brain decision audit log, and SLO exemplar trace ids.
 
 Runs full-screen (curses) when stdout is a TTY; ``--plain`` prints one
 table per refresh instead (pipe-friendly, and what the CI smoke
@@ -52,7 +56,8 @@ import time
 import urllib.request
 
 __all__ = ["fetch", "render_table", "render_serving_pane",
-           "render_compute_pane", "render_fleet_pane", "main"]
+           "render_compute_pane", "render_fleet_pane",
+           "render_traces_pane", "main"]
 
 COLUMNS = ("RANK", "STEP ms", "EWMA ms", "GOODPUT", "MFU%", "FEED%",
            "HB AGE", "FLAGS", "REMED")
@@ -84,7 +89,8 @@ def fetch(base_url: str, timeout: float = 5.0) -> dict:
     out = {}
     for key, path in (("anomalies", "/anomalies"), ("healthz", "/healthz"),
                       ("requests", "/requests"), ("slo", "/slo"),
-                      ("compute", "/compute"), ("fleet", "/fleet")):
+                      ("compute", "/compute"), ("fleet", "/fleet"),
+                      ("traces", "/traces"), ("decisions", "/decisions")):
         try:
             with urllib.request.urlopen(base_url + path,
                                         timeout=timeout) as r:
@@ -231,6 +237,51 @@ def render_fleet_pane(doc: dict) -> list:
     return lines
 
 
+def render_traces_pane(doc: dict, n: int = 5) -> list:
+    """The distributed-tracing pane (empty unless the target serves
+    ``/traces``/``/decisions`` — i.e. a router): the slowest recent
+    fleet traces with their TTFT decomposition / attempt fan-out /
+    replicas touched, the tail of the cluster-brain decision audit
+    log, and any SLO exemplar trace ids (the jump from a burning
+    histogram to a concrete journey to open)."""
+    lines = []
+    traces = (doc.get("traces") or {}).get("traces") or []
+    for tr in traces[:n]:
+        reps = tr.get("replicas") or []
+        lat = tr.get("latency_s")
+        ttft = tr.get("ttft_s")
+        q = tr.get("queue_s")
+        pf = tr.get("prefill_s")
+        lines.append(
+            "trace    {} lat={} ttft={} (q={} prefill={}) attempts={}{} "
+            "replicas={}".format(
+                str(tr.get("trace_id", "?"))[:16],
+                _num(lat, "{:.3f}s"), _num(ttft, "{:.3f}s"),
+                _num(q, "{:.3f}s"), _num(pf, "{:.3f}s"),
+                tr.get("attempts", 0),
+                " HEDGED" if tr.get("hedged") else "",
+                ",".join(str(r) for r in reps) or "-"))
+    decisions = (doc.get("decisions") or {}).get("decisions") or []
+    if decisions:
+        parts = []
+        for d in decisions[-n:]:
+            tag = d.get("kind", "?")
+            who = (d.get("replica") or d.get("victim_rank")
+                   or d.get("verdict") or d.get("tenant"))
+            parts.append(f"{tag}({who})" if who is not None else tag)
+        lines.append("decide   " + " -> ".join(parts))
+    objs = (doc.get("slo") or {}).get("objectives") or {}
+    ex_parts = []
+    for name, o in sorted(objs.items()):
+        ids = [str(e.get("trace_id", ""))[:12]
+               for e in (o.get("exemplars") or [])[-3:]]
+        if ids:
+            ex_parts.append(f"{name}:{','.join(ids)}")
+    if ex_parts:
+        lines.append("exemplar " + "  ".join(ex_parts))
+    return lines
+
+
 def render_table(doc: dict, base_url: str = "") -> str:
     """The poll document as fixed-width text (one refresh)."""
     an = doc.get("anomalies") or {}
@@ -275,6 +326,7 @@ def render_table(doc: dict, base_url: str = "") -> str:
     lines.extend(render_serving_pane(doc))
     lines.extend(render_compute_pane(doc))
     lines.extend(render_fleet_pane(doc))
+    lines.extend(render_traces_pane(doc))
     return "\n".join(lines)
 
 

@@ -11,6 +11,12 @@ failure-first acceptance contract end to end:
     idempotency key — retried, not failed), zero client-visible
     failures, ``dmlc_router_failovers_total`` >= 1 on the router's
     strict-Prometheus ``/metrics``, and p99 TTFT stays bounded.
+  * **the killed request is ONE fleet trace**: with
+    ``DMLC_TRACE_FLEET=1`` the torn request surfaces as a single
+    trace_id whose ``/trace/<id>`` journey shows both router dispatch
+    attempts (victim + survivor) and both server-side lifecycles, and
+    the merged ``/trace`` Chrome export stitches them with ``ph:"s"/
+    "f"`` flow arrows — the cross-process join proven end to end.
   * **circuit recovery**: the killed replica is restarted on its old
     port and the health probe's circuit breaker re-admits it.
   * **hedging**: with a tight hedge threshold, tail dispatches get a
@@ -35,6 +41,10 @@ import time
 import urllib.request
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# fleet tracing ON for the whole fleet: the router process reads it
+# here, the replica subprocesses inherit it through their env — the
+# smoke proves the cross-process trace join, not just the happy path
+os.environ.setdefault("DMLC_TRACE_FLEET", "1")
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -183,17 +193,31 @@ def run(router, server, reps, LoadGenerator, validate_exposition_text):
     runner = threading.Thread(
         target=lambda: summary.update(gen.run()), daemon=True)
     runner.start()
-    # kill once the burst has in-flight dispatches on the victim
+    # kill once the burst has in-flight dispatches on the victim AND
+    # the router's fleet trace store has captured at least one
+    # victim-side serving span (so the post-kill trace join can show
+    # the dead replica's lifecycle, not just the router's view of it)
     deadline = time.monotonic() + 60
+    victim_traced = False
     while time.monotonic() < deadline:
         with router._lock:
             v = next(r for r in router.replicas
                      if r.url == victim.url)
             inflight = v.inflight
         if inflight > 0:
-            break
+            tr = json.loads(fetch(server.url + "/traces"))
+            victim_traced = any(victim.url in (t.get("replicas") or [])
+                                for t in tr.get("traces") or [])
+            if victim_traced:
+                break
         time.sleep(0.02)
     assert inflight > 0, "burst never reached the victim replica"
+    assert victim_traced, \
+        "no victim-side serving span reached the fleet trace store"
+    # one final forced pull right before the kill: every request
+    # admitted on the victim so far has its serving.admitted instant
+    # safely in the router's store before the process dies
+    fetch(server.url + "/traces")
     victim.sigkill()
     print(f"fleet_smoke: SIGKILLed {victim.url} with {inflight} "
           f"dispatch(es) in flight")
@@ -222,6 +246,38 @@ def run(router, server, reps, LoadGenerator, validate_exposition_text):
           f"(failovers={ctr['dmlc_router_failovers_total']:.0f}, "
           f"p99_ttft={summary['p99_ttft_s']:.2f}s, "
           f"retried_ok={summary['n_requests_retried_ok']})")
+
+    # ---- phase 1b: the killed request is ONE fleet trace --------------
+    # a request torn by the SIGKILL must surface as a single trace_id
+    # whose journey shows >=2 router dispatch attempts on distinct
+    # replicas AND both server-side lifecycles (victim history +
+    # survivor completion), stitched by flow arrows in the merged
+    # Chrome trace — the cross-process join this PR exists for
+    doc = json.loads(fetch(server.url + "/traces"))
+    assert doc.get("enabled"), "fleet tracing not enabled at the router"
+    joined = [t for t in doc["traces"]
+              if t["attempts"] >= 2 and len(t["replicas"]) >= 2]
+    assert joined, (
+        "no trace joined a failed-over request across both replicas: "
+        + json.dumps(doc["traces"][:4]))
+    tid = joined[0]["trace_id"]
+    tl = json.loads(fetch(server.url + "/trace/" + tid))
+    disp = [e for e in tl["events"] if e["name"] == "router.dispatch"]
+    disp_replicas = {e["args"].get("replica") for e in disp}
+    assert len(disp) >= 2 and len(disp_replicas) >= 2, (
+        f"trace {tid} journey lacks the dual dispatch: {disp}")
+    lifecycles = {e["source"] for e in tl["events"]
+                  if str(e.get("cat", "")).startswith("serving")}
+    assert len(lifecycles) >= 2, (
+        f"trace {tid} lacks both server-side lifecycles: "
+        f"{sorted(lifecycles)} in {json.dumps(tl['events'][:10])}")
+    chrome = json.loads(fetch(server.url + "/trace"))
+    phases = {e.get("ph") for e in chrome}
+    assert "s" in phases and "f" in phases, (
+        f"merged Chrome trace lacks flow arrows: phases={phases}")
+    print(f"fleet_smoke: trace {tid[:16]} joined the killed request "
+          f"across {sorted(disp_replicas)} with flow arrows "
+          f"({len(tl['events'])} events)")
 
     # ---- phase 2: restart the victim; the circuit re-admits it --------
     reps[0] = ReplicaProc(victim.port)

@@ -211,6 +211,58 @@ def test_flight_ingest_restart_resets_rank_store():
     assert evs == ["new0"]  # dead incarnation's spans dropped
 
 
+def test_remap_ranks_moves_request_rows_without_collision():
+    # elastic generation change: rank 1 dies, rank 2 survives as the
+    # new rank 1.  Synthetic request-row tids (1<<48 + req_id) and
+    # fleet trace_ids name LOGICAL entities and must survive the
+    # renumbering verbatim, while the store key (merged-trace pid)
+    # moves with the surviving process — no collision with the rank
+    # that previously owned the number, no mislabeled rows.
+    from dmlc_tpu.telemetry.requests import REQUEST_ROW_TID_BASE
+
+    fr = FlightRecorder()
+    for r in (0, 1, 2):
+        spans = [{"name": f"req.r{r}", "ts": 1.0, "dur": 5.0,
+                  "tid": REQUEST_ROW_TID_BASE + 100 + r, "seq": 1,
+                  "cat": "serving", "thread": f"req {100 + r}",
+                  "args": {"trace_id": f"{r:032x}"}}]
+        fr.ingest(r, {"anchor": 100.0 + r, "spans": spans,
+                      "clock": {"offset_s": float(r), "rtt_s": 0.001}},
+                  host=f"host{r}")
+    fr.remap_ranks({0: 0, 2: 1})
+    assert fr.ranks() == [0, 1]
+
+    doc = fr.to_chrome_trace()
+    evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    by_pid = {e["pid"]: e for e in evs}
+    assert set(by_pid) == {1, 2}  # pid = new rank + 1; rank 1 dropped
+    # rank 0 untouched; the survivor's row moved intact: same request
+    # tid, same trace id, same name — only the process row changed
+    assert by_pid[1]["name"] == "req.r0"
+    assert by_pid[2]["name"] == "req.r2"
+    assert by_pid[2]["tid"] == REQUEST_ROW_TID_BASE + 102
+    assert by_pid[2]["args"]["trace_id"] == f"{2:032x}"
+    tids = [e["tid"] for e in evs]
+    assert len(tids) == len(set((e["pid"], e["tid"]) for e in evs))
+    meta = {(e["pid"], e["name"]): e for e in doc["traceEvents"]
+            if e["ph"] == "M"}
+    assert meta[(2, "process_name")]["args"]["name"] == "rank 1 (host2)"
+
+    # the clock relation travels with the surviving PROCESS (its
+    # physical clock did not change when its rank number did)
+    assert fr.clock.offset(1) == pytest.approx(2.0)
+    assert fr.clock.offset(2) is None
+
+    # seq high-water followed the move: re-shipping the survivor's
+    # already-ingested span under its NEW rank id dedups, and its
+    # anchor is recognized (no phantom-restart reset)
+    fr.ingest(1, {"anchor": 102.0, "spans": [
+        {"name": "req.r2", "ts": 1.0, "dur": 5.0,
+         "tid": REQUEST_ROW_TID_BASE + 102, "seq": 1,
+         "args": {"trace_id": f"{2:032x}"}}]})
+    assert fr.span_counts()[1] == 1
+
+
 def test_flight_ingest_survives_garbage():
     fr = FlightRecorder()
     fr.ingest_json(0, "{not json")

@@ -1,0 +1,337 @@
+"""dmlc-trace: fleet trace context, decision audit log, and the
+router-side FleetTraceStore (telemetry.tracecontext).
+
+The unit tests drive synthetic span-increment docs through the store
+so the join/merge/summarize contracts are checked exactly; one test
+runs a real Router against a scriptable replica with tracing OFF and
+the id-minting functions booby-trapped, proving the documented
+zero-overhead off path (the ``profiled_jit`` discipline).
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from dmlc_tpu import telemetry
+from dmlc_tpu.telemetry import tracecontext
+from dmlc_tpu.telemetry.requests import RequestLedger
+from dmlc_tpu.telemetry.tracecontext import (DecisionLog, FleetTraceStore,
+                                             TRACE_HEADER, format_header,
+                                             mint_trace_id, new_span_id,
+                                             parse_header)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.reset()
+    tracecontext.reset_decisions()
+    yield
+    telemetry.reset()
+    tracecontext.reset_decisions()
+
+
+# ---------------------------------------------------------------------------
+# context propagation primitives
+# ---------------------------------------------------------------------------
+
+def test_header_roundtrip_and_tolerant_parse():
+    tid, sid = mint_trace_id("req-1"), new_span_id()
+    assert parse_header(format_header(tid, sid)) == (tid, sid)
+    # tolerant: case and surrounding whitespace are normalized
+    assert parse_header(f"  {tid.upper()}-{sid.upper()} ") == (tid, sid)
+    # a bad tracer upstream must never fail a request
+    for garbage in (None, "", "nope", tid, f"{tid}-{sid}-extra",
+                    f"{tid[:-1]}-{sid}", f"{tid}-{sid[:-1]}",
+                    f"{tid[:-1]}g-{sid}", 7):
+        assert parse_header(garbage) is None
+
+
+def test_mint_is_deterministic_and_span_ids_are_not():
+    a, b = mint_trace_id("req-1"), mint_trace_id("req-1")
+    assert a == b and len(a) == 32 and int(a, 16) >= 0
+    assert mint_trace_id("req-2") != a
+    s1, s2 = new_span_id(), new_span_id()
+    assert len(s1) == 16 and int(s1, 16) >= 0
+    assert s1 != s2
+
+
+# ---------------------------------------------------------------------------
+# decision audit log
+# ---------------------------------------------------------------------------
+
+def test_decision_log_incremental_export_contract():
+    log = DecisionLog(capacity=8)
+    for i in range(5):
+        rec = log.record("scale_up", replica=f"r{i}")
+        assert rec["seq"] == i + 1 and rec["kind"] == "scale_up"
+    recs, last = log.records_since(0)
+    assert last == 5 and [r["seq"] for r in recs] == [1, 2, 3, 4, 5]
+    # the ?since= cursor never re-reads history
+    recs, last = log.records_since(3)
+    assert [r["seq"] for r in recs] == [4, 5] and last == 5
+    recs, _ = log.records_since(5)
+    assert recs == []
+    # limit caps at the OLDEST records (the poller catches up in order)
+    recs, _ = log.records_since(0, limit=2)
+    assert [r["seq"] for r in recs] == [1, 2]
+
+
+def test_decision_log_capacity_bounds_ring_but_seq_is_monotone():
+    log = DecisionLog(capacity=4)
+    for i in range(10):
+        log.record("k", i=i)
+    recs, last = log.records_since(0)
+    assert last == 10
+    assert [r["seq"] for r in recs] == [7, 8, 9, 10]  # oldest evicted
+    assert [r["t"] <= time.time() for r in recs] == [True] * 4
+    assert log.tail(2)[-1]["seq"] == 10
+    log.reset()
+    assert log.records_since(0) == ([], 10)  # seq keeps going
+    assert log.record("k")["seq"] == 11
+
+
+def test_default_ring_singleton_and_reset():
+    tracecontext.record_decision("tenant_rejected", tenant="free")
+    recs, last = tracecontext.decision_log().records_since(0)
+    assert last == 1 and recs[0]["tenant"] == "free"
+    tracecontext.reset_decisions()
+    assert tracecontext.decision_log().records_since(0) == ([], 0)
+
+
+# ---------------------------------------------------------------------------
+# fleet trace assembly
+# ---------------------------------------------------------------------------
+
+TID = mint_trace_id("req-join")
+
+
+def _span(name, ts_us, dur_us=1000.0, cat="serving", tid=1, **args):
+    rec = {"name": name, "ts": ts_us, "dur": dur_us, "cat": cat,
+           "tid": tid, "seq": 0}
+    if args:
+        rec["args"] = args
+    return rec
+
+
+def test_store_keeps_only_the_trace_join():
+    st = FleetTraceStore(max_spans_per_source=64)
+    kept = st.ingest("router", {"anchor_epoch": 100.0, "last_seq": 3,
+                                "spans": [
+        _span("router.dispatch", 0.0, cat="router", trace_id=TID,
+              replica="http://r1"),
+        _span("router.circuit_open", 10.0, cat="router"),  # control plane
+        _span("engine.step", 20.0, cat="engine"),          # not a join span
+        "garbage",
+    ]})
+    assert kept == 2
+    assert st.cursor("router") == 3 and st.sources() == ["router"]
+    # only the trace-stamped span names a trace
+    assert st.trace_ids() == [TID]
+
+
+def test_timeline_summary_and_slowest_first_ordering():
+    st = FleetTraceStore(max_spans_per_source=64)
+    # router: primary dispatch + a later hedge to a second replica
+    st.ingest("router", {"anchor_epoch": 100.0, "last_seq": 2, "spans": [
+        _span("router.dispatch", 0.0, 50e4, cat="router", trace_id=TID,
+              replica="http://r1", kind="primary"),
+        _span("router.dispatch", 20e4, 30e4, cat="router", trace_id=TID,
+              replica="http://r2", kind="hedge"),
+    ]})
+    # r1 saw queue+prefill before dying; r2 finished it
+    st.ingest("http://r1", {"anchor_epoch": 100.0, "last_seq": 2,
+                            "spans": [
+        _span("serving.queue", 1e4, 2e4, trace_id=TID),
+        _span("serving.prefill", 3e4, 4e4, trace_id=TID),
+    ]})
+    st.ingest("http://r2", {"anchor_epoch": 100.2, "last_seq": 1,
+                            "spans": [
+        _span("serving.decode", 1e4, 25e4, trace_id=TID),
+    ]})
+    # a second, faster trace -> must sort AFTER the slow one
+    tid2 = mint_trace_id("req-fast")
+    st.ingest("router", {"anchor_epoch": 100.0, "last_seq": 3, "spans": [
+        _span("router.dispatch", 90e4, 1e4, cat="router", trace_id=tid2,
+              replica="http://r1"),
+    ]})
+
+    tracecontext.record_decision("scale_up", replica="http://r2",
+                                 trace_id=TID)
+    tracecontext.record_decision("scale_down", replica="http://r9")
+
+    tl = st.timeline(TID)
+    assert tl["trace_id"] == TID
+    # wall-clock sorted across sources (r2's anchor is 0.2s later)
+    walls = [e["t_wall"] for e in tl["events"]]
+    assert walls == sorted(walls) and len(walls) == 5
+    assert tl["sources"] == ["http://r1", "http://r2", "router"]
+    # only the decision naming this trace rides along
+    assert [d["kind"] for d in tl["decisions"]] == ["scale_up"]
+
+    s = tl["summary"]
+    assert s["attempts"] == 2 and s["hedged"] is True
+    assert s["attempt_replicas"] == ["http://r1", "http://r2"]
+    assert s["replicas"] == ["http://r1", "http://r2"]
+    # phases aggregate serving span durations by suffix
+    assert s["queue_s"] == pytest.approx(0.02)
+    assert s["prefill_s"] == pytest.approx(0.04)
+    assert s["ttft_s"] == pytest.approx(0.06)
+    assert s["latency_s"] > 0
+
+    summaries = st.trace_summaries()
+    assert [x["trace_id"] for x in summaries] == [TID, tid2]  # slowest 1st
+    assert st.trace_ids()[0] == tid2  # most recently STARTED first
+
+
+def test_chrome_trace_has_flow_arrows_and_decision_instants():
+    st = FleetTraceStore(max_spans_per_source=64)
+    st.ingest("router", {"anchor_epoch": 100.0, "last_seq": 1, "spans": [
+        _span("router.dispatch", 0.0, 50e4, cat="router", trace_id=TID,
+              replica="http://r1", kind="primary"),
+    ]})
+    st.ingest("http://r1", {"anchor_epoch": 100.0, "last_seq": 1,
+                            "spans": [
+        _span("serving.queue", 1e4, 2e4, trace_id=TID),
+    ]})
+    tracecontext.record_decision("autoscale_verdict", verdict="scale_up")
+
+    evs = st.to_chrome_trace()
+    names = {e.get("name") for e in evs if e.get("ph") == "M"}
+    assert {"process_name", "process_sort_index"} <= names
+    labels = {e["args"]["name"] for e in evs
+              if e.get("name") == "process_name"}
+    assert labels == {"router", "replica http://r1"}
+    xs = [e for e in evs if e.get("ph") == "X"]
+    assert {e["name"] for e in xs} == {"router.dispatch", "serving.queue"}
+    assert all(e["ts"] >= 0 for e in xs)  # rebased to the earliest span
+    # the decision instant lands on the router's process row
+    router_pid = next(e["pid"] for e in evs
+                      if e.get("name") == "process_name"
+                      and e["args"]["name"] == "router")
+    inst = [e for e in evs if e.get("ph") == "i"]
+    assert inst and inst[0]["name"] == "decision:autoscale_verdict"
+    assert inst[0]["pid"] == router_pid
+    # the journey arrow: one s/f pair sharing an id, start on the
+    # router's dispatch, finish on the replica's earliest serving span
+    s = [e for e in evs if e.get("ph") == "s"]
+    f = [e for e in evs if e.get("ph") == "f"]
+    assert len(s) == 1 and len(f) == 1
+    assert s[0]["id"] == f[0]["id"] and f[0]["bp"] == "e"
+    assert s[0]["pid"] == router_pid and f[0]["pid"] != router_pid
+
+
+def test_replica_restart_rewinds_cursor_but_keeps_history():
+    st = FleetTraceStore(max_spans_per_source=64)
+    st.ingest("http://r1", {"anchor_epoch": 100.0, "last_seq": 5,
+                            "spans": [_span("serving.queue", 1e4, 2e4,
+                                            trace_id=TID)]})
+    assert st.cursor("http://r1") == 5
+    # the replica restarted: new anchor, seq counter reset.  A batch
+    # fetched with the stale cursor may be gapped -> dropped whole.
+    kept = st.ingest("http://r1", {"anchor_epoch": 200.0, "last_seq": 9,
+                                   "spans": [_span("serving.queue", 1e4,
+                                                   2e4, trace_id=TID)]})
+    assert kept == 0 and st.cursor("http://r1") == 0
+    assert st.anchor("http://r1") == 200.0
+    # the dead incarnation's spans ARE the post-SIGKILL history
+    assert st.trace_ids() == [TID]
+    # the next poll re-reads the fresh ring from 0 and lands normally
+    kept = st.ingest("http://r1", {"anchor_epoch": 200.0, "last_seq": 2,
+                                   "spans": [_span("serving.decode", 3e4,
+                                                   1e4, trace_id=TID)]})
+    assert kept == 1 and st.cursor("http://r1") == 2
+
+
+# ---------------------------------------------------------------------------
+# the zero-overhead off path
+# ---------------------------------------------------------------------------
+
+class _OkReplica:
+    """Minimal healthy replica for the off-path router test."""
+
+    def __init__(self):
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def _send(self, doc):
+                body = json.dumps(doc).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._send({"status": "ok", "active": 0, "waiting": 0,
+                            "max_active": 4, "draining": False})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                doc = json.loads(self.rfile.read(n))
+                outer.trace_headers.append(
+                    self.headers.get(TRACE_HEADER))
+                self._send({"state": "done", "output_ids": [1],
+                            "n_generated": 1,
+                            "request_id": doc.get("request_id")})
+
+            def log_message(self, *a):
+                pass
+
+        self.trace_headers = []
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def test_disabled_tracing_is_zero_overhead_on_the_request_path(
+        monkeypatch):
+    """With DMLC_TRACE_FLEET off, ``enabled()`` must be the ONLY
+    tracecontext call on the hot path: minting and span-id functions
+    are booby-trapped and a request still routes fine."""
+    from dmlc_tpu.serving.router import Router
+
+    monkeypatch.delenv("DMLC_TRACE_FLEET", raising=False)
+    assert tracecontext.enabled() is False
+
+    def boom(*a, **k):
+        raise AssertionError("tracecontext touched on the off path")
+
+    monkeypatch.setattr(tracecontext, "mint_trace_id", boom)
+    monkeypatch.setattr(tracecontext, "new_span_id", boom)
+    monkeypatch.setattr(tracecontext, "parse_header", boom)
+
+    rep = _OkReplica()
+    r = Router([rep.url], retries=2, dispatch_timeout_s=5.0,
+               request_timeout_s=10.0, start_health_thread=False)
+    try:
+        r.poll_once()
+        code, doc, _ = r.route({"prompt": [1], "request_id": "off-1"},
+                               trace_parent=f"{TID}-{'0' * 16}")
+        assert code == 200 and doc["request_id"] == "off-1"
+        assert r.trace_store is None        # dark: no store, no pulls
+        assert rep.trace_headers == [None]  # no header forwarded
+    finally:
+        r.close()
+        rep.close()
+
+    # the replica-side ledger is equally dark: no trace_id -> no
+    # serving.admitted instant, no trace_id stamped anywhere
+    led = RequestLedger(capacity=8, trace_rows=True)
+    led.on_submit(1, n_prompt=3, t=0.0)
+    led.on_prefill_begin(1, t=0.1)
+    led.on_first_token(1, t=0.2)
+    rec = led.on_finish(1, t=0.3)
+    assert "trace_id" not in rec
+    spans, _ = telemetry.spans_since(0)
+    assert all((s.get("args") or {}).get("trace_id") is None
+               for s in spans)
+    assert not any(s["name"] == "serving.admitted" for s in spans)
