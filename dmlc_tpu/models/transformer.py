@@ -604,6 +604,160 @@ def forward_decode(params, ids, positions, k_cache, v_cache, lengths,
     return logits, jnp.stack(k_news), jnp.stack(v_news)
 
 
+def _rope_window(x, positions, theta: float = 10000.0):
+    """Rotary embedding for a decode WINDOW: x [B, S, H, D] with
+    per-token positions [B, S] (speculative verify places each window
+    token at its own absolute depth)."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [B, S, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _cached_window_attention(q, k_new, v_new, k_cache, v_cache, lengths):
+    """Window generalization of :func:`_cached_attention`: S window
+    tokens per row (q/k_new/v_new [B, S, H, D]) attend the cache plus a
+    causal prefix of the window itself — window position s sees cache
+    slots j < lengths[b] and window slots <= s.  S=1 reduces exactly to
+    the single-token mask."""
+    d = q.shape[-1]
+    tc = k_cache.shape[1]
+    s_w = q.shape[1]
+    k_all = jnp.concatenate([k_cache, k_new], axis=1)  # [B, Tc+S, H, D]
+    v_all = jnp.concatenate([v_cache, v_new], axis=1)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_all,
+                   preferred_element_type=jnp.float32) * (1.0 / d ** 0.5)
+    idx = jnp.arange(tc + s_w)
+    in_cache = idx[None, None, :] < lengths[:, None, None]       # [B, 1, K]
+    in_window = ((idx[None, None, :] >= tc)
+                 & (idx[None, None, :] - tc
+                    <= jnp.arange(s_w)[None, :, None]))          # [1, S, K]
+    valid = in_cache | in_window                                 # [B, S, K]
+    s = jnp.where(valid[:, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_all.dtype), v_all,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def forward_decode_spec(params, ids, positions, k_cache, v_cache, lengths,
+                        cfg: TransformerConfig):
+    """Multi-token verify step against a dense gathered cache.
+
+    The speculative-decoding scorer on the gather path: ids/positions
+    [B, S] are each row's window — position 0 the token being consumed,
+    positions 1..S-1 drafted continuations — and the step returns
+    logits at ALL window positions (``[B, S, V]``) so the engine's
+    longest-accepted-prefix walk can verify every draft from one
+    program launch.  k_new/v_new come back ``[L, B, S, H, hd]``; the
+    caller appends exactly the prefix it commits.  S=1 is numerically
+    the plain :func:`forward_decode` (same mask, same f32 score path).
+    """
+    x = embed_lookup(params["embed"], ids, ShardAxes()).astype(cfg.jdtype)
+    blocks = params["blocks"]
+    n_stages, lps = blocks["ln1"].shape[0], blocks["ln1"].shape[1]
+    k_news, v_news = [], []
+    li = 0
+    for s in range(n_stages):
+        for i in range(lps):
+            p = _layer_params(blocks, s, i)
+            with jax.named_scope("attention"):
+                xn = rms_norm(x, p["ln1"])
+                q = jnp.einsum("bte,ehd->bthd", xn, p["wq"])
+                k = jnp.einsum("bte,ehd->bthd", xn, p["wk"])
+                v = jnp.einsum("bte,ehd->bthd", xn, p["wv"])
+                q = _rope_window(q, positions)
+                k = _rope_window(k, positions)
+                o = _cached_window_attention(q, k, v, k_cache[li],
+                                             v_cache[li], lengths)
+                x = x + jnp.einsum("bthd,hde->bte", o, p["wo"])
+            with jax.named_scope("mlp"):
+                x = x + _moe_ffn(rms_norm(x, p["ln2"]), p, ShardAxes(), cfg)
+            k_news.append(k)
+            v_news.append(v)
+            li += 1
+    with jax.named_scope("unembed"):
+        x = rms_norm(x, params["ln_f"])
+        logits = jnp.einsum("bte,ev->btv", x, params["unembed"])
+    return logits, jnp.stack(k_news), jnp.stack(v_news)
+
+
+def forward_decode_paged(params, ids, positions, k_pool, v_pool,
+                         block_tables, lengths, cfg: TransformerConfig):
+    """Decode window step attending the paged KV pool IN PLACE.
+
+    The fast path: no dense gather, no re-placement copy.  ids /
+    positions [B, S] (S=1 plain decode, S=k+1 speculative verify);
+    k_pool / v_pool [L, n_blocks, block_size, H, hd] — the cache's
+    device-resident pools; block_tables [B, W] int32 (rows padded with
+    0); lengths [B] int32 committed tokens per row.
+
+    Scatter-then-attend per layer: each layer writes the window's K/V
+    into the pool at positions ``lengths[b] + s`` (physical address via
+    the block table) and then attends positions ``<= lengths[b] + s``
+    through :func:`ops.paged_attention.paged_attention` — the same mask
+    the gather path applies to its dense view, with the window tokens
+    at their real paged addresses instead of a concatenated tail.
+    Dead rows (length 0) route their scatter out of bounds
+    (``mode="drop"``) so padding can never corrupt a live block.
+
+    Returns ``(logits [B, S, V], k_pool, v_pool, k_new, v_new)``: the
+    updated pools (the caller adopts them — window slots past what it
+    commits hold garbage by the same contract as gather padding) and
+    the window K/V ``[L, B, S, H, hd]`` for the host-mirror append.
+    """
+    from ..ops import paged_attention as _paged
+
+    b, s_w = ids.shape
+    n_blocks = k_pool.shape[1]
+    bs = k_pool.shape[2]
+    # physical scatter addresses for the window: logical block ->
+    # table lookup -> (block, slot); dead rows go out of bounds
+    pos_w = lengths[:, None] + jnp.arange(s_w)[None, :]          # [B, S]
+    lb = pos_w // bs
+    wb = jnp.take_along_axis(block_tables,
+                             jnp.clip(lb, 0, block_tables.shape[1] - 1),
+                             axis=1)
+    wb = jnp.where(lengths[:, None] > 0, wb, n_blocks)           # OOB-drop
+    ws = pos_w % bs
+    x = embed_lookup(params["embed"], ids, ShardAxes()).astype(cfg.jdtype)
+    blocks = params["blocks"]
+    n_stages, lps = blocks["ln1"].shape[0], blocks["ln1"].shape[1]
+    k_news, v_news = [], []
+    li = 0
+    for s in range(n_stages):
+        for i in range(lps):
+            p = _layer_params(blocks, s, i)
+            with jax.named_scope("attention"):
+                xn = rms_norm(x, p["ln1"])
+                q = jnp.einsum("bte,ehd->bthd", xn, p["wq"])
+                k = jnp.einsum("bte,ehd->bthd", xn, p["wk"])
+                v = jnp.einsum("bte,ehd->bthd", xn, p["wv"])
+                q = _rope_window(q, positions)
+                k = _rope_window(k, positions)
+                k_pool = k_pool.at[li, wb, ws].set(
+                    k.astype(k_pool.dtype), mode="drop")
+                v_pool = v_pool.at[li, wb, ws].set(
+                    v.astype(v_pool.dtype), mode="drop")
+                o = _paged.paged_attention(q, k_pool[li], v_pool[li],
+                                           block_tables, lengths)
+                x = x + jnp.einsum("bthd,hde->bte", o, p["wo"])
+            with jax.named_scope("mlp"):
+                x = x + _moe_ffn(rms_norm(x, p["ln2"]), p, ShardAxes(), cfg)
+            k_news.append(k)
+            v_news.append(v)
+            li += 1
+    with jax.named_scope("unembed"):
+        x = rms_norm(x, params["ln_f"])
+        logits = jnp.einsum("bte,ev->btv", x, params["unembed"])
+    return logits, k_pool, v_pool, jnp.stack(k_news), jnp.stack(v_news)
+
+
 def make_train_step(mesh, cfg: TransformerConfig, optimizer=None,
                     ledger: bool = True, grad_norm: bool = False,
                     overlap: Optional[str] = None):

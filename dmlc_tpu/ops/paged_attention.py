@@ -1,0 +1,198 @@
+"""Paged decode attention: attend straight into the block pool.
+
+The decode fast path's kernel: queries for a small per-sequence window
+of tokens (one token in plain decode, ``k+1`` in a speculative-verify
+step) attend against that sequence's KV blocks *in place*, addressed
+through a per-sequence block table — no dense ``[B, maxlen, H, D]``
+gather is ever materialized and no re-placement copy runs per
+iteration.  The pool keeps the cache's layer-major layout
+(``kv_cache.PagedKVCache``); callers pass ONE layer's slice:
+
+    k_pool / v_pool : [n_blocks, block_size, H, D]
+    block_tables    : [B, W] int32   (row b's physical block ids;
+                                      rows padded with 0 — masked off)
+    lengths         : [B]    int32   (committed tokens before the window)
+    q               : [B, S, H, D]   (post-rope window queries)
+
+Window position ``s`` of row ``b`` attends pool positions
+``p <= lengths[b] + s`` within the table's ``W * block_size`` span —
+the caller must have scattered the window's own K/V into the pool at
+positions ``lengths[b] .. lengths[b]+S-1`` first (scatter-then-attend),
+so this is exactly the gather path's "cache + new token" mask with the
+new tokens living at their real paged addresses instead of a dense
+tail.  Dead batch rows (length 0, table all zeros) read block 0 and
+produce garbage the engine never samples.
+
+Two implementations behind one dispatcher: a Pallas TPU kernel whose
+block-table indirection lives in the BlockSpec index map (the scalar-
+prefetched table picks which physical block each grid step DMAs — the
+PagedAttention trick), and a ``lax``-composed fallback (gather inside
+jit) that runs everywhere and is the parity oracle.  interpret=True
+runs the kernel on CPU for tests.  Layout/tiling per
+/opt/skills/guides/pallas_guide.md; grid/accumulator structure mirrors
+ops/flash_attention.py (KV walk in the grid, f32 accumulators in the
+revisited output blocks, predicated skip of fully-masked blocks).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG_BIG = -1e30
+
+__all__ = ["paged_attention", "supports"]
+
+
+def supports(head_dim: int, block_size: int) -> bool:
+    """Whether the Pallas kernel serves these shapes: the head dim must
+    fill whole 128-element lanes and the KV block whole 8-row sublanes
+    (f32 minimal tile); everything else is handled by padding."""
+    return head_dim % 128 == 0 and block_size % 8 == 0
+
+
+def _lax_paged_attention(q, k_pool, v_pool, block_tables, lengths, scale):
+    """Gather-composed fallback: the block gather happens INSIDE jit
+    (one fused gather per layer, no host staging, no dense [B, maxlen]
+    intermediate on the host) and the math mirrors the model's
+    ``_cached_attention`` f32 score path bit-for-bit modulo summation
+    order — the 1e-5 parity contract."""
+    b, s_w, h, d = q.shape
+    w = block_tables.shape[1]
+    bs = k_pool.shape[1]
+    k_ctx = k_pool[block_tables].reshape(b, w * bs, h, d)
+    v_ctx = v_pool[block_tables].reshape(b, w * bs, h, d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_ctx,
+                   preferred_element_type=jnp.float32) * scale
+    pos = jnp.arange(w * bs)
+    limit = lengths[:, None] + jnp.arange(s_w)[None, :]          # [B, S]
+    keep = pos[None, None, :] <= limit[:, :, None]               # [B, S, K]
+    s = jnp.where(keep[:, None], s, _NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_ctx.dtype), v_ctx,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def _kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, pv_ref, m_ref, l_ref,
+            *, bs: int, s_pad: int, s_real: int, scale: float):
+    from jax.experimental import pallas as pl
+
+    bi = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        pv_ref[...] = jnp.zeros_like(pv_ref[...])
+        m_ref[...] = jnp.full_like(m_ref[...], _NEG_BIG)
+        l_ref[...] = jnp.zeros_like(l_ref[...])
+
+    # the last pool position any window row of this sequence may
+    # attend; blocks entirely past it are predicated no-op visits
+    limit = len_ref[bi] + s_real - 1
+
+    @pl.when(j * bs <= limit)
+    def _step():
+        q = q_ref[0, 0]                                # [S_pad, D]
+        kb = k_ref[:, :, 0].reshape(bs, -1)            # [bs, D]
+        vb = v_ref[:, :, 0].reshape(bs, -1)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [S_pad, bs]
+        k_pos = j * bs + lax.broadcasted_iota(jnp.int32, (s_pad, bs), 1)
+        q_lim = len_ref[bi] + lax.broadcasted_iota(jnp.int32, (s_pad, bs), 0)
+        keep = k_pos <= q_lim
+        s = jnp.where(keep, s, _NEG_BIG)
+        m_old = m_ref[0, 0, :, 0]                      # [S_pad]
+        l_old = l_ref[0, 0, :, 0]
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=1))
+        p = jnp.where(keep, jnp.exp(s - m_new[:, None]), 0.0)
+        corr = jnp.exp(m_old - m_new)
+        l_new = l_old * corr + jnp.sum(p, axis=1)
+        pv = jax.lax.dot_general(
+            p, vb.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [S_pad, D]
+        pv_ref[0, 0] = pv_ref[0, 0] * corr[:, None] + pv
+        # per-row scalars broadcast over an 8-lane minor axis (Mosaic
+        # lane tiling, same storage trick as flash_attention)
+        m_ref[0, 0] = jnp.broadcast_to(m_new[:, None], (s_pad, 8))
+        l_ref[0, 0] = jnp.broadcast_to(l_new[:, None], (s_pad, 8))
+
+
+def _pallas_paged_attention(q, k_pool, v_pool, block_tables, lengths,
+                            scale, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, s_w, h, d = q.shape
+    w = block_tables.shape[1]
+    bs = k_pool.shape[1]
+    s_pad = -(-s_w // 8) * 8  # window rows fill whole sublanes
+    qt = jnp.transpose(q, (0, 2, 1, 3))                  # [B, H, S, D]
+    if s_pad != s_w:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, s_pad - s_w), (0, 0)))
+    tbl = block_tables.astype(jnp.int32)
+    lens = lengths.astype(jnp.int32)
+
+    # the paged indirection: the K/V index maps read the scalar-
+    # prefetched block table to pick which PHYSICAL block each grid
+    # step DMAs — the kernel walks row b's logical blocks j=0..W-1 but
+    # the pool is only ever touched at the table's addresses
+    q_spec = pl.BlockSpec((1, 1, s_pad, d),
+                          lambda bi, hi, j, tbl_, lens_: (bi, hi, 0, 0))
+    kv_spec = pl.BlockSpec((1, bs, 1, d),
+                           lambda bi, hi, j, tbl_, lens_:
+                           (tbl_[bi, j], 0, hi, 0))
+    acc_spec = pl.BlockSpec((1, 1, s_pad, d),
+                            lambda bi, hi, j, tbl_, lens_: (bi, hi, 0, 0))
+    ml_spec = pl.BlockSpec((1, 1, s_pad, 8),
+                           lambda bi, hi, j, tbl_, lens_: (bi, hi, 0, 0))
+    pv, m, l = pl.pallas_call(
+        functools.partial(_kernel, bs=bs, s_pad=s_pad, s_real=s_w,
+                          scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, h, w),  # innermost block walk revisits (bi, hi)
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=[acc_spec, ml_spec, ml_spec],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s_pad, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s_pad, 8), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, s_pad, 8), jnp.float32),
+        ],
+        interpret=interpret,
+    )(tbl, lens, qt, k_pool, v_pool)
+    out = pv / jnp.maximum(l[..., :1], 1e-37)            # [B, H, S_pad, D]
+    out = jnp.transpose(out[:, :, :s_w], (0, 2, 1, 3))
+    return out.astype(q.dtype)
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, lengths, *,
+                    scale: Optional[float] = None, impl: str = "auto",
+                    interpret: bool = False):
+    """Window attention against one layer's paged KV pool.
+
+    See the module docstring for shapes and the mask contract.  Returns
+    ``[B, S, H, D]`` in q's dtype.  ``impl``: "auto" picks the Pallas
+    kernel on TPU when :func:`supports` allows and the lax fallback
+    everywhere else; "pallas"/"lax" force a path (tests drive the
+    kernel on CPU with ``impl="pallas", interpret=True``).
+    """
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / d ** 0.5
+    if impl not in ("auto", "pallas", "lax"):
+        raise ValueError(f"unknown paged-attention impl {impl!r}")
+    use_pallas = impl == "pallas" or (
+        impl == "auto" and jax.default_backend() == "tpu"
+        and supports(d, int(k_pool.shape[1])))
+    if use_pallas:
+        return _pallas_paged_attention(q, k_pool, v_pool, block_tables,
+                                       lengths, float(scale), interpret)
+    return _lax_paged_attention(q, k_pool, v_pool, block_tables, lengths,
+                                float(scale))

@@ -48,7 +48,7 @@ from ..base import DMLCError, get_env
 from ..concurrency import BufferPool, make_lock
 from ..models import transformer as tfm
 from .kv_cache import PagedKVCache
-from .scheduler import (ACTIVE, AlreadyFinished,
+from .scheduler import (ACTIVE, WAITING, AlreadyFinished,
                         ContinuousBatchScheduler, Request,
                         coerce_priority)
 
@@ -126,23 +126,45 @@ class _DedupeTable:
                 self._done.pop(self._order.popleft(), None)
 
 
-def _jitted_programs():
-    """Process-wide jitted prefill/decode (one jit wrapper, so every
-    engine instance shares one compile cache — tests and smokes build
-    several engines and must not pay XLA again for identical shapes).
+def _jitted_programs(use_paged: bool = False, window: int = 1):
+    """Process-wide jitted prefill/decode (one jit wrapper per program
+    variant, so every engine instance shares one compile cache — tests
+    and smokes build several engines and must not pay XLA again for
+    identical shapes).
 
-    Both programs go through :func:`telemetry.compute.profiled_jit`
-    (sites ``serving.prefill`` / ``serving.decode``), which is plain
+    The decode program depends on the engine's data path: the gather
+    oracle (``forward_decode``, site ``serving.decode``), its
+    multi-token speculative-verify twin (``forward_decode_spec``, site
+    ``serving.decode_spec``), or the paged fast path
+    (``forward_decode_paged``, site ``serving.decode_paged`` — one
+    program serves any verify window, the window is a shape).  All go
+    through :func:`telemetry.compute.profiled_jit`, which is plain
     ``jax.jit`` when ``DMLC_COMPUTE_PROFILE=0``; the cache is keyed on
     that mode so toggling the knob between tests cannot hand a plain
-    engine a profiled program or vice versa.  The decode site carries
-    the ``DMLC_SERVE_MAX_DECODE_SIGS`` signature cap — every distinct
-    gathered context length is a full XLA recompile, so unbounded
-    signature growth is a bug worth failing loudly on."""
+    engine a profiled program or vice versa.  Decode sites carry the
+    ``DMLC_SERVE_MAX_DECODE_SIGS`` signature cap — every distinct
+    context depth is a full XLA recompile, so unbounded signature
+    growth is a bug worth failing loudly on."""
     compute = telemetry.compute
-    key = "profiled" if compute.enabled() else "plain"
-    progs = _JIT_CACHE.get(key)
-    if progs is None:
+    mode = "profiled" if compute.enabled() else "plain"
+    if use_paged:
+        decode_key = (mode, "decode_paged")
+        builder = lambda cap: compute.profiled_jit(  # noqa: E731
+            tfm.forward_decode_paged, site="serving.decode_paged",
+            static_argnums=(7,), max_signatures=cap)
+    elif window > 1:
+        decode_key = (mode, "decode_spec")
+        builder = lambda cap: compute.profiled_jit(  # noqa: E731
+            tfm.forward_decode_spec, site="serving.decode_spec",
+            static_argnums=(6,), max_signatures=cap)
+    else:
+        decode_key = (mode, "decode")
+        builder = lambda cap: compute.profiled_jit(  # noqa: E731
+            tfm.forward_decode, site="serving.decode",
+            static_argnums=(6,), max_signatures=cap)
+    prefill_key = (mode, "prefill")
+    progs = (_JIT_CACHE.get(prefill_key), _JIT_CACHE.get(decode_key))
+    if progs[0] is None or progs[1] is None:
         # this cache outlives any one engine — if the first engine of
         # the process is built inside an interleaving-explorer scenario
         # (analysis.scenarios builds a real engine as a scheduler test
@@ -152,24 +174,20 @@ def _jitted_programs():
         prev_hook = concurrency._lock_factory_hook
         concurrency.set_lock_factory_hook(None)
         try:
-            progs = (
-                compute.profiled_jit(tfm.forward_prefill_last,
-                                     site="serving.prefill",
-                                     static_argnums=(3,)),
-                compute.profiled_jit(
-                    tfm.forward_decode, site="serving.decode",
-                    static_argnums=(6,),
-                    max_signatures=get_env("DMLC_SERVE_MAX_DECODE_SIGS",
-                                           64)),
-            )
+            if progs[0] is None:
+                _JIT_CACHE[prefill_key] = compute.profiled_jit(
+                    tfm.forward_prefill_last, site="serving.prefill",
+                    static_argnums=(3,))
+            if progs[1] is None:
+                _JIT_CACHE[decode_key] = builder(
+                    get_env("DMLC_SERVE_MAX_DECODE_SIGS", 64))
         finally:
             concurrency.set_lock_factory_hook(prev_hook)
-        _JIT_CACHE[key] = progs
-    else:
-        for prog in progs:
-            rereg = getattr(prog, "reregister", None)
-            if rereg is not None:
-                rereg()
+        progs = (_JIT_CACHE[prefill_key], _JIT_CACHE[decode_key])
+    for prog in progs:
+        rereg = getattr(prog, "reregister", None)
+        if rereg is not None:
+            rereg()
     return progs
 
 
@@ -238,7 +256,32 @@ class InferenceEngine:
         self._dedupe = _DedupeTable(get_env("DMLC_SERVE_DEDUPE_MAX", 512))
         self._crash_requeue_max = get_env(
             "DMLC_SERVE_CRASH_REQUEUE_MAX", 2)
-        self._prefill, self._decode = _jitted_programs()
+        # decode fast path: paged attention reads the pool in place
+        # (no per-step dense gather / re-placement copy) and an n-gram
+        # drafter turns one verify launch into up to spec_k+1 committed
+        # tokens.  "auto" takes the paged path except when the mesh
+        # demands the gather view's dp/tp re-placement (the paged
+        # program is single-chip for now)
+        self.paged_mode = str(get_env("DMLC_SERVE_PAGED_ATTN",
+                                      "auto")).lower()
+        if self.paged_mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"DMLC_SERVE_PAGED_ATTN must be auto|on|off, got "
+                f"{self.paged_mode!r}")
+        self.spec_k = max(0, int(get_env("DMLC_SERVE_SPEC_K", 0)))
+        self.spec_min_ctx = max(1, int(get_env("DMLC_SERVE_SPEC_MIN_CTX",
+                                               4)))
+        if self.paged_mode == "auto":
+            from .kv_cache import kv_partition_spec
+
+            sharded = mesh is not None and \
+                kv_partition_spec(mesh) is not None
+            self._use_paged = not sharded
+        else:
+            self._use_paged = self.paged_mode == "on"
+        self._spec_window = 1 + self.spec_k
+        self._prefill, self._decode = _jitted_programs(
+            self._use_paged, self._spec_window)
         self._stop = threading.Event()
         self._draining = threading.Event()
         # iteration seqlock: odd = an engine iteration is mid-flight
@@ -253,6 +296,10 @@ class InferenceEngine:
         self._thread: Optional[threading.Thread] = None
         # dmlc-check: unguarded(engine-thread-confined)
         self._flops_declared = False
+        # dmlc-check: unguarded(engine-thread-confined)
+        self._hbm_tick = 0
+        # dmlc-check: unguarded(engine-thread-confined)
+        self._fpt_cache: dict = {}
         # padded prompt lengths seen so far: a NEW bucket means a fresh
         # XLA prefill compile, worth a log line and a counter
         # dmlc-check: unguarded(engine-thread-confined)
@@ -324,9 +371,12 @@ class InferenceEngine:
         if any(t < 0 or t >= self.cfg.vocab for t in req.prompt_ids):
             raise ValueError(
                 f"prompt ids out of range for vocab {self.cfg.vocab}")
-        if not self.cache.fits_at_all(req.n_prompt + mnt):
+        # spec decode reserves a whole verify window ahead of each
+        # step, so the worst-case footprint carries spec_k extra slots
+        if not self.cache.fits_at_all(req.n_prompt + mnt + self.spec_k):
             raise RequestTooLarge(
-                f"request needs up to {req.n_prompt + mnt} cached tokens; "
+                f"request needs up to {req.n_prompt + mnt + self.spec_k} "
+                f"cached tokens; "
                 f"cache holds {self.cache.n_blocks * self.cache.block_size}")
         if request_id is not None:
             # publish BEFORE the (possibly seconds-long) slot wait so a
@@ -512,17 +562,30 @@ class InferenceEngine:
 
     # ---- one iteration --------------------------------------------------
     def step(self) -> bool:
-        """One continuous-batching iteration: at most one prefill, then
-        one decode token for every active request.  Returns whether any
-        work happened (the loop's idle signal).  Public so tests can
+        """One continuous-batching iteration: drain admissible prefills
+        (the scheduler's ``next_prefill`` stops at ``max_active``), then
+        one decode window for every active request.  Prefill-priority
+        keeps the decode batch full — an 8-deep queue joins the batch in
+        ONE iteration instead of ramping a row per step, which is where
+        decode MFU goes to die on short bursts.  Decode still runs every
+        iteration, so active rows are never starved; the worst prefill
+        stall a streaming user can see is one queue-drain of admissible
+        requests, bounded by ``max_active``.  Returns whether any work
+        happened (the loop's idle signal).  Public so tests can
         single-step the engine deterministically."""
         self._step_seq += 1
         try:
             did = False
-            req = self.scheduler.next_prefill()
-            if req is not None:
+            while True:
+                req = self.scheduler.next_prefill()
+                if req is None:
+                    break
                 self._run_prefill(req)
                 did = True
+                if req.state == WAITING:
+                    # allocate lost a race and requeued the request;
+                    # bail rather than spin on it inside one iteration
+                    break
             active = self.scheduler.active_requests()
             if active:
                 self._run_decode(active)
@@ -627,16 +690,26 @@ class InferenceEngine:
             self.requests.on_prefill_end(req.id)
         self.scheduler.activate(req)
 
-    def _ensure_decode_capacity(self, active: List[Request]) -> tuple:
-        """Reserve one more cache slot per active request, preempting
-        youngest-first under pressure; returns ``(survivors,
-        n_preempted)`` — the count feeds the iteration record."""
+    def _ensure_decode_capacity(self, active: List[Request],
+                                n_tokens: int = 1) -> tuple:
+        """Reserve ``n_tokens`` more cache slots per active request
+        (one for plain decode, the whole verify window under spec
+        decode), preempting youngest-first under pressure; returns
+        ``(survivors, n_preempted)`` — the count feeds the iteration
+        record."""
+        # batch fast path: one allocator visit reserves the whole
+        # batch when the pool has room (the overwhelmingly common
+        # case); the per-request loop below only runs under pressure,
+        # where eviction decisions must be made one victim at a time
+        if active and self.cache.extend_many(
+                [r.id for r in active], n_tokens):
+            return list(active), 0
         alive = []
         n_preempted = 0
         for req in active:
             if req.state != ACTIVE:
                 continue  # a preemption below already took it out
-            while not self.cache.extend(req.id, 1):
+            while not self.cache.extend(req.id, n_tokens):
                 victim = self.scheduler.preempt_youngest()
                 if victim is not None:
                     n_preempted += 1
@@ -655,8 +728,44 @@ class InferenceEngine:
         # only still-active requests may decode
         return [r for r in alive if r.state == ACTIVE], n_preempted
 
+    def _draft_tokens(self, req: Request) -> List[int]:
+        """n-gram suffix-lookup drafter: propose up to ``spec_k``
+        continuation tokens from the request's OWN context.  The
+        longest (3→1) suffix of prompt+generated that recurs earlier in
+        the context predicts whatever followed its previous occurrence
+        — free to compute, surprisingly effective on looping/structured
+        output, and harmless when wrong (the verify step rejects).  No
+        proposal below ``spec_min_ctx`` context tokens."""
+        ctx = list(req.prompt_ids) + list(req.generated)
+        n = len(ctx)
+        if n < self.spec_min_ctx:
+            return []
+        # C-speed suffix search: token ids map 1:1 onto unicode code
+        # points, so str.rfind does the rightmost-occurrence scan (the
+        # python-loop version was a measurable slice of a ~1 ms decode
+        # step at batch 8)
+        try:
+            text = "".join(map(chr, ctx))
+        except ValueError:  # id beyond chr() range: python-loop fallback
+            text = None
+        for m in (3, 2, 1):
+            if n <= m:
+                continue
+            if text is not None:
+                # match must lie fully inside the prefix (end before
+                # the terminal suffix itself): search window [0, n-1)
+                p = text.rfind(text[n - m:], 0, n - 1)
+            else:
+                suffix = ctx[-m:]
+                p = next((s for s in range(n - m - 1, -1, -1)
+                          if ctx[s:s + m] == suffix), -1)
+            if p >= 0:
+                return ctx[p + m:p + m + self.spec_k]
+        return []
+
     def _run_decode(self, active: List[Request]) -> None:
-        active, n_preempted = self._ensure_decode_capacity(active)
+        s_w = self._spec_window
+        active, n_preempted = self._ensure_decode_capacity(active, s_w)
         if not active:
             if n_preempted:
                 self.requests.on_iteration(
@@ -665,11 +774,32 @@ class InferenceEngine:
             return
         b = len(active)
         pad_b = self.max_active
-        ids = np.zeros(pad_b, np.int32)
-        positions = np.zeros(pad_b, np.int32)
+        # the decode window: column 0 is the token each row consumes
+        # this step; columns 1..k carry the drafter's proposals (zeros
+        # when it has none — the verify mask is causal inside the
+        # window, so junk columns cannot influence earlier positions)
+        ids = np.zeros((pad_b, s_w), np.int32)
+        positions = np.zeros((pad_b, s_w), np.int32)
+        drafts: List[List[int]] = []
+        if self._use_paged:
+            # ONE cache visit covers the whole batch: the block-table
+            # fetch already reports every row's committed length, so
+            # the per-row length() round-trips (a lock each) are free
+            tables, lengths = self.cache.block_tables_array(
+                [r.id for r in active], pad_batch=pad_b)
+            base_lens = lengths[:b].astype(np.int64)
+        else:
+            tables = None
+            lengths = None
+            base_lens = np.array(
+                [self.cache.length(r.id) for r in active], np.int64)
         for i, req in enumerate(active):
-            ids[i] = req.generated[-1]
-            positions[i] = self.cache.length(req.id)
+            ids[i, 0] = req.generated[-1]
+            d = self._draft_tokens(req) if s_w > 1 else []
+            if d:
+                ids[i, 1:1 + len(d)] = d
+            drafts.append(d)
+        positions[:b] = base_lens[:, None] + np.arange(s_w)
         compute = telemetry.compute
         if not self._flops_declared:
             # per-token FLOPs vary with context; declared once for the
@@ -680,39 +810,60 @@ class InferenceEngine:
             telemetry.declare_dtype(self.cfg.dtype)
             self._flops_declared = True
         telemetry.step_begin()
-        with compute.phase("gather"):
-            k, v, lengths = self.cache.gather(
-                [r.id for r in active], pad_batch=pad_b)
-            k, v = self.cache.shard_gathered(k, v)
-        t_dev = time.perf_counter()
-        logits, k_new, v_new = self._decode(
-            self.params, ids, positions, k, v, lengths, self.cfg)
+        if self._use_paged:
+            # fast path: NO dense gather, NO re-placement copy — the
+            # program reads the device-resident pools in place through
+            # the block tables (a [B, W] int32 array is all that ships)
+            k_pool, v_pool = self.cache.device_pools()
+            ctx_depth = tables.shape[1] * self.cache.block_size
+            t_dev = time.perf_counter()
+            logits, k_pool, v_pool, k_new, v_new = self._decode(
+                self.params, ids, positions, k_pool, v_pool, tables,
+                lengths, self.cfg)
+            self.cache.adopt_device_pools(k_pool, v_pool)
+        else:
+            with compute.phase("gather"):
+                k, v, lengths = self.cache.gather(
+                    [r.id for r in active], pad_batch=pad_b)
+                k, v = self.cache.shard_gathered(k, v)
+            ctx_depth = int(k.shape[2])
+            t_dev = time.perf_counter()
+            if s_w > 1:
+                logits, k_new, v_new = self._decode(
+                    self.params, ids, positions, k, v, lengths, self.cfg)
+            else:
+                logits, k_new, v_new = self._decode(
+                    self.params, ids[:, 0], positions[:, 0], k, v,
+                    lengths, self.cfg)
         logits = np.asarray(logits)
         k_new = np.asarray(k_new)
         v_new = np.asarray(v_new)
+        if logits.ndim == 2:  # single-token gather program: [B, V]
+            logits = logits[:, None]
+            k_new = k_new[:, :, None]
+            v_new = v_new[:, :, None]
         dev_s = time.perf_counter() - t_dev
-        flops = float(sum(
-            tfm.decode_flops_per_token(self.cfg, int(lengths[i]) + 1)
-            for i in range(b)))
+        # executed FLOPs: every window position runs the full forward
+        # whether or not its token commits (verify is the price of
+        # speculation; MFU is accounted on work actually executed).
+        # Context depths repeat heavily across rows and steps, so the
+        # per-token figure is memoized (engine-thread-confined cache)
+        fpt_at = self._fpt_cache
+        flops = 0.0
+        for i in range(b):
+            base = int(base_lens[i])
+            for s in range(s_w):
+                c = base + s + 1
+                f = fpt_at.get(c)
+                if f is None:
+                    f = fpt_at[c] = tfm.decode_flops_per_token(self.cfg, c)
+                flops += f
         if compute.enabled():
             # the fused decode executable's internal split is not host
             # observable; apportion its wall time by the model's exact
-            # per-phase FLOP breakdown at the gathered context depth
+            # per-phase FLOP breakdown at the batch's context depth
             compute.phase_estimate(
-                tfm.decode_phase_flops(self.cfg, int(k.shape[2])), dev_s)
-        stats_fn = getattr(self._decode, "stats", None)
-        cost = stats_fn() if stats_fn else None
-        telemetry.step_end(
-            tokens=float(b), flops=flops,
-            bytes_accessed=(cost["last_cost"] or {}).get("bytes_accessed")
-            if cost else None)
-        telemetry.inc("serving", "decode_steps")
-        telemetry.observe("serving", "decode_batch", b)
-        if cost:
-            telemetry.set_gauge("serving", "decode_signatures",
-                                cost["signatures"])
-        if compute.enabled():
-            compute.sample_hbm()
+                tfm.decode_phase_flops(self.cfg, ctx_depth), dev_s)
         # per-sequence numeric health: a non-finite logit row (NaN/Inf
         # from a poisoned cache page or an overflowed activation) would
         # serve garbage silently.  Checking only the sampled position is
@@ -721,31 +872,117 @@ class InferenceEngine:
         # keeps the guard O(1) per row instead of O(vocab) on the decode
         # hot path.  Fail exactly that request with a clear error; the
         # rest of the batch (and the engine) keep serving.
+        #
+        # Longest-accepted-prefix commit walk: window position s emits
+        # argmax(logits[s]); the walk continues past s only while the
+        # drafted token MATCHES that argmax, so the committed output is
+        # bit-identical to single-token greedy decoding — speculation
+        # can change only how many tokens land per step, never which.
         n_tokens = 0
+        n_proposed = 0
+        n_accepted = 0
         with compute.phase("sampling"):
+            # one vectorized argmax + finiteness probe over the whole
+            # [B, S_w] window: the walk below touches only python ints
+            # (per-position np.argmax calls were a measurable slice of
+            # the step wall at batch 8 × window 8)
+            amax = np.argmax(logits[:b], axis=2)
+            fin = np.isfinite(
+                np.take_along_axis(logits[:b], amax[:, :, None],
+                                   axis=2))[:, :, 0]
+            outcomes = []
             for i, req in enumerate(active):
-                next_id = int(np.argmax(logits[i]))
-                if not np.isfinite(logits[i, next_id]):
-                    telemetry.inc("serving", "nonfinite_failures")
-                    logger.error("request %d produced non-finite logits "
-                                 "at decode position %d", req.id,
-                                 int(lengths[i]))
-                    self._finish(req, error="non-finite logits during "
-                                 "decode (numeric corruption); retry the "
-                                 "request", reason="nonfinite")
-                    continue
-                self.cache.append(req.id, k_new[:, i], v_new[:, i])
-                req.generated.append(next_id)
-                n_tokens += 1
-                telemetry.inc("serving", "tokens_generated")
-                self.requests.on_token(req.id)
-                if req.is_finished_by(next_id):
-                    self._finish(req)
+                draft = drafts[i]
+                n_proposed += len(draft)
+                n_row = 0
+                fail = False
+                done = False
+                for s in range(1 + len(draft)):
+                    if not fin[i, s]:
+                        telemetry.inc("serving", "nonfinite_failures")
+                        logger.error(
+                            "request %d produced non-finite logits at "
+                            "decode position %d", req.id,
+                            int(base_lens[i]) + s)
+                        fail = True
+                        break
+                    next_id = int(amax[i, s])
+                    req.generated.append(next_id)
+                    n_row += 1
+                    if req.is_finished_by(next_id):
+                        done = True
+                        break
+                    if s < len(draft) and draft[s] == next_id:
+                        n_accepted += 1
+                        continue
+                    break
+                outcomes.append((req, i, n_row, fail, done))
+                n_tokens += n_row
+            # ONE batched host-mirror write covering every row's
+            # committed prefix (contiguous by construction): the
+            # per-row write calls were dominated by lock/GIL
+            # crossings, not bytes moved.  Must land before any
+            # _finish below — finishing frees blocks.
+            self.cache.write_many(
+                [(req.id, k_new[:, i, :n_row], v_new[:, i, :n_row])
+                 for req, i, n_row, _, _ in outcomes if n_row],
+                device_synced=self._use_paged)
+            for req, i, n_row, fail, done in outcomes:
+                if n_row:
+                    self.requests.on_token(req.id, n=n_row)
+        stats_fn = getattr(self._decode, "stats", None)
+        cost = stats_fn() if stats_fn else None
+        telemetry.step_end(
+            tokens=float(n_tokens), flops=flops,
+            bytes_accessed=(cost["last_cost"] or {}).get("bytes_accessed")
+            if cost else None,
+            tokens_per_step=n_tokens / b if b else None,
+            spec_accept_rate=(n_accepted / n_proposed
+                              if n_proposed else None))
+        # completion delivery happens AFTER step_end: waking a blocked
+        # handler thread (and everything it does with the core next) is
+        # response streaming, not decode work — the step ledger's wall
+        # must cover the device program + the commit, nothing else
+        for req, _i, _n, fail, done in outcomes:
+            if fail:
+                self._finish(
+                    req, error="non-finite logits during decode "
+                    "(numeric corruption); retry the request",
+                    reason="nonfinite")
+            elif done:
+                self._finish(req)
+        if n_tokens:
+            telemetry.inc("serving", "tokens_generated", n_tokens)
+        telemetry.inc("serving", "decode_steps")
+        telemetry.observe("serving", "decode_batch", b)
+        telemetry.set_gauge("serving", "paged_active",
+                            1.0 if self._use_paged else 0.0)
+        if self._use_paged:
+            telemetry.inc("serving", "paged_decode_steps")
+        if s_w > 1:
+            telemetry.inc("serving", "spec_proposed", n_proposed)
+            telemetry.inc("serving", "spec_accepted", n_accepted)
+            if n_proposed:
+                telemetry.set_gauge("serving", "spec_accept_rate",
+                                    100.0 * n_accepted / n_proposed)
+            telemetry.observe("serving", "spec_tokens_per_step",
+                              n_tokens / b)
+        if cost:
+            telemetry.set_gauge("serving", "decode_signatures",
+                                cost["signatures"])
+        # HBM peak tracking needs only periodic samples; on a ~1 ms
+        # fast-path decode step the per-step device memory-stats query
+        # was a measurable tax, so sample every 8th iteration (and the
+        # first, so short runs still record a peak)
+        self._hbm_tick += 1
+        if compute.enabled() and (self._hbm_tick - 1) % 8 == 0:
+            compute.sample_hbm()
         # the decode ledger's per-iteration record: batch composition +
         # admission queue depth + KV pressure — the /requests load
         # signal a router/autoscaler consumes — then a throttled SLO
         # burn-rate evaluation on fresh evidence.  tokens counts what
-        # actually landed (a nonfinite-guarded row produced none)
+        # actually landed (a nonfinite-guarded row produced none; an
+        # accepted draft lands several)
         self.requests.on_iteration(
             active=b, waiting=self.scheduler.n_waiting,
             preempted=n_preempted, tokens=n_tokens,

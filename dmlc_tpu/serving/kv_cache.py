@@ -13,10 +13,16 @@ Pool layout (layer-major, mirroring the paged-attention kernel shapes):
 
     k_pool / v_pool : [n_layers, n_blocks, block_size, n_heads, head_dim]
 
-The model never sees pages: :meth:`PagedKVCache.gather` materializes a
-dense padded ``[L, B, T, H, D]`` view for a decode batch (whole blocks
-are copied; slots past a sequence's length carry garbage the attention
-mask ignores), and :meth:`shard_gathered` places that view over a
+Two decode data paths share this bookkeeping.  The paged fast path
+(``DMLC_SERVE_PAGED_ATTN``) keeps device-resident pool twins
+(:meth:`device_pools` / :meth:`adopt_device_pools`) and ships only the
+tiny int32 :meth:`block_tables_array` per step — the model attends the
+pool in place (ops/paged_attention) and no dense view is ever built.
+The gather path remains the oracle twin and the sharded-mesh route:
+:meth:`PagedKVCache.gather` materializes a dense padded
+``[L, B, T, H, D]`` view for a decode batch (whole blocks are copied;
+slots past a sequence's length carry garbage the attention mask
+ignores), and :meth:`shard_gathered` places that view over a
 ``parallel.mesh`` — batch over ``dp``, heads over ``tp`` — so the
 decode matmuls run sharded under jit.  Prefill attention goes through
 the model layer's existing dispatch (Pallas flash on TPU, the
@@ -154,6 +160,30 @@ class PagedKVCache:
         self.k_pool = np.zeros(shape, dtype)
         # dmlc-check: unguarded(data plane is single-step-thread by contract — class docstring)
         self.v_pool = np.zeros(shape, dtype)
+        # device twins of the pools for the paged-attention fast path:
+        # lazily created, kept in sync block-granularly — host writes
+        # (prefill) mark their blocks dirty and device_pools() uploads
+        # just those; decode-step scatter happens IN the jitted program,
+        # whose updated pools the engine hands back via
+        # adopt_device_pools (the host mirror gets the same tokens
+        # through append_from_device, which skips the dirty mark)
+        # dmlc-check: unguarded(data plane is single-step-thread by contract — class docstring)
+        self._dev_k = None
+        # dmlc-check: unguarded(data plane is single-step-thread by contract — class docstring)
+        self._dev_v = None
+        # dmlc-check: unguarded(data plane is single-step-thread by contract — class docstring)
+        self._dirty_blocks: set = set()
+        # dmlc-check: unguarded(data plane is single-step-thread by contract — class docstring)
+        self._upload_jit = None
+        # block-table memo: the tables themselves change only when some
+        # sequence gains or loses blocks (every ~block_size committed
+        # tokens), not every decode step — the version counter lets
+        # block_tables_array reuse the previous [B, W] array instead of
+        # rebuilding it per step (a measurable slice of a ~1 ms step)
+        # dmlc-check: unguarded(data plane is single-step-thread by contract — class docstring)
+        self._tables_version = 0
+        # dmlc-check: unguarded(data plane is single-step-thread by contract — class docstring)
+        self._tables_cache: Optional[tuple] = None
         self._alloc = BlockAllocator(self.n_blocks)
         self._seqs: Dict[int, _SeqEntry] = {}
         # running Σ length over live sequences: occupancy/waste gauges
@@ -200,6 +230,7 @@ class PagedKVCache:
             ent = _SeqEntry()
             ent.blocks = got
             self._seqs[seq_id] = ent
+            self._tables_version += 1
         self._publish_usage()
         return True
 
@@ -216,6 +247,37 @@ class PagedKVCache:
                 telemetry.inc("serving", "kv_alloc_failures")
                 return False
             ent.blocks.extend(got)
+            self._tables_version += 1
+        self._publish_usage()
+        return True
+
+    def extend_many(self, seq_ids: Sequence[int],
+                    n_tokens: int = 1) -> bool:
+        """Reserve ``n_tokens`` more per sequence for a whole decode
+        batch under ONE lock acquisition — all or nothing.  False means
+        the free list cannot cover the batch and NO state changed; the
+        caller falls back to the per-sequence extend + evict loop.  The
+        common steady-state case (every row already has block headroom)
+        touches no allocator state at all."""
+        with self._lock:
+            ents = [self._seq(s) for s in seq_ids]
+            needs = [self.blocks_for(e.length + n_tokens) - len(e.blocks)
+                     for e in ents]
+            total = sum(n for n in needs if n > 0)
+            if total == 0:
+                return True
+            if total > self._alloc.n_free:
+                return False
+            grew = False
+            for ent, need in zip(ents, needs):
+                if need <= 0:
+                    continue
+                got = self._alloc.alloc_many(need)
+                assert got is not None  # guarded by the total check
+                ent.blocks.extend(got)
+                grew = True
+            if grew:
+                self._tables_version += 1
         self._publish_usage()
         return True
 
@@ -229,6 +291,7 @@ class PagedKVCache:
                 return
             self._cached_tokens -= ent.length
             self._alloc.free(ent.blocks)
+            self._tables_version += 1
         self._publish_usage()
 
     def length(self, seq_id: int) -> int:
@@ -250,12 +313,16 @@ class PagedKVCache:
         return ent
 
     # ---- data plane -----------------------------------------------------
-    def write(self, seq_id: int, k, v, start: Optional[int] = None) -> None:
+    def write(self, seq_id: int, k, v, start: Optional[int] = None, *,
+              device_synced: bool = False) -> None:
         """Write ``k/v [L, T, H, D]`` at token offset ``start`` (default:
         the current length — append semantics).  Capacity must already
         be reserved (allocate/extend); writing past it raises rather
         than silently growing, keeping the eviction policy in the
-        scheduler where it belongs."""
+        scheduler where it belongs.  ``device_synced`` marks a write
+        whose bytes the device pools ALREADY hold (a decode-step
+        scatter adopted via :meth:`adopt_device_pools`) — it updates
+        the host mirror without dirtying the blocks for re-upload."""
         k = np.asarray(k)
         v = np.asarray(v)
         t = k.shape[1]
@@ -273,6 +340,7 @@ class PagedKVCache:
             ent.length = new_len
         bs = self.block_size
         off = 0
+        touched = set()
         while off < t:
             p = pos + off
             blk = blocks[p // bs]
@@ -280,12 +348,184 @@ class PagedKVCache:
             n = min(bs - slot, t - off)
             self.k_pool[:, blk, slot:slot + n] = k[:, off:off + n]
             self.v_pool[:, blk, slot:slot + n] = v[:, off:off + n]
+            if not device_synced:
+                touched.add(blk)
             off += n
+        if touched:
+            if self._dev_k is not None:
+                # write-through: upload NOW, once per prefill/resume,
+                # so the decode hot loop never pays an upload — before
+                # this, every decode step following a prefill re-synced
+                # dirty blocks and the eager scatter dispatch was ~half
+                # the decode step wall on small models
+                self._upload_blocks(touched)
+            else:
+                self._dirty_blocks.update(touched)
+
+    def write_many(self, updates, *, device_synced: bool = False) -> None:
+        """Batched :meth:`write`: ``updates`` is ``[(seq_id, k, v), ...]``
+        with each ``k/v [L, T, H, D]`` appended at that sequence's
+        current length.
+
+        One lock acquisition covers the whole batch.  The per-row
+        ``write`` calls on the decode commit path were dominated not by
+        bytes moved but by lock/GIL handoffs — with a pool of HTTP
+        handler threads live, every release is a chance to lose the GIL
+        for a scheduler quantum, and the commit walk made one such
+        crossing per row per step."""
+        if not updates:
+            return
+        plans = []
+        with self._lock:
+            for seq_id, k, v in updates:
+                k = np.asarray(k)
+                v = np.asarray(v)
+                t = k.shape[1]
+                ent = self._seq(seq_id)
+                pos = ent.length
+                end = pos + t
+                if self.blocks_for(end) > len(ent.blocks):
+                    raise DMLCError(
+                        f"write past reservation: seq {seq_id} end={end} "
+                        f"blocks={len(ent.blocks)}×{self.block_size}")
+                self._cached_tokens += end - ent.length
+                ent.length = end
+                plans.append((list(ent.blocks), pos, t, k, v))
+        bs = self.block_size
+        touched = set()
+        for blocks, pos, t, k, v in plans:
+            off = 0
+            while off < t:
+                p = pos + off
+                blk = blocks[p // bs]
+                slot = p % bs
+                n = min(bs - slot, t - off)
+                self.k_pool[:, blk, slot:slot + n] = k[:, off:off + n]
+                self.v_pool[:, blk, slot:slot + n] = v[:, off:off + n]
+                if not device_synced:
+                    touched.add(blk)
+                off += n
+        if touched:
+            if self._dev_k is not None:
+                self._upload_blocks(touched)
+            else:
+                self._dirty_blocks.update(touched)
 
     def append(self, seq_id: int, k, v) -> None:
         """Append ONE token's ``k/v [L, H, D]`` (the per-decode-step
         write path)."""
         self.write(seq_id, np.asarray(k)[:, None], np.asarray(v)[:, None])
+
+    def append_from_device(self, seq_id: int, k, v) -> None:
+        """Append ONE token's ``k/v [L, H, D]`` that the device pools
+        already hold (the paged decode program scattered it in place):
+        host-mirror bookkeeping only, no dirty mark, no re-upload."""
+        self.write(seq_id, np.asarray(k)[:, None], np.asarray(v)[:, None],
+                   device_synced=True)
+
+    # ---- device twins (paged-attention fast path) ----------------------
+    def _upload_blocks(self, blocks) -> None:
+        """Block-granular host→device sync of ``blocks`` into the
+        existing device twins.
+
+        Runs through a jitted scatter (eager ``.at[].set`` dispatch cost
+        roughly tripled prefill wall on small models).  The block count
+        is padded to the next power of two by REPEATING the first
+        (index, data) pair — duplicate scatter indices carrying
+        identical values are deterministic — so the jit sees a handful
+        of shapes total instead of one per count."""
+        import jax
+
+        if self._upload_jit is None:
+            self._upload_jit = jax.jit(
+                lambda pool, idx, data: pool.at[:, idx].set(data))
+        idx = np.asarray(sorted(blocks), np.int32)
+        n = len(idx)
+        padded = 1
+        while padded < n:
+            padded *= 2
+        if padded > n:
+            idx = np.concatenate([idx, np.full(padded - n, idx[0],
+                                               np.int32)])
+        k_blk = self.k_pool[:, idx]
+        v_blk = self.v_pool[:, idx]
+        self._dev_k = self._upload_jit(self._dev_k, idx, k_blk)
+        self._dev_v = self._upload_jit(self._dev_v, idx, v_blk)
+
+    def device_pools(self):
+        """The device-resident ``(k_pool, v_pool)`` twins.  First call
+        uploads the whole pool once and flips :meth:`write` into
+        write-through mode (each prefill/resume uploads its own blocks
+        as it lands); any blocks dirtied BEFORE that first call are
+        drained here.  Steady-state decode therefore pays no upload at
+        all — the program's in-place scatter keeps the device copy
+        freshest and :meth:`adopt_device_pools` installs it."""
+        import jax.numpy as jnp
+
+        if self._dev_k is None:
+            self._dev_k = jnp.asarray(self.k_pool)
+            self._dev_v = jnp.asarray(self.v_pool)
+            self._dirty_blocks.clear()
+        elif self._dirty_blocks:
+            self._upload_blocks(self._dirty_blocks)
+            self._dirty_blocks.clear()
+        return self._dev_k, self._dev_v
+
+    def adopt_device_pools(self, k_pool, v_pool) -> None:
+        """Install the pools a paged decode program returned (its
+        in-program scatter made them the freshest copy)."""
+        self._dev_k = k_pool
+        self._dev_v = v_pool
+
+    def block_tables_array(self, seq_ids: Sequence[int], *,
+                           pad_width: Optional[int] = None,
+                           pad_batch: Optional[int] = None
+                           ) -> Tuple[np.ndarray, np.ndarray]:
+        """Per-sequence block tables as one dense int32 array — the
+        small indirection the paged-attention kernel ships to the
+        device INSTEAD of a gathered cache.
+
+        Returns ``(tables [B, W], lengths [B])``; ``W`` = ``pad_width``
+        or the max owned-block count (min 1), ``B`` = ``pad_batch`` or
+        ``len(seq_ids)``.  Rows are padded with block 0 — the attention
+        mask keeps padded entries unreachable (positions past
+        ``lengths``), and dead rows carry length 0.  Like gather's
+        ``pad_len``, an insufficient explicit ``pad_width`` is loud.
+
+        The tables array is memoized on (seq_ids, padding, allocator
+        version): block OWNERSHIP changes only every ~block_size
+        committed tokens, so most decode steps get the previous array
+        back verbatim (callers must treat it as read-only — the engine
+        only ever ships it into jit).  Lengths change every step and
+        are always rebuilt."""
+        key = (tuple(seq_ids), pad_width, pad_batch)
+        with self._lock:
+            cached = self._tables_cache
+            if cached is not None and cached[0] == key \
+                    and cached[1] == self._tables_version:
+                ents = [self._seq(s) for s in seq_ids]
+                lengths = np.zeros(cached[2].shape[0], np.int32)
+                lengths[:len(ents)] = [e.length for e in ents]
+                return cached[2], lengths
+            version = self._tables_version
+            ents = [self._seq(s) for s in seq_ids]
+            tables = [list(e.blocks) for e in ents]
+            lens = [e.length for e in ents]
+        w = max((len(t) for t in tables), default=0) or 1
+        if pad_width is not None:
+            if pad_width < w:
+                raise ValueError(f"pad_width {pad_width} < required {w}")
+            w = pad_width
+        b = max(pad_batch or 0, len(seq_ids))
+        out = np.zeros((b, w), np.int32)
+        lengths = np.zeros(b, np.int32)
+        for i, (t, n) in enumerate(zip(tables, lens)):
+            out[i, :len(t)] = t
+            lengths[i] = n
+        with self._lock:
+            if version == self._tables_version:
+                self._tables_cache = (key, version, out)
+        return out, lengths
 
     def gather(self, seq_ids: Sequence[int], *, pad_len: Optional[int] = None,
                pad_batch: Optional[int] = None

@@ -328,13 +328,23 @@ class LoadGenerator:
         return self.fetch_json("/healthz")
 
     def emit_bench(self, path: str, summary: Dict,
-                   extra: Optional[Dict] = None) -> Dict:
+                   extra: Optional[Dict] = None,
+                   recompiles_baseline: Optional[int] = None) -> Dict:
         """Join the client summary with the server-side views — the
         decode step ledger (/healthz) and the request ledger
         (/requests: queue-wait/TBT percentiles, preemption rate, KV
         occupancy) — and write the one-line BENCH_serving.json
         artifact: the before/after surface serving optimisations are
-        judged on."""
+        judged on.
+
+        ``recompiles_baseline`` is the compile-ledger watermark taken
+        at the END of the harness warmup: with it the artifact splits
+        ``recompiles_warmup`` (expected, bucket-sweeping compiles) from
+        ``recompiles_steady`` (compiles DURING the measured window —
+        the number the steady-state gate pins to zero).  Without it the
+        artifact only carries the lifetime total, which conflates the
+        two and historically let warmup compiles masquerade as
+        steady-state churn."""
         ledger = self.healthz().get("ledger", {}) or {}
         doc = dict(summary)
         doc["decode_mfu"] = ledger.get("mfu")
@@ -343,6 +353,11 @@ class LoadGenerator:
         doc["decode_goodput_tokens_per_s"] = ledger.get(
             "goodput_tokens_per_s")
         doc["decode_steps"] = ledger.get("steps")
+        # decode fast-path keys (PR 19): committed tokens per batch row
+        # per step (> 1 only with speculative decoding) and the draft
+        # acceptance rate that explains it
+        doc["decode_tokens_per_step"] = ledger.get("tokens_per_step")
+        doc["spec_accept_rate"] = ledger.get("spec_accept_rate")
         reqs = self._fetch_optional("/requests").get("summary", {}) or {}
         doc["queue_wait_p50_s"] = reqs.get("queue_wait_p50_s")
         doc["queue_wait_p99_s"] = reqs.get("queue_wait_p99_s")
@@ -367,6 +382,11 @@ class LoadGenerator:
                                if ledger.get("bound") is not None
                                else roof.get("bound"))
         doc["recompiles"] = comp.get("recompiles_total")
+        if recompiles_baseline is not None and \
+                doc["recompiles"] is not None:
+            doc["recompiles_warmup"] = recompiles_baseline
+            doc["recompiles_steady"] = (doc["recompiles"]
+                                        - recompiles_baseline)
         doc["hbm_peak_bytes"] = (comp.get("hbm", {}) or {}).get(
             "peak_bytes")
         if extra:
@@ -375,3 +395,40 @@ class LoadGenerator:
             json.dump(doc, f)
             f.write("\n")
         return doc
+
+
+def _cli(argv: Optional[List[str]] = None) -> int:
+    """``python -m dmlc_tpu.serving.loadgen --url http://host:port ...``
+
+    Drives the closed-loop streams from a DEDICATED process and prints
+    the run summary as one JSON line.  Measurement methodology: an
+    in-process client contends with the engine for the GIL and the
+    cores, so every client thread's scheduling quantum lands in the
+    server's decode-step tail — the measured phase of a bench must
+    drive load from outside the server process (this entrypoint), the
+    way a real load test drives from outside the server box."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="dmlc_tpu.serving.loadgen")
+    p.add_argument("--url", required=True)
+    p.add_argument("--streams", type=int, default=8)
+    p.add_argument("--requests-per-stream", type=int, default=4)
+    p.add_argument("--prompt-len", type=int, nargs=2, default=(8, 24),
+                   metavar=("MIN", "MAX"))
+    p.add_argument("--max-tokens", type=int, default=16)
+    p.add_argument("--vocab", type=int, default=128)
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+    gen = LoadGenerator(
+        args.url, n_streams=args.streams,
+        requests_per_stream=args.requests_per_stream,
+        prompt_len=tuple(args.prompt_len), max_tokens=args.max_tokens,
+        vocab=args.vocab, seed=args.seed)
+    summary = gen.run()
+    summary["failures"] = gen.failures[:5]
+    print(json.dumps(summary))
+    return 0 if not gen.failures else 1
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess
+    raise SystemExit(_cli())

@@ -282,7 +282,14 @@ class ServingHTTPServer:
             def log_message(self, fmt, *args):
                 logger.debug("serving http: " + fmt, *args)
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        class _Server(ThreadingHTTPServer):
+            # a burst of simultaneous connects (an offline-mode load
+            # test submitting its whole request set at once) overflows
+            # the 5-entry default listen backlog and the kernel RSTs
+            # the overflow; size it to the admission queue instead
+            request_queue_size = 128
+
+        self._httpd = _Server((host, port), Handler)
         self._httpd.daemon_threads = True
         self.host = host
         self.port = self._httpd.server_address[1]

@@ -81,8 +81,34 @@ _lock = make_lock("compute._lock")
 _sites: Dict[str, "_ProfiledJit"] = {}
 
 
+# str(dtype) dominated the per-call signature cost on large pytrees
+# (hundreds of leaves × numpy dtype __str__ every dispatch); dtypes are
+# a tiny closed set, so memoize the conversion.  The canonicalizing
+# variant mirrors what jit traces on (x64 demotion: int64 and float32
+# numpy inputs land on the same executable, so they must land on the
+# same signature)
+_dtype_strs: Dict = {}
+_canon_dtype_strs: Dict = {}
+
+
+def _dtype_str(dt) -> str:
+    s = _dtype_strs.get(dt)
+    if s is None:
+        s = _dtype_strs[dt] = str(dt)
+    return s
+
+
+def _canon_dtype_str(dt) -> str:
+    s = _canon_dtype_strs.get(dt)
+    if s is None:
+        from jax import dtypes as _jdt
+
+        s = _canon_dtype_strs[dt] = str(_jdt.canonicalize_dtype(dt))
+    return s
+
+
 def _leaf_sig(av) -> Tuple:
-    return (tuple(av.shape), str(av.dtype),
+    return (tuple(av.shape), _dtype_str(av.dtype),
             bool(getattr(av, "weak_type", False)))
 
 
@@ -139,6 +165,15 @@ class _ProfiledJit:
         self.last_cost: Optional[Dict] = None
         self.last_signature: Optional[str] = None
         self._trace_times: deque = deque(maxlen=256)
+        # identity-keyed memo for REPEATED pytree arguments: serving
+        # passes the same params dict every call, and hashing its ~30
+        # leaves per step is pure dispatch tax.  Keyed on id() with a
+        # strong ref pinning the object (so the id cannot be reused),
+        # bounded, and only for container args (an ndarray can be
+        # mutated in place, a params pytree's leaf STRUCTURE cannot
+        # change shape without being a new tree in practice)
+        # dmlc-check: unguarded(benign race: GIL-atomic dict ops; strong ref defeats id reuse)
+        self._arg_sig_memo: Dict[int, Tuple[Any, Any]] = {}
         with _lock:
             _sites[self.site] = self
 
@@ -151,12 +186,37 @@ class _ProfiledJit:
         for i, a in enumerate(args):
             if i in self._static:
                 parts.append(("static", a))
+            elif isinstance(a, dict):
+                memo = self._arg_sig_memo.get(id(a))
+                if memo is not None and memo[0] is a:
+                    parts.append(memo[1])
+                    continue
+                part = self._tree_sig(a)
+                if len(self._arg_sig_memo) < 64:
+                    self._arg_sig_memo[id(a)] = (a, part)
+                parts.append(part)
             else:
-                leaves, treedef = jax.tree_util.tree_flatten(a)
-                parts.append((treedef, tuple(
-                    _leaf_sig(shaped_abstractify(leaf))
-                    for leaf in leaves)))
+                parts.append(self._tree_sig(a))
         return tuple(parts)
+
+    def _tree_sig(self, a):
+        import jax
+        from jax.api_util import shaped_abstractify
+
+        leaves, treedef = jax.tree_util.tree_flatten(a)
+        sigs = []
+        for leaf in leaves:
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is not None and dtype is not None:
+                # array-like fast path: shape/dtype/weak_type read
+                # straight off the leaf — the hot-loop dispatch cost,
+                # paid per leaf per call
+                sigs.append((tuple(shape), _canon_dtype_str(dtype),
+                             bool(getattr(leaf, "weak_type", False))))
+            else:  # scalars etc: canonicalize like jit does
+                sigs.append(_leaf_sig(shaped_abstractify(leaf)))
+        return (treedef, tuple(sigs))
 
     # -- compile (cache miss) -------------------------------------------
     def _compile(self, key, args):

@@ -205,6 +205,14 @@ METRIC_NAMES = frozenset({
     # and the decode jit-signature population)
     "dmlc_serving_prompt_bucket_new",
     "dmlc_serving_decode_signatures",
+    # decode fast path — paged attention (pool read in place, no dense
+    # gather) and speculative decoding (n-gram drafts, exact verify)
+    "dmlc_serving_paged_active",
+    "dmlc_serving_paged_decode_steps",
+    "dmlc_serving_spec_proposed",
+    "dmlc_serving_spec_accepted",
+    "dmlc_serving_spec_accept_rate",
+    "dmlc_serving_spec_tokens_per_step",
     # fleet router (serving/router.py): dispatch/retry/hedge/failover
     # counters, fleet health gauges, routed latency/TTFT, per-status
     # edge counters, and the hand-rendered per-replica labeled families
@@ -285,6 +293,10 @@ METRIC_NAMES = frozenset({
     "dmlc_step_memory_bound",
     "dmlc_step_mfu_pct",
     "dmlc_step_time_secs",
+    # decode fast path: committed tokens per batch row and the
+    # speculative-decoding draft acceptance (telemetry.steps)
+    "dmlc_step_tokens_per_step",
+    "dmlc_step_spec_accept_rate_pct",
     # telemetry self-accounting
     "dmlc_telemetry_beats_truncated",
     # tracker surface (hand-rendered families)

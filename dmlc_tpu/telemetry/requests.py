@@ -243,11 +243,17 @@ class RequestLedger:
                   args={"resume": 1, "tokens":
                         st.n_prompt + max(st.n_generated - 1, 0)})
 
-    def on_token(self, req_id: int, t: Optional[float] = None) -> None:
-        """One decode token landed.  The gap since the previous token
-        is recorded as TBT — across a preemption episode that gap spans
-        evict + requeue + re-prefill, which is exactly the stall a
-        streaming user experiences, so it is deliberately NOT excluded."""
+    def on_token(self, req_id: int, t: Optional[float] = None,
+                 n: int = 1) -> None:
+        """``n`` decode tokens landed at one instant (a speculative
+        commit delivers its whole accepted prefix in one burst; plain
+        decode passes n=1).  The gap since the previous burst is
+        recorded ONCE as TBT — that gap is the stall a streaming user
+        actually sees between deliveries, and across a preemption
+        episode it spans evict + requeue + re-prefill, which is exactly
+        why it is deliberately NOT excluded.  Zero-length intra-burst
+        gaps are not observed: they would drag the TBT percentiles
+        toward 0 without any user-visible latency behind them."""
         t = time.perf_counter() if t is None else t
         gap = None
         with self._lock:
@@ -263,7 +269,7 @@ class RequestLedger:
             st.last_token_t = t
             if st.decode_t0 is None:
                 st.decode_t0 = t
-            st.n_generated += 1
+            st.n_generated += n
             st.state = "active"
         if gap is not None:
             core.observe_duration("serving", "tbt", gap)

@@ -324,14 +324,21 @@ class StepLedger:
     def step_end(self, tokens: Optional[float] = None,
                  flops: Optional[float] = None,
                  bytes_fed: Optional[float] = None,
-                 bytes_accessed: Optional[float] = None
+                 bytes_accessed: Optional[float] = None,
+                 tokens_per_step: Optional[float] = None,
+                 spec_accept_rate: Optional[float] = None
                  ) -> Optional[StepRecord]:
         """Close the open step and append its record; returns it (None
         when no step was open).  ``tokens``/``flops``/``bytes_fed``
         default to declared-FLOPs × tokens and the feed-counter delta.
         ``bytes_accessed`` (the step executable's XLA cost-analysis
         figure, telemetry.compute) adds the bandwidth half of the
-        roofline: ``membw_util`` and the ``bound`` verdict."""
+        roofline: ``membw_util`` and the ``bound`` verdict.
+        ``tokens_per_step`` (committed tokens per batch row — > 1 only
+        when speculative decoding lands drafts) and
+        ``spec_accept_rate`` (accepted / proposed drafts in [0, 1])
+        make the decode fast path's multiplier a first-class ledger
+        figure."""
         opened = self._open
         if opened is None:
             return None
@@ -446,6 +453,11 @@ class StepLedger:
                 mfu=mfu,
                 membw_util=membw_util,
                 bound=bound,
+                tokens_per_step=(float(tokens_per_step)
+                                 if tokens_per_step is not None else None),
+                spec_accept_rate=(float(spec_accept_rate)
+                                  if spec_accept_rate is not None
+                                  else None),
             )
             self._records.append(rec)
         self._publish(rec)
@@ -474,6 +486,12 @@ class StepLedger:
         if rec.get("bound") is not None:
             core.set_gauge("step", "memory_bound",
                            1.0 if rec["bound"] == "memory" else 0.0)
+        if rec.get("tokens_per_step") is not None:
+            core.set_gauge("step", "tokens_per_step",
+                           rec["tokens_per_step"])
+        if rec.get("spec_accept_rate") is not None:
+            core.set_gauge("step", "spec_accept_rate_pct",
+                           100.0 * rec["spec_accept_rate"])
 
     # ---- views ----------------------------------------------------------
     def records(self) -> List[StepRecord]:
@@ -525,13 +543,39 @@ class StepLedger:
             out["goodput_tokens_per_s"] = (
                 sum(r["tokens"] for r in toks)
                 / max(sum(r["wall_s"] for r in toks), 1e-9))
-        mfus = [r["mfu"] for r in recs if r["mfu"] is not None]
-        out["mfu"] = sum(mfus) / len(mfus) if mfus else None
-        mbs = [r["membw_util"] for r in recs
-               if r.get("membw_util") is not None]
-        out["membw_util"] = sum(mbs) / len(mbs) if mbs else None
+        # window MFU / bandwidth utilization: work-weighted aggregates,
+        # Σwork / (Σwall × peak) — the standard whole-window definition.
+        # A plain mean of per-step ratios over-weights ramp/drain steps
+        # that pay fixed dispatch overhead while carrying little work.
+        fl = [r for r in recs
+              if r.get("flops") and r.get("mfu") is not None]
+        peak = self.peak_flops()
+        if fl and peak:
+            out["mfu"] = (sum(r["flops"] for r in fl)
+                          / max(sum(r["wall_s"] for r in fl), 1e-9)
+                          / peak)
+        else:
+            mfus = [r["mfu"] for r in recs if r["mfu"] is not None]
+            out["mfu"] = sum(mfus) / len(mfus) if mfus else None
+        by = [r for r in recs if r.get("bytes_accessed")
+              and r.get("membw_util") is not None]
+        peak_bw = self.peak_membw()
+        if by and peak_bw:
+            out["membw_util"] = (
+                sum(r["bytes_accessed"] for r in by)
+                / max(sum(r["wall_s"] for r in by), 1e-9) / peak_bw)
+        else:
+            mbs = [r["membw_util"] for r in recs
+                   if r.get("membw_util") is not None]
+            out["membw_util"] = sum(mbs) / len(mbs) if mbs else None
         out["bound"] = next((r["bound"] for r in reversed(recs)
                              if r.get("bound") is not None), None)
+        tps = [r["tokens_per_step"] for r in recs
+               if r.get("tokens_per_step") is not None]
+        out["tokens_per_step"] = sum(tps) / len(tps) if tps else None
+        acc = [r["spec_accept_rate"] for r in recs
+               if r.get("spec_accept_rate") is not None]
+        out["spec_accept_rate"] = sum(acc) / len(acc) if acc else None
         return out
 
     def roofline_summary(self) -> Dict:
@@ -564,6 +608,13 @@ class StepLedger:
             self._seq = 0
             self._flops_per_token = None
             self._open = None
+            # drop RESOLVED-but-not-DECLARED peaks: a reset means a new
+            # measurement context (tests repin DMLC_PEAK_* between
+            # runs), and detection is cheap to redo — only an explicit
+            # declare_peak_flops outlives a reset
+            if not self._peak_declared:
+                self._peak_resolved = False
+            self._peak_bw_resolved = False
 
 
 # ---------------------------------------------------------------------------
@@ -583,11 +634,15 @@ def step_begin() -> None:
 
 def step_end(tokens: Optional[float] = None, flops: Optional[float] = None,
              bytes_fed: Optional[float] = None,
-             bytes_accessed: Optional[float] = None
+             bytes_accessed: Optional[float] = None,
+             tokens_per_step: Optional[float] = None,
+             spec_accept_rate: Optional[float] = None
              ) -> Optional[StepRecord]:
     return _default.step_end(tokens=tokens, flops=flops,
                              bytes_fed=bytes_fed,
-                             bytes_accessed=bytes_accessed)
+                             bytes_accessed=bytes_accessed,
+                             tokens_per_step=tokens_per_step,
+                             spec_accept_rate=spec_accept_rate)
 
 
 def declare_flops_per_token(flops: float) -> None:

@@ -1,0 +1,342 @@
+"""Decode fast path: paged-vs-gather parity, spec-decode bit-parity.
+
+The op-level matrix checks the paged attention op against the model's
+dense gather-path window attention on identical cache state — the
+1e-5 logits-parity contract, swept where a length matrix is cheapest.
+The engine-level tests pin the end-to-end contract instead: greedy
+outputs bit-identical with the fast path on, off, and with speculative
+decoding enabled, each through a forced preemption episode (the
+resume path is where a paged/spec bookkeeping bug would corrupt
+output).  The cache tests guard the host-mirror twins the fast path
+leans on: freed blocks' bytes never reach a live gather row, and the
+batched commit write is byte-equivalent to the per-row writes it
+replaced.
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dmlc_tpu import telemetry
+from dmlc_tpu.base import DMLCError
+from dmlc_tpu.ops.paged_attention import paged_attention, supports
+from dmlc_tpu.serving import (InferenceEngine, PagedKVCache, Request,
+                              ServingHTTPServer)
+
+
+# ---------------------------------------------------------------------------
+# op-level parity matrix: paged vs gather window attention
+# ---------------------------------------------------------------------------
+
+def _rand(rng, *shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+def _parity_case(rng, *, n_blocks, bs, w, h, d, s_w, lengths):
+    """Build one batch of paged state plus its dense gather-path view.
+
+    Returns ``(paged_out, dense_out)`` for the same queries: the paged
+    op attends the scattered pool through block tables; the dense path
+    is the model's ``_cached_window_attention`` over the gathered view
+    with the window riding as a concatenated tail (exactly how the
+    gather decode program sees it)."""
+    from dmlc_tpu.models.transformer import _cached_window_attention
+
+    b = len(lengths)
+    lengths = np.asarray(lengths, np.int32)
+    span = w * bs
+    k_pool = _rand(rng, n_blocks, bs, h, d)
+    v_pool = _rand(rng, n_blocks, bs, h, d)
+    # disjoint physical blocks per row (sequences never share blocks),
+    # deliberately non-contiguous within each row
+    assert n_blocks >= b * w
+    tables = rng.permutation(n_blocks)[:b * w].reshape(b, w).astype(np.int32)
+    q = _rand(rng, b, s_w, h, d)
+    k_new = _rand(rng, b, s_w, h, d)
+    v_new = _rand(rng, b, s_w, h, d)
+    # paged path: scatter-then-attend at each row's real paged address
+    kp, vp = k_pool.copy(), v_pool.copy()
+    for i in range(b):
+        for s in range(s_w):
+            p = int(lengths[i]) + s
+            kp[tables[i, p // bs], p % bs] = k_new[i, s]
+            vp[tables[i, p // bs], p % bs] = v_new[i, s]
+    paged = np.asarray(paged_attention(q, kp, vp, tables, lengths,
+                                       impl="lax"))
+    # gather path: the PRE-scatter pool is the cache (positions >=
+    # length are garbage the mask hides), window as explicit tail
+    k_cache = k_pool[tables].reshape(b, span, h, d)
+    v_cache = v_pool[tables].reshape(b, span, h, d)
+    dense = np.asarray(_cached_window_attention(q, k_new, v_new,
+                                                k_cache, v_cache, lengths))
+    return paged, dense
+
+
+@pytest.mark.parametrize("s_w", [1, 3])
+def test_paged_vs_gather_parity_matrix(s_w):
+    """Single-block, boundary-straddling, and max-length rows in one
+    batch: the paged op matches the gather-path oracle to 1e-5."""
+    bs, w = 4, 4
+    span = w * bs
+    lengths = [1, bs - 1, bs, bs + 1, 2 * bs + 1, span - s_w]
+    paged, dense = _parity_case(np.random.default_rng(0), n_blocks=24,
+                                bs=bs, w=w, h=2, d=8, s_w=s_w,
+                                lengths=lengths)
+    np.testing.assert_allclose(paged, dense, rtol=1e-5, atol=1e-5)
+
+
+def test_paged_attention_pallas_interpret_parity():
+    """The Pallas kernel (interpret mode on CPU) agrees with the lax
+    fallback on supported shapes — same matrix of lengths."""
+    bs, w, d = 8, 3, 128
+    assert supports(d, bs)
+    lengths = [1, bs, bs + 1, w * bs - 1]
+    rng = np.random.default_rng(1)
+    n_blocks, h, s_w = 6, 1, 1
+    k_pool = _rand(rng, n_blocks, bs, h, d)
+    v_pool = _rand(rng, n_blocks, bs, h, d)
+    tables = np.stack([rng.permutation(n_blocks)[:w]
+                       for _ in lengths]).astype(np.int32)
+    q = _rand(rng, len(lengths), s_w, h, d)
+    lens = np.asarray(lengths, np.int32)
+    ref = np.asarray(paged_attention(q, k_pool, v_pool, tables, lens,
+                                     impl="lax"))
+    got = np.asarray(paged_attention(q, k_pool, v_pool, tables, lens,
+                                     impl="pallas", interpret=True))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_paged_attention_rejects_unknown_impl():
+    z = np.zeros((1, 1, 1, 8), np.float32)
+    pool = np.zeros((2, 4, 1, 8), np.float32)
+    with pytest.raises(ValueError):
+        paged_attention(z, pool, pool, np.zeros((1, 2), np.int32),
+                        np.zeros((1,), np.int32), impl="cuda")
+
+
+# ---------------------------------------------------------------------------
+# host-mirror hardening: freed bytes, batched writes
+# ---------------------------------------------------------------------------
+
+def _kv(rng, n, *, layers=2, heads=2, dim=3):
+    shape = (layers, n, heads, dim)
+    return (rng.standard_normal(shape).astype(np.float32),
+            rng.standard_normal(shape).astype(np.float32))
+
+
+def test_gather_never_reads_freed_blocks_bytes():
+    """Property: under interleaved alloc/free churn, a live row's valid
+    prefix never contains a freed block's bytes.  Every free block is
+    poisoned with a sentinel each iteration; any table/gather indexing
+    bug that routed a live row through a freed block would surface it."""
+    sent = np.float32(12345.0)
+    cache = PagedKVCache(2, 2, 3, n_blocks=12, block_size=4)
+    rng = np.random.default_rng(11)
+    live, sid = {}, 0
+    for _ in range(60):
+        if live and (len(live) >= 4 or rng.random() < 0.5):
+            victim = int(rng.choice(sorted(live)))
+            cache.free(victim)
+            del live[victim]
+        else:
+            sid += 1
+            n = int(rng.integers(1, 13))
+            if cache.allocate(sid, n):
+                k, v = _kv(rng, n)
+                cache.write(sid, k, v)
+                live[sid] = (n, k, v)
+        used = set()
+        for s in live:
+            used.update(cache.block_table(s))
+        for blk in set(range(12)) - used:
+            cache.k_pool[:, blk] = sent
+            cache.v_pool[:, blk] = sent
+        if not live:
+            continue
+        ids = sorted(live)
+        pad_len = -(-max(live[s][0] for s in ids) // 4) * 4
+        gk, gv, lens = cache.gather(ids, pad_batch=len(ids) + 2,
+                                    pad_len=pad_len)
+        for row, s in enumerate(ids):
+            n, k, v = live[s]
+            assert lens[row] == n
+            np.testing.assert_array_equal(gk[:, row, :n], k)
+            np.testing.assert_array_equal(gv[:, row, :n], v)
+        # dead pad rows are zero-filled, never a freed block's bytes
+        assert not gk[:, len(ids):].any()
+        assert not gv[:, len(ids):].any()
+
+
+def test_write_many_matches_per_row_writes():
+    """The batched commit write (one lock for the whole batch) is
+    byte- and bookkeeping-equivalent to per-row appends, including a
+    window that straddles a block boundary."""
+    a = PagedKVCache(2, 2, 3, n_blocks=8, block_size=4)
+    b = PagedKVCache(2, 2, 3, n_blocks=8, block_size=4)
+    rng = np.random.default_rng(5)
+    prefixes = {1: 3, 2: 5}           # 3+2 straddles a block boundary
+    windows = {1: 2, 2: 3}
+    init = {s: _kv(np.random.default_rng(s), n)
+            for s, n in prefixes.items()}
+    for cache in (a, b):
+        for s, n in prefixes.items():
+            assert cache.allocate(s, n + windows[s])
+            cache.write(s, *init[s])
+    upd = {s: _kv(rng, n) for s, n in windows.items()}
+    for s in prefixes:
+        a.write(s, *upd[s])           # append semantics (start=None)
+    b.write_many([(s, k, v) for s, (k, v) in upd.items()])
+    np.testing.assert_array_equal(a.k_pool, b.k_pool)
+    np.testing.assert_array_equal(a.v_pool, b.v_pool)
+    for s, n in prefixes.items():
+        assert a.length(s) == b.length(s) == n + windows[s]
+    assert a.stats() == b.stats()
+    # empty batch is a no-op; over-reservation still raises
+    b.write_many([])
+    k_big, v_big = _kv(rng, 32)
+    with pytest.raises(DMLCError):
+        b.write_many([(1, k_big, v_big)])
+
+
+# ---------------------------------------------------------------------------
+# engine-level bit-parity (real jitted compute, tiny config)
+# ---------------------------------------------------------------------------
+
+def _tiny_model():
+    import jax
+
+    from dmlc_tpu.models import transformer as tfm
+
+    cfg = tfm.TransformerConfig(vocab=64, d_model=32, n_heads=2, head_dim=8,
+                                d_ff=64, n_layers=2, n_experts=1,
+                                microbatches=1)
+    return tfm.init_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+def _greedy_oracle(params, cfg, prompt, n):
+    from dmlc_tpu.models import transformer as tfm
+
+    ctx = list(prompt)
+    for _ in range(n):
+        lg, _, _ = tfm.forward_prefill(
+            params, np.array([ctx], np.int32), cfg)
+        ctx.append(int(np.argmax(np.asarray(lg[0, -1]))))
+    return ctx[len(prompt):]
+
+
+def _run_requests(params, cfg, *, n_blocks=6, max_new=10):
+    """3 requests through a pool too small for them to coexist: forces
+    preemption + recompute-resume.  Returns their outputs."""
+    eng = InferenceEngine(params, cfg, n_blocks=n_blocks, block_size=4,
+                          max_active=3, queue_depth=8)
+    eng.start()
+    try:
+        reqs = [eng.submit([i + 1] * 4, max_new_tokens=max_new)
+                for i in range(3)]
+        for r in reqs:
+            assert r.wait(300), f"request {r.id} never finished"
+            assert r.error is None
+            assert r.n_generated == max_new
+        return [list(r.generated) for r in reqs]
+    finally:
+        eng.close()
+
+
+def test_paged_on_off_bit_identical_through_preemption(monkeypatch):
+    """DMLC_SERVE_PAGED_ATTN=on vs =off produce bit-identical greedy
+    output across a preemption episode, and both match the no-cache
+    oracle — the fast path is output-invisible end to end."""
+    params, cfg = _tiny_model()
+    before = telemetry.snapshot()["counters"].get(
+        "serving", {}).get("preemptions", 0)
+    outs = {}
+    for mode in ("on", "off"):
+        monkeypatch.setenv("DMLC_SERVE_PAGED_ATTN", mode)
+        outs[mode] = _run_requests(params, cfg)
+    after = telemetry.snapshot()["counters"]["serving"]["preemptions"]
+    assert after > before, "tiny pool must have forced preemption"
+    assert outs["on"] == outs["off"]
+    for i in range(3):
+        assert outs["on"][i] == _greedy_oracle(params, cfg, [i + 1] * 4, 10)
+
+
+def test_spec_decode_bit_parity_through_preemption(monkeypatch):
+    """Speculative decoding (k=3) through the same preemption-forcing
+    pool: greedy output stays bit-identical to the oracle, and the
+    drafter actually proposed (the accept walk, not drafter silence,
+    is what kept the output exact)."""
+    params, cfg = _tiny_model()
+    monkeypatch.setenv("DMLC_SERVE_SPEC_K", "3")
+    monkeypatch.setenv("DMLC_SERVE_SPEC_MIN_CTX", "4")
+    snap = telemetry.snapshot()["counters"].get("serving", {})
+    before_prop = snap.get("spec_proposed", 0)
+    before_pre = snap.get("preemptions", 0)
+    outs = _run_requests(params, cfg, max_new=12)
+    counters = telemetry.snapshot()["counters"]["serving"]
+    assert counters.get("spec_proposed", 0) > before_prop, \
+        "drafter never proposed — the spec path was not exercised"
+    assert counters["preemptions"] > before_pre
+    for i in range(3):
+        assert outs[i] == _greedy_oracle(params, cfg, [i + 1] * 4, 12)
+
+
+def test_ngram_drafter_proposes_from_own_context(monkeypatch):
+    monkeypatch.setenv("DMLC_SERVE_SPEC_K", "3")
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=8, block_size=4,
+                          max_active=2, queue_depth=4)
+    try:
+        # rightmost fully-in-prefix occurrence of suffix [3,1,2] is at
+        # offset 2, so the drafter replays what followed it
+        assert eng._draft_tokens(
+            Request([1, 2, 3, 1, 2, 3, 1, 2], 4)) == [3, 1, 2]
+        # below DMLC_SERVE_SPEC_MIN_CTX (default 4): no proposal
+        assert eng._draft_tokens(Request([1, 2], 4)) == []
+        # no recurring suffix anywhere: no proposal
+        assert eng._draft_tokens(Request([1, 2, 3, 4, 5, 6, 7], 4)) == []
+    finally:
+        eng.close()
+
+
+def test_fast_path_metric_families_registered():
+    from dmlc_tpu.telemetry.metric_names import METRIC_NAMES
+
+    for fam in ("dmlc_serving_paged_active",
+                "dmlc_serving_paged_decode_steps",
+                "dmlc_serving_spec_proposed",
+                "dmlc_serving_spec_accepted",
+                "dmlc_serving_spec_accept_rate",
+                "dmlc_serving_spec_tokens_per_step",
+                "dmlc_step_spec_accept_rate_pct"):
+        assert fam in METRIC_NAMES, f"{fam} missing from metric registry"
+
+
+# ---------------------------------------------------------------------------
+# loadgen CLI (the out-of-process bench driver)
+# ---------------------------------------------------------------------------
+
+def test_loadgen_cli_drives_server_and_emits_summary(capsys):
+    from dmlc_tpu.serving.loadgen import _cli
+
+    params, cfg = _tiny_model()
+    eng = InferenceEngine(params, cfg, n_blocks=32, block_size=4,
+                          max_active=2, queue_depth=8)
+    eng.start()
+    srv = ServingHTTPServer(eng, port=0)
+    try:
+        rc = _cli(["--url", srv.url, "--streams", "2",
+                   "--requests-per-stream", "1", "--prompt-len", "2", "4",
+                   "--max-tokens", "3", "--vocab", str(cfg.vocab)])
+        assert rc == 0
+        doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert doc["n_requests_ok"] == 2 and doc["n_requests_failed"] == 0
+        assert doc["failures"] == []
+        # the server really served them
+        reqs = json.loads(urllib.request.urlopen(
+            srv.url + "/requests", timeout=30).read())
+        assert reqs["summary"]["requests_done"] >= 2
+    finally:
+        srv.close()
+        eng.close()

@@ -1076,13 +1076,15 @@ def test_engine_fails_only_nonfinite_logit_request():
     real = eng._decode
     fired = []
 
-    def poisoned(p, ids, positions, k, v, lengths, c):
-        lg, kn, vn = real(p, ids, positions, k, v, lengths, c)
-        lg = np.asarray(lg).copy()
+    def poisoned(*a):
+        # signature-agnostic: works for both the gather decode program
+        # (7 args, 3 outputs) and the paged one (8 args, 5 outputs)
+        out = real(*a)
+        lg = np.asarray(out[0]).copy()
         if not fired:
-            lg[0, :] = np.nan  # r1's row (activation order)
+            lg[0] = np.nan  # r1's row (activation order)
             fired.append(True)
-        return lg, kn, vn
+        return (lg,) + tuple(out[1:])
 
     eng._decode = poisoned
     before = telemetry.counters_snapshot().get("serving", {}).get(
