@@ -300,6 +300,28 @@ def _ledger_keys(summary):
     return out
 
 
+def _goodput_keys(g0, g1):
+    """Goodput-ledger delta over the benched window → artifact keys:
+    the job-level wall-clock decomposition (goodput_fraction + named
+    per-bucket badput seconds) for the same steps the step ledger
+    accounted, so a perf regression shows up as a *named* badput
+    bucket, not just a lower tokens/s."""
+    if not g0 or not g1:
+        return {}
+    wall = g1["wall_s"] - g0["wall_s"]
+    if wall <= 0:
+        return {}
+    buckets = {b: max(g1["buckets"].get(b, 0.0)
+                      - g0["buckets"].get(b, 0.0), 0.0)
+               for b in g1["buckets"]}
+    out = {"goodput_fraction":
+           round(buckets.get("productive", 0.0) / wall, 4)}
+    for b, s in sorted(buckets.items()):
+        if b != "productive" and s > 0.0005:
+            out[f"goodput_badput_{b}_s"] = round(s, 4)
+    return out
+
+
 def bench_step_ledger():
     """Ledger-derived step keys on ANY backend: a small synced train
     loop through the step ledger.  When the flagship TPU transformer
@@ -337,18 +359,24 @@ def bench_step_ledger():
     params, opt_state, loss = step(params, opt_state, ids, labels)
     float(loss)  # compile + settle outside the ledgered window
     telemetry.reset_steps()
+    from dmlc_tpu.telemetry import goodput as goodput_mod
+    gled = goodput_mod.ledger()  # opt in: step_end feeds the ledger
+    g0 = gled.status()
     flops = train_step_flops(cfg, B, T)
     for _ in range(n_steps):
         telemetry.step_begin()
         params, opt_state, loss = step(params, opt_state, ids, labels)
         float(loss)  # sync per step: walls are step times, not dispatch
         telemetry.step_end(tokens=B * T, flops=flops)
+    g1 = gled.status()
     summ = telemetry.ledger().summary()
     log(f"bench: step ledger p50={summ.get('step_time_p50', 0):.4f}s "
         f"p99={summ.get('step_time_p99', 0):.4f}s "
         f"goodput={summ.get('goodput_tokens_per_s', 0):,.0f} tok/s "
         f"mfu={summ.get('mfu')}")
-    return _ledger_keys(summ)
+    out = _ledger_keys(summ)
+    out.update(_goodput_keys(g0, g1))
+    return out
 
 
 def bench_feed_to_hbm():

@@ -368,6 +368,17 @@ KNOBS: Tuple[Knob, ...] = (
     _k("DMLC_TRACE_EXEMPLARS", int, 16,
        "exemplar trace ids retained per latency signal / SLO "
        "objective", ship=True, group="telemetry"),
+    _k("DMLC_GOODPUT_MIN_FRACTION", float, 0.5,
+       "watchdog effective-goodput collapse gate: flag a rank whose "
+       "windowed effective (wall-clock) tokens/s drops below this "
+       "fraction of its in-step tokens/s", ship=True, group="telemetry"),
+    _k("DMLC_GOODPUT_WINDOW_S", float, 60.0,
+       "goodput ledger window for the effective-vs-in-step tokens/s "
+       "comparison the collapse detector judges", ship=True,
+       group="telemetry"),
+    _k("DMLC_GOODPUT_MAX_INTERVALS", int, 64,
+       "closed badput intervals retained per rank for incident "
+       "forensics (GET /incidents)", ship=True, group="telemetry"),
 
     # ---- lock-order watchdog ------------------------------------------
     _k("DMLC_LOCKCHECK", bool, False,

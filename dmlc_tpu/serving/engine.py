@@ -249,6 +249,14 @@ class InferenceEngine:
         self.slo = (slo_monitor if slo_monitor is not None
                     else telemetry.slo.monitor())
         self.requests = telemetry.RequestLedger(slo=self.slo)
+        # availability ledger (telemetry.goodput): the serving twin of
+        # the training goodput ledger — serving / draining /
+        # crashed_recovering / starved_idle wall fractions + tokens
+        # served vs. capacity-tokens, surfaced via stats() → the router
+        # /fleet view and the /metrics dmlc_availability_* family.
+        # A replica is idle until its loop first does work.
+        self.availability = telemetry.AvailabilityLedger()
+        self.availability.set_state("starved_idle")
         # idempotency-key dedupe (router retry/hedge primitive) + the
         # per-request crash-requeue budget (requeue-on-crash keeps an
         # engine-iteration crash output-invisible, bounded so a
@@ -452,6 +460,7 @@ class InferenceEngine:
         already-queued) generations finish."""
         if not self._draining.is_set():
             self._draining.set()
+            self.availability.set_state("draining")
             telemetry.set_gauge("serving", "draining", 1)
             telemetry.record_event("serving_drain_begin",
                                    active=self.scheduler.n_active,
@@ -525,9 +534,11 @@ class InferenceEngine:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
+            crashed = False
             try:
                 did = self.step()
             except Exception as e:  # noqa: BLE001 - engine must not die
+                crashed = True
                 # a crashed decode leaves the ACTIVE set's cache state
                 # unknown — but the OUTPUT state is perfectly known
                 # (req.generated), and recompute-resume is free: each
@@ -552,6 +563,18 @@ class InferenceEngine:
                         pass
                 logger.error("serving iteration failed: %r", e)
                 did = False
+            # availability state for this iteration: draining wins
+            # (drain is still in progress even while work finishes),
+            # then crash recovery, then serving vs. starved-idle;
+            # set_state is a no-op when the state is unchanged
+            if self._draining.is_set():
+                self.availability.set_state("draining")
+            elif crashed:
+                self.availability.set_state("crashed_recovering")
+            elif did:
+                self.availability.set_state("serving")
+            else:
+                self.availability.set_state("starved_idle")
             if not did:
                 # idle: nothing waiting, nothing active — but the SLO
                 # windows keep aging, so evaluation must keep running
@@ -987,6 +1010,7 @@ class InferenceEngine:
             active=b, waiting=self.scheduler.n_waiting,
             preempted=n_preempted, tokens=n_tokens,
             kv_stats=self.cache.stats())
+        self.availability.note_tokens(n_tokens)
         self.slo.maybe_evaluate()
 
     # ---- observability --------------------------------------------------
@@ -1001,4 +1025,5 @@ class InferenceEngine:
             "ledger": telemetry.ledger().summary(),
             "requests": self.requests.summary(),
             "slo_active": self.slo.active(),
+            "availability": self.availability.report(),
         }

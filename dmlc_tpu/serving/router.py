@@ -131,7 +131,7 @@ class Replica:
     __slots__ = ("url", "state", "fail_streak", "next_probe_t",
                  "last_ok_t", "inflight", "queue_depth", "live",
                  "active", "waiting", "max_active", "dispatches",
-                 "failures", "last_error")
+                 "failures", "last_error", "availability")
 
     def __init__(self, url: str):
         self.url = url.rstrip("/")
@@ -148,6 +148,10 @@ class Replica:
         self.dispatches = 0
         self.failures = 0
         self.last_error: Optional[str] = None
+        # availability-ledger doc from the replica's last /healthz poll
+        # (serving/draining/crashed/starved fractions, tokens served
+        # vs. capacity) — the /fleet audit trail for scaling decisions
+        self.availability: Optional[Dict] = None
 
     def view(self) -> Dict:
         return {
@@ -158,6 +162,7 @@ class Replica:
             "dispatches": self.dispatches, "failures": self.failures,
             "fail_streak": self.fail_streak,
             "last_error": self.last_error,
+            "availability": self.availability,
         }
 
 
@@ -632,6 +637,9 @@ class Router:
             if qd is None:
                 qd = reqs.get("decode_queue_depth") or 0
             rep.queue_depth = int(qd)
+            av = doc.get("availability")
+            if isinstance(av, dict):
+                rep.availability = av
         if recovered:
             telemetry.inc("router", "probe_recoveries")
             telemetry.record_event("router_replica_up", replica=rep.url)
@@ -1140,6 +1148,11 @@ class RouterHTTPServer:
                        (``?since=N&limit=M`` incremental export —
                        autoscaler verdicts, preemption chains, tenant
                        rejections; always on)
+      GET  /incidents  incident forensics over the fleet plane:
+                       decision chains (preemption / scale episodes)
+                       joined with the event ring into postmortem
+                       timelines (``?limit=N``; always on — see
+                       telemetry.forensics)
       GET  /traces     per-trace summaries, slowest first (dmlc-top's
                        traces pane; ``DMLC_TRACE_FLEET=1``)
       GET  /trace      the merged fleet Chrome trace (router +
@@ -1212,12 +1225,39 @@ class RouterHTTPServer:
                                json.dumps(rt.replica_views()).encode())
                 elif path == "/fleet" and fleet_source is not None:
                     try:
-                        body = json.dumps(
-                            fleet_source().report()).encode()
+                        doc = fleet_source().report()
+                        # per-replica availability ledgers captured by
+                        # the health poller: the audit trail scaling
+                        # decisions are judged against (capacity-tokens
+                        # vs. tokens actually served)
+                        doc["replica_availability"] = {
+                            v["url"]: v.get("availability")
+                            for v in rt.replica_views()}
+                        body = json.dumps(doc).encode()
                     except Exception as e:  # noqa: BLE001 - no 500s
                         logger.warning("/fleet render failed: %r", e)
                         self._send(503, "text/plain",
                                    b"fleet render failed\n")
+                        return
+                    self._send(200, "application/json", body)
+                elif path == "/incidents":
+                    # fleet-plane forensics: preemption / scale decision
+                    # chains joined with the event ring (the router has
+                    # no goodput aggregator — the tracker's /incidents
+                    # adds the training-plane badput intervals)
+                    try:
+                        from ..telemetry.events import events as _events
+                        from ..telemetry.forensics import IncidentReporter
+                        rep = IncidentReporter(
+                            decisions_source=lambda:
+                                tracecontext.decision_log().tail(256),
+                            events_source=_events)
+                        body = json.dumps(rep.report(
+                            self._qs_int("limit", 32))).encode()
+                    except Exception as e:  # noqa: BLE001 - no 500s
+                        logger.warning("/incidents render failed: %r", e)
+                        self._send(503, "text/plain",
+                                   b"incidents render failed\n")
                         return
                     self._send(200, "application/json", body)
                 elif path == "/decisions":

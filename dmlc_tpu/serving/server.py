@@ -141,12 +141,18 @@ class ServingHTTPServer:
                 if path == "/metrics":
                     text = (telemetry.to_prometheus_text()
                             + eng.slo.prometheus_text()
-                            + telemetry.compute.prometheus_text())
+                            + telemetry.compute.prometheus_text()
+                            + eng.availability.prometheus_text())
                     self._send(200,
                                "text/plain; version=0.0.4; charset=utf-8",
                                text.encode())
                 elif path == "/healthz":
                     self._send_json(200, {"status": "ok", **eng.stats()})
+                elif path == "/goodput":
+                    # the serving twin of the training /goodput: this
+                    # replica's availability ledger (state fractions sum
+                    # to 1, tokens served vs. capacity-tokens)
+                    self._send_json(200, eng.availability.report())
                 elif path == "/compute":
                     self._send_json(200, telemetry.compute.report())
                 elif path == "/requests":

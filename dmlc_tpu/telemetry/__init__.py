@@ -43,6 +43,14 @@ counters, which remains as a compatible shim over this package):
                    decision audit log behind the router's /decisions,
                    and cross-process trace assembly (/trace,
                    /trace/<id>, /traces) behind DMLC_TRACE_FLEET=1
+  * ``goodput``    job-level goodput/badput ledger: the entire wall
+                   clock partitioned into productive vs. named badput
+                   buckets (startup/compile/feed/checkpoint/resize/
+                   rollback/preempted), cluster aggregation behind
+                   /goodput, and the serving availability twin
+  * ``forensics``  incident reports: badput episodes joined with the
+                   decision log, events and anomaly flags into
+                   postmortem timelines behind /incidents
   * ``metric_names`` the checked-in metric-name contract registry
                    (scripts/lint.py enforces it)
 
@@ -65,6 +73,8 @@ from . import (  # noqa: F401
     events,
     exporters,
     flight,
+    forensics,
+    goodput,
     heartbeat,
     metric_names,
     postmortem,
@@ -125,6 +135,16 @@ from .compute import (  # noqa: F401
     profiled_jit,
     reset_compute,
 )
+from .goodput import (  # noqa: F401
+    AvailabilityLedger,
+    GoodputAggregator,
+    GoodputLedger,
+    reset_goodput,
+)
+from .forensics import (  # noqa: F401
+    IncidentReporter,
+    build_incidents,
+)
 from .steps import (  # noqa: F401
     StepLedger,
     declare_dtype,
@@ -139,14 +159,18 @@ from .steps import (  # noqa: F401
 )
 
 __all__ = [
+    "AvailabilityLedger",
     "ClockOffsetEstimator",
     "DEFAULT_BOUNDS",
     "DEFAULT_STRAGGLER_KEYS",
     "DecisionLog",
     "FleetTraceStore",
     "FlightRecorder",
+    "GoodputAggregator",
+    "GoodputLedger",
     "Histogram",
     "HeartbeatSender",
+    "IncidentReporter",
     "RequestLedger",
     "SLOMonitor",
     "StepLedger",
@@ -155,6 +179,7 @@ __all__ = [
     "Watchdog",
     "anchor_epoch",
     "annotate",
+    "build_incidents",
     "counters_snapshot",
     "decision_log",
     "declare_dtype",
@@ -176,6 +201,7 @@ __all__ = [
     "reset",
     "reset_compute",
     "reset_events",
+    "reset_goodput",
     "reset_steps",
     "set_gauge",
     "snapshot",

@@ -44,7 +44,8 @@ from ..base import get_env
 from ..concurrency import make_lock
 from .slo import SLO_KINDS
 
-__all__ = ["Watchdog", "ANOMALY_KINDS", "COMPUTE_KINDS", "FLEET_KINDS"]
+__all__ = ["Watchdog", "ANOMALY_KINDS", "COMPUTE_KINDS", "FLEET_KINDS",
+           "GOODPUT_KINDS"]
 
 logger = logging.getLogger("dmlc_tpu.tracker")
 
@@ -61,6 +62,15 @@ COMPUTE_KINDS = ("recompile_storm",)
 # hysterized decision (scale-up wanted but no host/replica headroom),
 # so flags apply/clear directly — no consecutive-step gating
 FLEET_KINDS = ("fleet_saturated",)
+
+# goodput-ledger kinds ride the heartbeat ``goodput`` sub-doc
+# (telemetry.goodput.status): effective (wall-clock) tokens/s collapsing
+# below DMLC_GOODPUT_MIN_FRACTION of the in-step rate over the ledger's
+# window means the job is paying for badput, not compute — distinct from
+# the step-gated ``goodput_collapse`` rule, which only sees in-step
+# throughput and is blind to the time *between* steps.  Flags
+# apply/clear directly from each shipped window — no step gating.
+GOODPUT_KINDS = ("effective_goodput_collapse",)
 
 # per-rank recent-step window used for the cluster median/MAD view
 _RECENT = 32
@@ -85,7 +95,7 @@ class _RankState:
     __slots__ = ("recent", "steps", "ewma_fast", "ewma_slow",
                  "goodput_ewma", "goodput_peak", "feed_frac_ewma",
                  "last", "last_seq", "anchor", "consec", "active",
-                 "active_since", "remediation", "compute")
+                 "active_since", "remediation", "compute", "goodput")
 
     def __init__(self):
         self.recent: deque = deque(maxlen=_RECENT)
@@ -103,6 +113,7 @@ class _RankState:
         self.active_since: Dict[str, float] = {}
         self.remediation: Optional[Dict] = None  # shipped selfheal doc
         self.compute: Optional[Dict] = None      # shipped compute doc
+        self.goodput: Optional[Dict] = None      # shipped goodput window
 
 
 def _ewma(prev: Optional[float], x: float, alpha: float) -> float:
@@ -125,6 +136,7 @@ class Watchdog:
         self.regression_frac = get_env("DMLC_WATCHDOG_REGRESSION", 0.5)
         self.feed_frac = get_env("DMLC_WATCHDOG_FEED_FRAC", 0.5)
         self.goodput_frac = get_env("DMLC_WATCHDOG_GOODPUT_FRAC", 0.5)
+        self.goodput_min_fraction = get_env("DMLC_GOODPUT_MIN_FRACTION", 0.5)
         self._log = log
         self._lock = make_lock("Watchdog._lock")
         self._ranks: Dict[int, _RankState] = {}
@@ -150,6 +162,9 @@ class Watchdog:
             fleet = doc.get("fleet")
             if isinstance(fleet, dict):
                 self.ingest_fleet(rank, fleet)
+            gd = doc.get("goodput")
+            if isinstance(gd, dict):
+                self.ingest_goodput(rank, gd)
             trace = doc.get("trace")
             if not isinstance(trace, dict):
                 return
@@ -275,6 +290,49 @@ class Watchdog:
                               "controller-reported fleet saturation "
                               f"({why or 'scale-up wanted, no headroom'})"))
             elif not saturated and kind in st.active:
+                st.active.discard(kind)
+                st.active_since.pop(kind, None)
+                self._log.info("anomaly cleared: rank %d %s", rank, kind)
+        for kind, detail in fresh:
+            self._flag(rank, kind, detail, {}, step_gated=False)
+
+    def ingest_goodput(self, rank: int, doc: Dict) -> None:
+        """Mirror a rank's shipped goodput window (the heartbeat
+        ``goodput`` sub-doc from ``telemetry.goodput.status``) and flag
+        :data:`GOODPUT_KINDS` when effective (wall-clock) tokens/s over
+        the window collapses below ``DMLC_GOODPUT_MIN_FRACTION`` of the
+        in-step rate.  The ledger's window is the gate — no
+        consecutive-step counting here."""
+        if rank < 0 or not isinstance(doc, dict):
+            return
+        win = doc.get("window")
+        eff = in_step = None
+        if isinstance(win, dict):
+            eff = win.get("effective_tokens_per_s")
+            in_step = win.get("in_step_tokens_per_s")
+        collapsed = bool(
+            eff is not None and in_step
+            and eff < self.goodput_min_fraction * in_step)
+        fresh = []
+        with self._lock:
+            st = self._ranks.setdefault(rank, _RankState())
+            st.goodput = {
+                "goodput_fraction": doc.get("goodput_fraction"),
+                "effective_tokens_per_s": doc.get("effective_tokens_per_s"),
+                "in_step_tokens_per_s": doc.get("in_step_tokens_per_s"),
+                "current": doc.get("current"),
+                "window": win if isinstance(win, dict) else None,
+            }
+            kind = "effective_goodput_collapse"
+            if collapsed and kind not in st.active:
+                st.active.add(kind)
+                st.active_since[kind] = time.time()
+                fresh.append((kind,
+                              f"effective {eff:.1f} tok/s < "
+                              f"{self.goodput_min_fraction:.2f} x in-step "
+                              f"{in_step:.1f} tok/s over the goodput window "
+                              f"(current: {doc.get('current')})"))
+            elif not collapsed and kind in st.active:
                 st.active.discard(kind)
                 st.active_since.pop(kind, None)
                 self._log.info("anomaly cleared: rank %d %s", rank, kind)
@@ -459,6 +517,7 @@ class Watchdog:
                     "flags": sorted(st.active),
                     "remediation": st.remediation,
                     "compute": st.compute,
+                    "goodput": st.goodput,
                 }
                 for kind in sorted(st.active):
                     active.append({"rank": r, "kind": kind,
@@ -511,7 +570,7 @@ class Watchdog:
                      for r, st in sorted(self._ranks.items())]
         for r, kinds in items:
             for kind in (ANOMALY_KINDS + SLO_KINDS + COMPUTE_KINDS
-                         + FLEET_KINDS):
+                         + FLEET_KINDS + GOODPUT_KINDS):
                 val = 1 if kind in kinds else 0
                 lines.append(
                     f'dmlc_anomaly_active{{rank="{r}",kind="{kind}"}} '

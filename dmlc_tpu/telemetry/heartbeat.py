@@ -367,12 +367,17 @@ class TelemetryHTTPServer:
     ``compute_source`` (zero-arg callable returning a JSON-able dict,
     e.g. ``Watchdog.compute_report``) enables ``GET /compute``: the
     cluster view of the per-rank compile/roofline/HBM ledgers shipped
-    with heartbeats."""
+    with heartbeats.  ``goodput_source`` (zero-arg callable, e.g.
+    ``GoodputAggregator.report``) enables ``GET /goodput``: the cluster
+    wall-clock decomposition; ``incidents_source`` (zero-arg callable,
+    e.g. ``IncidentReporter.report``) enables ``GET /incidents``: the
+    forensics join of badput episodes with decisions/events/anomalies."""
 
     def __init__(self, aggregator: TelemetryAggregator,
                  host: str = "127.0.0.1", port: int = 0,
                  trace_source=None, anomaly_source=None,
-                 resize_handler=None, compute_source=None):
+                 resize_handler=None, compute_source=None,
+                 goodput_source=None, incidents_source=None):
         agg = aggregator
 
         class Handler(BaseHTTPRequestHandler):
@@ -417,6 +422,24 @@ class TelemetryHTTPServer:
                         logger.warning("/compute render failed: %r", e)
                         self._send(503, "text/plain",
                                    b"compute render failed\n")
+                        return
+                    self._send(200, "application/json", body)
+                elif path == "/goodput" and goodput_source is not None:
+                    try:
+                        body = json.dumps(goodput_source()).encode()
+                    except Exception as e:  # noqa: BLE001 - no 500s
+                        logger.warning("/goodput render failed: %r", e)
+                        self._send(503, "text/plain",
+                                   b"goodput render failed\n")
+                        return
+                    self._send(200, "application/json", body)
+                elif path == "/incidents" and incidents_source is not None:
+                    try:
+                        body = json.dumps(incidents_source()).encode()
+                    except Exception as e:  # noqa: BLE001 - no 500s
+                        logger.warning("/incidents render failed: %r", e)
+                        self._send(503, "text/plain",
+                                   b"incidents render failed\n")
                         return
                     self._send(200, "application/json", body)
                 else:
@@ -565,6 +588,16 @@ class HeartbeatSender:
         compute_doc = compute_mod.status()
         if compute_doc:
             doc["compute"] = compute_doc
+        # goodput ledger status (telemetry.goodput): the wall-clock
+        # decomposition, cumulative per-bucket seconds re-shipped fully
+        # every beat (self-correcting across drops/remaps), the recent
+        # badput intervals for forensics, and the windowed effective-vs-
+        # in-step rates the watchdog's collapse detector compares
+        from . import goodput as goodput_mod
+
+        goodput_doc = goodput_mod.status()
+        if goodput_doc:
+            doc["goodput"] = goodput_doc
         if self.ship_trace:
             doc["trace"] = self._trace_doc()
             payload = self._capped_payload(doc)

@@ -282,7 +282,25 @@ METRIC_NAMES = frozenset({
     "dmlc_slo_burn_rate",
     "dmlc_slo_violation_active",
     "dmlc_slo_objective_threshold",
+    # job-level goodput/badput ledger (telemetry.goodput): per-rank
+    # hand-rendered labeled families + cluster rollups on the tracker
+    "dmlc_goodput_bucket_seconds",
+    "dmlc_goodput_fraction",
+    "dmlc_goodput_effective_tokens_per_s",
+    "dmlc_goodput_cluster_fraction",
+    "dmlc_goodput_cluster_bucket_seconds",
+    "dmlc_goodput_cluster_effective_tokens_per_s",
+    # serving-replica availability ledger (telemetry.goodput
+    # AvailabilityLedger; hand-rendered on the serving /metrics)
+    "dmlc_availability_state_seconds",
+    "dmlc_availability_fraction",
+    "dmlc_availability_tokens_served_total",
+    "dmlc_availability_capacity_tokens",
+    # effective-goodput-collapse anomaly flag events (Watchdog._flag
+    # counter, fed by the goodput heartbeat sub-doc)
+    "dmlc_anomaly_effective_goodput_collapse_flags",
     # step ledger
+    "dmlc_step_checkpoint_stall_secs",
     "dmlc_step_collective_secs",
     "dmlc_step_collective_overlapped_secs",
     "dmlc_step_compute_secs",
@@ -330,6 +348,8 @@ NON_METRIC_TOKENS = frozenset({
     "dmlc_top",
     "dmlc_tracker",       # reference repo path tracker/dmlc_tracker/…
     "dmlc_anomaly",       # prose prefix for the dmlc_anomaly_* family
+    "dmlc_goodput",       # prose prefix for the dmlc_goodput_* family
+    "dmlc_availability",  # prose prefix for the dmlc_availability_* family
     "dmlc_compute",       # prose prefix for the dmlc_compute_* family
     "dmlc_elastic",       # prose prefix for the dmlc_elastic_* family
     "dmlc_integrity",     # prose prefix for the dmlc_integrity_* family

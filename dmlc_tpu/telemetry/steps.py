@@ -215,6 +215,8 @@ def _classify(rec: Dict) -> Optional[str]:
         return "feed"
     if cat == "collective" or name.startswith("collective."):
         return "collective"
+    if cat == "checkpoint" or name.startswith("checkpoint."):
+        return "checkpoint"
     if name == "pipeline.run":
         return "pipeline"
     return None
@@ -350,7 +352,8 @@ class StepLedger:
         new_spans, _ = core.spans_since(opened["cursor"])
         tid = opened["tid"]
         ts0, ts1 = opened["ts0"], core.now_ts()
-        buckets = {"feed": 0.0, "collective": 0.0, "pipeline": 0.0}
+        buckets = {"feed": 0.0, "collective": 0.0, "pipeline": 0.0,
+                   "checkpoint": 0.0}
         ivals = []
         own_ivals = []
         for rec in new_spans:
@@ -408,8 +411,12 @@ class StepLedger:
             if cur < hi:
                 overlapped += hi - cur
         feed_s = min(buckets["feed"], wall)
-        coll_s = min(buckets["collective"], wall - feed_s)
-        compute_s = max(wall - feed_s - coll_s, 0.0)
+        # same-thread checkpoint.save time inside the step is EXPOSED
+        # checkpoint stall — the ROADMAP item 4 before/after metric
+        # (async checkpointing's win is driving this to ~0)
+        ckpt_s = min(buckets["checkpoint"], wall - feed_s)
+        coll_s = min(buckets["collective"], wall - feed_s - ckpt_s)
+        compute_s = max(wall - feed_s - ckpt_s - coll_s, 0.0)
         overlapped_s = min(overlapped / 1e6, wall)
 
         if bytes_fed is None:
@@ -440,6 +447,7 @@ class StepLedger:
                 t_wall=time.time(),
                 wall_s=wall,
                 feed_wait_s=feed_s,
+                checkpoint_stall_s=ckpt_s,
                 collective_s=coll_s,
                 collective_overlapped_s=overlapped_s,
                 compute_s=compute_s,
@@ -470,6 +478,9 @@ class StepLedger:
         core.inc("step", "count")
         core.observe_duration("step", "time", rec["wall_s"])
         core.observe_duration("step", "feed_wait", rec["feed_wait_s"])
+        if rec.get("checkpoint_stall_s"):
+            core.observe_duration("step", "checkpoint_stall",
+                                  rec["checkpoint_stall_s"])
         core.observe_duration("step", "collective", rec["collective_s"])
         if rec.get("collective_overlapped_s"):
             core.observe_duration("step", "collective_overlapped",
@@ -492,6 +503,14 @@ class StepLedger:
         if rec.get("spec_accept_rate") is not None:
             core.set_gauge("step", "spec_accept_rate_pct",
                            100.0 * rec["spec_accept_rate"])
+        # feed the job-level goodput ledger (lazy: a no-op unless the
+        # process opted in by creating one; goodput never imports steps)
+        try:
+            from . import goodput as _goodput
+            _goodput.on_step(tokens=rec.get("tokens") or 0.0,
+                             step_s=rec["wall_s"])
+        except Exception:  # noqa: BLE001 - accounting must not fail steps
+            pass
 
     # ---- views ----------------------------------------------------------
     def records(self) -> List[StepRecord]:
@@ -532,6 +551,9 @@ class StepLedger:
             "step_time_p99": pct(99),
             "feed_wait_fraction": (sum(r["feed_wait_s"] for r in recs)
                                    / wall_total),
+            "checkpoint_stall_fraction": (
+                sum(r.get("checkpoint_stall_s", 0.0) for r in recs)
+                / wall_total),
             "collective_exposed_fraction": (
                 sum(r["collective_s"] for r in recs) / wall_total),
             "collective_overlapped_fraction": (

@@ -71,6 +71,8 @@ PASS_ENVS = [
     "DMLC_COMPUTE_PROFILE", "DMLC_COMPUTE_TRACE_PHASES",
     "DMLC_COMPUTE_STORM_WINDOW_S", "DMLC_COMPUTE_STORM_TRACES",
     "DMLC_TRACE_FLEET", "DMLC_TRACE_EXEMPLARS",
+    "DMLC_GOODPUT_MIN_FRACTION", "DMLC_GOODPUT_WINDOW_S",
+    "DMLC_GOODPUT_MAX_INTERVALS",
     "DMLC_LOCKCHECK",
     "DMLC_LOCKCHECK_BLOCK_S", "DMLC_RACECHECK",
     "DMLC_RACECHECK_MAX_SITES", "DMLC_FLASH_BH_BLOCK",

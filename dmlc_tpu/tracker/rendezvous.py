@@ -333,7 +333,25 @@ class RabitTracker:
         # same heartbeats; its dmlc_anomaly_active gauges join /metrics
         # and its verdicts mark the merged /trace timeline
         self.watchdog = Watchdog(log=logger)
-        self.telemetry.extra_text = self.watchdog.prometheus_text
+        # goodput aggregator: consumes the heartbeat ``goodput``
+        # sub-docs into the cluster wall-clock decomposition (/goodput,
+        # dmlc_goodput_* gauges); the forensics reporter joins its
+        # badput intervals with the decision log, the event ring and
+        # the watchdog's flags into /incidents
+        from ..telemetry import (GoodputAggregator, IncidentReporter,
+                                 forensics, tracecontext)
+        from ..telemetry.events import events as _events
+
+        self.goodput = GoodputAggregator()
+        self.incidents = IncidentReporter(
+            intervals_source=self.goodput.badput_intervals,
+            decisions_source=lambda: tracecontext.decision_log().tail(256),
+            events_source=lambda: _events(),
+            anomalies_source=lambda: forensics.watchdog_anomaly_records(
+                self.watchdog.report()))
+        self.telemetry.extra_text = lambda: (
+            self.watchdog.prometheus_text()
+            + self.goodput.prometheus_text())
         self.flight.marker_source = self.watchdog.trace_markers
         # dmlc-check: unguarded(built pre-start; closed by the control thread)
         self.metrics_server = None
@@ -348,10 +366,13 @@ class RabitTracker:
                 trace_source=self.flight.to_chrome_trace,
                 anomaly_source=self.watchdog.report,
                 resize_handler=self._http_resize,
-                compute_source=self.watchdog.compute_report)
+                compute_source=self.watchdog.compute_report,
+                goodput_source=self.goodput.report,
+                incidents_source=self.incidents.report)
             self.metrics_port = self.metrics_server.port
             logger.info("tracker /metrics + /trace + /anomalies + "
-                        "/compute on %s:%d", host_ip, self.metrics_port)
+                        "/compute + /goodput + /incidents on %s:%d",
+                        host_ip, self.metrics_port)
         logger.info("tracker listening on %s:%d", host_ip, self.port)
 
     def worker_envs(self) -> Dict[str, str]:
@@ -576,11 +597,16 @@ class RabitTracker:
         # too — else /trace renders a survivor's history under a pid a
         # different worker now owns (see FlightRecorder.remap_ranks)
         self.flight.remap_ranks(rank_map)
+        # goodput docs are cumulative and re-shipped fully every beat,
+        # so the remap is self-correcting — but moving them now keeps
+        # /goodput truthful between the renumbering and the next beat
+        self.goodput.remap_ranks(rank_map)
         for old, new in rank_map.items():
             if old != new:
                 self.watchdog.drop(old)
         for r in remove:
             self.watchdog.drop(r)
+            self.goodput.drop(r)
         telemetry.inc("elastic", "resizes_total")
         telemetry.inc("elastic", "shrinks_total"
                       if target < old_world else "grows_total")
@@ -701,6 +727,12 @@ class RabitTracker:
                         # compile-ledger status: feeds the watchdog's
                         # recompile_storm flag and the /compute view
                         self.watchdog.ingest_compute(w.rank, comp)
+                    gd = doc.get("goodput")
+                    if isinstance(gd, dict):
+                        # goodput decomposition: /goodput aggregation +
+                        # the watchdog's effective-goodput collapse gate
+                        self.goodput.ingest(w.rank, gd)
+                        self.watchdog.ingest_goodput(w.rank, gd)
                     trace = doc.get("trace")
                     if isinstance(trace, dict):
                         self.flight.ingest(w.rank, trace, host=w.host)
@@ -912,6 +944,10 @@ class RabitTracker:
         # the replacement's step baselines start over (fresh process,
         # fresh compile warmup); its anomaly history stays in the ring
         self.watchdog.drop(rank)
+        # goodput: the dead rank's wall keeps running as ``preempted``
+        # until a relaunched process reports under this rank (or the
+        # rank is evicted by a shrink, which drops it)
+        self.goodput.mark_dead(rank)
 
     def _monitor_loop(self) -> None:
         interval = max(0.1, min(1.0, self.miss_window_s / 4))

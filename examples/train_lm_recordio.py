@@ -372,6 +372,19 @@ def main():
     feed_iter = iter(feed)
     loss = float("nan")
     need_resync = False
+    # job-level goodput accounting (telemetry.goodput): explicit enter()
+    # hooks mark the intervals the span surfaces can't see — the elastic
+    # recovery window (WorldResized raise -> resync settled) and the
+    # self-heal rollback + replay.  The resize path must RE-ENTER the
+    # interval it was in before the raise (e.g. a feed wait), else the
+    # whole recovery leaks into idle/unattributed
+    from dmlc_tpu.telemetry import goodput as goodput_ledger
+
+    goodput_ledger.ledger()  # opt this process into goodput heartbeats
+    resize_active = False    # a resize episode is open
+    resize_prev = None       # override to restore when it settles
+    rollback_until = None    # replaying until done reaches this step
+    rollback_prev = None
     # done-value at the current stream's batch 0: the deterministic
     # feed means "replay to step A" = fast-forward (A - stream_base)
     # quality batches from a fresh stream.  Non-elastic streams always
@@ -463,6 +476,16 @@ def main():
                     # never-trained batches from the fresh stream
                     skip = 0
                     need_resync = False
+                    if resize_active:
+                        # generation settled: re-enter the pre-resize
+                        # interval.  A voided rollback replay does NOT
+                        # resume (skip was just reset) — its episode
+                        # ends with the resize
+                        if resize_prev == "rollback_replay":
+                            rollback_until = None
+                            resize_prev = rollback_prev
+                        goodput_ledger.enter(resize_prev)
+                        resize_active = False
                 trainer.client.check_resized()
             batch = next(feed_iter, None)
             if batch is None:
@@ -502,6 +525,12 @@ def main():
                 params, opt_state = prev_params, prev_opt
                 continue
             if action == "rollback":
+                # rollback_replay covers the restore AND the re-executed
+                # steps (work lost = steps redone x prior step time):
+                # the override stays up until `done` regains this step
+                if rollback_until is None:
+                    rollback_prev = goodput_ledger.enter("rollback_replay")
+                rollback_until = max(rollback_until or 0, done)
                 (params, opt_state, done, skip, base,
                  stream_base) = rollback_and_replay(
                     prev_params, prev_opt, done, base, stream_base)
@@ -514,10 +543,21 @@ def main():
             # recovery happens at the top of the next iteration (the
             # resync broadcast can itself hit another resize, and it
             # must run under this same handler)
+            prev = goodput_ledger.enter("resize")
+            if not resize_active:
+                # only the FIRST raise of an episode captures the
+                # pre-resize interval (a resize landing mid-resync
+                # re-raises here with the override already "resize")
+                resize_prev = prev
+                resize_active = True
             need_resync = True
             continue
         telemetry.step_end(tokens=int(ids.size))
         done += 1
+        if rollback_until is not None and done >= rollback_until:
+            # replay caught back up: the lost work is repaid
+            goodput_ledger.enter(rollback_prev)
+            rollback_until = None
         if done % 10 == 0 or done == 1:
             print(f"step {done}: loss {float(loss):.4f}", flush=True)
         if manager is not None and done % 20 == 0:

@@ -35,7 +35,10 @@ The full ISSUE 17 acceptance flow in one process tree:
      -> acquire -> kill rank -> shrink resize -> replica added ->
      scale_up — plus the restore chain and the tenant-governor 429s,
      with the ``since`` cursor honoring the incremental-export
-     contract.
+     contract;
+  9. incident forensics (``GET /incidents``) joins that chain into ONE
+     incident report naming every decision in the episode with a
+     wall-ordered timeline and a human-readable summary.
 
 Exit 0 on success, 1 with a diagnostic on any failure.
 """
@@ -658,6 +661,32 @@ def run(tracker, router, server, scaler, gov, workers, victim_proc,
     print(f"autoscale smoke: /decisions replayed the preemption chain "
           f"in causal order ({len(dec)} records, chain seqs "
           f"{[h['seq'] for h in hits]})", flush=True)
+
+    # --- phase 6: /incidents joins the episode into ONE report --------
+    inc_doc = json.loads(fetch(server.url + "/incidents"))
+    incidents = inc_doc.get("incidents") or []
+    if not incidents:
+        fail(f"/incidents empty after a preemption episode: {inc_doc}")
+    episode = None
+    for inc in incidents:
+        if set(chain) <= set(inc.get("decision_kinds") or ()):
+            episode = inc
+            break
+    if episode is None:
+        fail(f"no single incident names the whole preemption chain "
+             f"{chain}; got "
+             f"{[inc.get('decision_kinds') for inc in incidents]}")
+    if episode["t1"] < episode["t0"] or not episode.get("summary"):
+        fail(f"malformed incident report: {json.dumps(episode)[:400]}")
+    timeline_kinds = [r.get("kind") for r in episode.get("timeline", ())]
+    if [k for k in timeline_kinds if k in chain] == []:
+        fail(f"incident timeline lost the decision chain: "
+             f"{timeline_kinds}")
+    print(f"autoscale smoke: /incidents joined the preemption episode "
+          f"into one report ({episode['id']}, "
+          f"{len(episode['decision_kinds'])} decisions over "
+          f"{episode['duration_s']:.1f}s: {episode['summary']})",
+          flush=True)
 
 
 if __name__ == "__main__":

@@ -30,6 +30,13 @@ the step roofline (``mfu``/``membw_util``/``bound``), HBM peak and
 headroom, and the decode phase time shares; against a tracker the same
 pane shows per-rank recompile totals and storm-flagged ranks.
 
+A **goodput pane** (``/goodput`` + ``/incidents``) shows the job-level
+wall-clock decomposition against a tracker — goodput fraction,
+effective tokens/s, the largest badput buckets by name — and the
+newest incident forensics reports; against a serving replica the same
+endpoint feeds the availability ledger (state fractions, tokens vs.
+capacity).
+
 Pointed at a **router** with an autoscaler wired, ``/fleet`` feeds a
 fleet pane: replica count, aggregate utilization, the controller's
 hysteresis streaks / cooldown / last decision (with ``SATURATED``
@@ -57,7 +64,7 @@ import urllib.request
 
 __all__ = ["fetch", "render_table", "render_serving_pane",
            "render_compute_pane", "render_fleet_pane",
-           "render_traces_pane", "main"]
+           "render_traces_pane", "render_goodput_pane", "main"]
 
 COLUMNS = ("RANK", "STEP ms", "EWMA ms", "GOODPUT", "MFU%", "FEED%",
            "HB AGE", "FLAGS", "REMED")
@@ -90,7 +97,8 @@ def fetch(base_url: str, timeout: float = 5.0) -> dict:
     for key, path in (("anomalies", "/anomalies"), ("healthz", "/healthz"),
                       ("requests", "/requests"), ("slo", "/slo"),
                       ("compute", "/compute"), ("fleet", "/fleet"),
-                      ("traces", "/traces"), ("decisions", "/decisions")):
+                      ("traces", "/traces"), ("decisions", "/decisions"),
+                      ("goodput", "/goodput"), ("incidents", "/incidents")):
         try:
             with urllib.request.urlopen(base_url + path,
                                         timeout=timeout) as r:
@@ -282,6 +290,46 @@ def render_traces_pane(doc: dict, n: int = 5) -> list:
     return lines
 
 
+def render_goodput_pane(doc: dict) -> list:
+    """The goodput pane: against a tracker, the cluster wall-clock
+    decomposition from ``/goodput`` — goodput fraction, effective
+    tokens/s, and the largest badput buckets by name — plus the newest
+    incident reports from ``/incidents``.  Against a serving replica
+    the same endpoint serves the availability ledger: state fractions
+    (summing to 1) and tokens served vs. capacity."""
+    gp = doc.get("goodput") or {}
+    lines = []
+    cluster = gp.get("cluster") or {}
+    if cluster.get("wall_s"):
+        bad = sorted(
+            ((b, s) for b, s in (cluster.get("buckets") or {}).items()
+             if b != "productive" and s >= 0.05),
+            key=lambda kv: -kv[1])
+        lines.append(
+            "goodput  {:.0f}% productive over {:.0f}s wall  eff={} tok/s"
+            "  badput: {}".format(
+                (cluster.get("goodput_fraction") or 0.0) * 100,
+                cluster["wall_s"],
+                _num(cluster.get("effective_tokens_per_s"), "{:,.0f}"),
+                "  ".join(f"{b}={s:.1f}s" for b, s in bad[:5]) or "none"))
+    elif gp.get("states"):  # serving replica: availability ledger
+        fr = gp.get("fractions") or {}
+        lines.append(
+            "avail    {:.0f}% serving (drain={:.0f}% crash={:.0f}% "
+            "idle={:.0f}%)  tokens={} capacity_util={}".format(
+                (gp.get("availability") or 0.0) * 100,
+                (fr.get("draining") or 0.0) * 100,
+                (fr.get("crashed_recovering") or 0.0) * 100,
+                (fr.get("starved_idle") or 0.0) * 100,
+                _num(gp.get("tokens_served"), "{:,.0f}"),
+                _num(gp.get("capacity_utilization"), "{:.2f}")))
+    for inc in ((doc.get("incidents") or {}).get("incidents") or [])[:2]:
+        lines.append("incident {} {:.0f}s: {}".format(
+            inc.get("id", "?"), inc.get("duration_s") or 0.0,
+            inc.get("summary", "")))
+    return lines
+
+
 def render_table(doc: dict, base_url: str = "") -> str:
     """The poll document as fixed-width text (one refresh)."""
     an = doc.get("anomalies") or {}
@@ -326,6 +374,7 @@ def render_table(doc: dict, base_url: str = "") -> str:
     lines.extend(render_serving_pane(doc))
     lines.extend(render_compute_pane(doc))
     lines.extend(render_fleet_pane(doc))
+    lines.extend(render_goodput_pane(doc))
     lines.extend(render_traces_pane(doc))
     return "\n".join(lines)
 

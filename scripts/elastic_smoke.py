@@ -22,7 +22,11 @@ walks the whole elastic lifecycle:
      an uninterrupted single-process oracle within float tolerance;
   5. /metrics shows dmlc_elastic_resizes_total >= 2 (the shrink and the
      grow), the death counter, and /healthz reports the final
-     generation and world size.
+     generation and world size;
+  6. /goodput shows the job-level wall-clock decomposition: per-rank
+     and cluster buckets sum to wall within 2%, the resize and
+     checkpoint_restore badput buckets are nonzero (the episode was
+     attributed, not lost), and unattributed stays under 10%.
 
 Exit 0 on success, 1 with a diagnostic on any failure.
 """
@@ -107,11 +111,15 @@ def oracle_trajectory(X, y):
 def worker_main() -> None:
     import numpy as np
 
+    from dmlc_tpu import telemetry
     from dmlc_tpu.checkpoint import CheckpointManager
     from dmlc_tpu.io import input_split
     from dmlc_tpu.resilience import fault_point
     from dmlc_tpu.telemetry import HeartbeatSender
+    from dmlc_tpu.telemetry import goodput as goodput_ledger
     from dmlc_tpu.tracker.client import TrackerClient, WorldResized
+
+    goodput_ledger.ledger()  # opt into the goodput heartbeat sub-doc
 
     uri = os.environ["ELASTIC_SMOKE_DATA"]
     log_path = os.environ["ELASTIC_SMOKE_LOG"]
@@ -158,9 +166,15 @@ def worker_main() -> None:
                 need_sync = False
             c.check_resized()
             fault_point("elastic.step", rank=c.rank, step=step + 1)
+            telemetry.step_begin()
             tot = c.allreduce_sum(grad_and_loss(X, y, w))
         except WorldResized:
+            # WorldResized -> generation settled is `resize` badput; the
+            # resync that follows (checkpoint restore, broadcast) keeps
+            # its own attribution (checkpoint.restore span etc.)
+            prev = goodput_ledger.enter("resize")
             c.resize()
+            goodput_ledger.enter(prev)
             need_sync = True
             continue
         w = w - LR * tot[:N_FEATURES] / tot[N_FEATURES]
@@ -170,7 +184,8 @@ def worker_main() -> None:
             manager.save(step, {"w": w})
             with open(log_path, "a") as f:
                 f.write(f"{step} {loss:.12e}\n")
-        time.sleep(PACE_S)
+        time.sleep(PACE_S)  # inside the step window: paced, not badput
+        telemetry.step_end(tokens=N_FEATURES * len(y))
     if c.rank == 0:
         np.save(os.environ["ELASTIC_SMOKE_WOUT"], w)
     with open(os.environ["ELASTIC_SMOKE_DONE"] + f".{os.getpid()}",
@@ -309,6 +324,9 @@ def main() -> None:
         body = urllib.request.urlopen(
             f"http://127.0.0.1:{tracker.metrics_port}/metrics",
             timeout=10).read().decode()
+        goodput = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{tracker.metrics_port}/goodput",
+            timeout=10).read())
 
         # --- loss-trajectory parity with the uninterrupted oracle -----
         losses = _log_steps(log_path)
@@ -340,6 +358,38 @@ def main() -> None:
             fail(f"/metrics {name} = {got} (< {want}); payload:\n"
                  f"{body[:3000]}")
         print(f"elastic smoke: {name} = {got:g} OK", flush=True)
+
+    # --- goodput decomposition: every second of badput has a name -----
+    if "dmlc_goodput_cluster_fraction" not in body:
+        fail("/metrics is missing the dmlc_goodput_* families")
+    cluster = goodput.get("cluster", {})
+    per_rank = goodput.get("per_rank", {})
+    if not per_rank:
+        fail(f"/goodput reported no ranks: {goodput}")
+    for rank, doc in per_rank.items():
+        part, wall = sum(doc["buckets"].values()), doc["wall_s"]
+        if wall <= 0 or abs(part - wall) > 0.02 * wall:
+            fail(f"rank {rank} goodput decomposition does not sum to "
+                 f"wall: {part:.3f}s vs {wall:.3f}s")
+    part, wall = sum(cluster["buckets"].values()), cluster["wall_s"]
+    if abs(part - wall) > 0.02 * wall:
+        fail(f"cluster goodput decomposition does not sum to wall: "
+             f"{part:.3f}s vs {wall:.3f}s")
+    for bucket in ("productive", "resize", "checkpoint_restore"):
+        if cluster["buckets"].get(bucket, 0.0) <= 0.0:
+            fail(f"cluster goodput bucket {bucket} is zero — the "
+                 f"shrink/grow episode was not attributed: "
+                 f"{cluster['buckets']}")
+    unattributed = cluster["buckets"].get("unattributed", 0.0)
+    if unattributed > 0.10 * wall:
+        fail(f"unattributed badput {unattributed:.3f}s exceeds 10% of "
+             f"wall {wall:.3f}s: {cluster['buckets']}")
+    print(f"elastic smoke: goodput fraction "
+          f"{cluster['goodput_fraction']:.2f}, resize "
+          f"{cluster['buckets']['resize']:.2f}s, checkpoint_restore "
+          f"{cluster['buckets']['checkpoint_restore']:.3f}s, "
+          f"unattributed {unattributed:.3f}s / {wall:.2f}s wall OK",
+          flush=True)
     print("elastic smoke OK")
 
 

@@ -70,6 +70,17 @@ def fmt_bench_lines(bench, coll):
                    "T=8192 (flash kernels, no T×T materialization, "
                    "save_flash remat policy)")
         lines.append(lm + ".")
+    if x.get("goodput_fraction") is not None:
+        bad = [(k[len("goodput_badput_"):-2], v)
+               for k, v in sorted(x.items())
+               if k.startswith("goodput_badput_") and k.endswith("_s")]
+        gp = (f"- Job-level goodput ledger over the benched train loop: "
+              f"**{x['goodput_fraction'] * 100:.0f}% of wall-clock "
+              f"productive**")
+        if bad:
+            gp += (" — badput named per bucket: "
+                   + ", ".join(f"{k} {v:.2f}s" for k, v in bad))
+        lines.append(gp + ".")
     if "recordio_feed_padded_MBps" in x:
         feed = (f"- RecordIO→HBM feed: padded "
                 f"{x['recordio_feed_padded_MBps']:.1f} MB/s, packed "

@@ -1,0 +1,508 @@
+"""Goodput/badput ledger + incident forensics (ISSUE 20): the wall-clock
+partition invariant, span/override attribution priority, tracker-side
+aggregation across elastic renumbering, the serving availability twin,
+and the incident builder joining badput intervals with decision chains."""
+
+import time
+
+import pytest
+
+from dmlc_tpu import telemetry
+from dmlc_tpu.telemetry import (
+    AvailabilityLedger,
+    GoodputAggregator,
+    GoodputLedger,
+    StepLedger,
+    Watchdog,
+    exporters,
+)
+from dmlc_tpu.telemetry.forensics import (
+    IncidentReporter,
+    build_incidents,
+    watchdog_anomaly_records,
+)
+from dmlc_tpu.telemetry.goodput import BADPUT_BUCKETS, BUCKETS
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    telemetry.reset()
+    telemetry.reset_steps()
+    telemetry.reset_goodput()
+    yield
+    telemetry.reset()
+    telemetry.reset_steps()
+    telemetry.reset_goodput()
+
+
+def _assert_partition(doc):
+    """The tentpole invariant: every instant in exactly one bucket."""
+    assert set(doc["buckets"]) <= set(BUCKETS)
+    assert sum(doc["buckets"].values()) == pytest.approx(
+        doc["wall_s"], abs=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# GoodputLedger: the partition invariant + attribution priority
+# ---------------------------------------------------------------------------
+
+def test_partition_sums_to_wall_with_mixed_evidence():
+    led = GoodputLedger()
+    with telemetry.span("step", stage="step"):
+        time.sleep(0.02)
+        with telemetry.span("checkpoint.save", stage="checkpoint"):
+            time.sleep(0.02)
+    prev = led.enter("resize")
+    assert prev is None
+    time.sleep(0.02)
+    led.enter(prev)
+    led.on_step(tokens=1000, step_s=0.04)
+    doc = led.status()
+    _assert_partition(doc)
+    # specific badput carved out of the step's productive window
+    assert doc["buckets"]["checkpoint_save"] >= 0.015
+    assert doc["buckets"]["productive"] >= 0.015
+    assert doc["buckets"]["resize"] >= 0.015
+    # pre-ledger process time classifies as startup, not unattributed
+    assert doc["buckets"].get("startup", 0.0) > 0.0
+    assert doc["goodput_fraction"] == pytest.approx(
+        doc["buckets"]["productive"] / doc["wall_s"], rel=1e-6)
+    assert doc["tokens"] == 1000
+    assert doc["effective_tokens_per_s"] == pytest.approx(
+        1000 / doc["wall_s"], rel=1e-6)
+
+
+def test_partition_holds_at_every_call_and_buckets_are_monotone():
+    led = GoodputLedger()
+    led.on_step(tokens=1, step_s=0.001)  # pin the startup boundary
+    prior = {}
+    for i in range(4):
+        if i == 1:
+            with telemetry.span("feed.wait", stage="feed"):
+                time.sleep(0.01)
+        if i == 2:
+            led.enter("rollback_replay")
+            time.sleep(0.01)
+            led.enter(None)
+        time.sleep(0.005)
+        doc = led.status()
+        _assert_partition(doc)
+        for b, s in prior.items():
+            assert doc["buckets"].get(b, 0.0) >= s - 1e-6, b
+        prior = dict(doc["buckets"])
+    assert prior["feed_stall"] >= 0.008
+    assert prior["rollback_replay"] >= 0.008
+
+
+def test_open_span_is_not_double_counted_across_samples():
+    """A span still open at a sample must classify provisionally and
+    then settle once — total stays a partition throughout."""
+    led = GoodputLedger()
+    with telemetry.span("checkpoint.restore", stage="checkpoint"):
+        time.sleep(0.02)
+        mid = led.status()          # span open: provisional tail
+        _assert_partition(mid)
+        assert mid["buckets"].get("checkpoint_restore", 0.0) >= 0.015
+        assert mid["current"] == "checkpoint_restore"
+        time.sleep(0.02)
+    done = led.status()
+    _assert_partition(done)
+    assert done["buckets"]["checkpoint_restore"] >= 0.035
+    assert done["buckets"]["checkpoint_restore"] < mid["wall_s"] + 0.1
+
+
+def test_resize_mid_feed_wait_attributes_both(monkeypatch):
+    """Regression (satellite 2): a WorldResized landing while blocked in
+    feed.wait must attribute the recovery to ``resize`` and the
+    surrounding wait to ``feed_stall`` — nothing leaks to unattributed."""
+    led = GoodputLedger()
+    led.on_step(tokens=1, step_s=0.001)
+    with telemetry.span("feed.wait", stage="feed"):
+        time.sleep(0.02)
+        # the example's except WorldResized: path
+        prev = led.enter("resize")
+        time.sleep(0.02)
+        led.enter(prev)  # resync done: re-enter the pre-resize interval
+        time.sleep(0.02)
+    doc = led.status()
+    _assert_partition(doc)
+    assert doc["buckets"]["resize"] >= 0.015
+    assert doc["buckets"]["feed_stall"] >= 0.03
+    assert doc["buckets"].get("unattributed", 0.0) < 0.01
+
+
+def test_enter_restore_chain_preserves_rollback_override():
+    """enter() returns the previous override so a resize landing inside
+    rollback_replay restores it instead of clearing it."""
+    led = GoodputLedger()
+    led.enter("rollback_replay")
+    time.sleep(0.01)
+    prev = led.enter("resize")
+    assert prev == "rollback_replay"
+    time.sleep(0.01)
+    led.enter(prev)
+    time.sleep(0.01)
+    led.enter(None)
+    doc = led.status()
+    _assert_partition(doc)
+    assert doc["buckets"]["rollback_replay"] >= 0.015
+    assert doc["buckets"]["resize"] >= 0.008
+
+
+def test_enter_rejects_unknown_bucket():
+    with pytest.raises(ValueError):
+        GoodputLedger().enter("coffee_break")
+
+
+def test_badput_intervals_recorded_for_forensics():
+    led = GoodputLedger(max_intervals=8)
+    led.enter("resize")
+    time.sleep(0.02)
+    led.enter(None)
+    with telemetry.span("checkpoint.save", stage="checkpoint"):
+        time.sleep(0.015)
+    doc = led.status()
+    ivs = doc["intervals"]
+    assert [iv["bucket"] for iv in ivs] == ["resize", "checkpoint_save"]
+    now = time.time()
+    for iv in ivs:
+        assert iv["t1"] > iv["t0"]
+        assert iv["dur_s"] == pytest.approx(iv["t1"] - iv["t0"], abs=1e-6)
+        assert abs(iv["t1"] - now) < 60  # epoch-stamped, not monotonic
+    assert ivs[0]["seq"] < ivs[1]["seq"]
+
+
+def test_window_doc_tracks_recent_rate():
+    led = GoodputLedger(window_s=0.05)
+    led.on_step(tokens=100, step_s=0.01)
+    time.sleep(0.06)
+    led.on_step(tokens=900, step_s=0.01)
+    doc = led.status()
+    win = doc["window"]
+    assert win["wall_s"] <= 0.2
+    assert win["tokens"] == pytest.approx(900)
+    assert win["effective_tokens_per_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# step ledger coupling: checkpoint stall family + goodput feed
+# ---------------------------------------------------------------------------
+
+def test_step_record_carves_checkpoint_stall():
+    led = StepLedger()
+    led.step_begin()
+    with telemetry.span("checkpoint.save", stage="checkpoint"):
+        time.sleep(0.02)
+    time.sleep(0.01)
+    rec = led.step_end(tokens=10)
+    assert rec["checkpoint_stall_s"] >= 0.015
+    total = (rec["feed_wait_s"] + rec["checkpoint_stall_s"]
+             + rec["collective_s"] + rec["compute_s"])
+    assert total == pytest.approx(rec["wall_s"], rel=1e-6)
+    assert led.summary()["checkpoint_stall_fraction"] > 0.0
+
+
+def test_step_end_feeds_goodput_ledger_when_opted_in():
+    from dmlc_tpu.telemetry import goodput as goodput_mod
+
+    goodput_mod.ledger()  # opt in
+    led = StepLedger()
+    led.step_begin()
+    time.sleep(0.01)
+    led.step_end(tokens=123)
+    doc = goodput_mod.status()
+    assert doc is not None
+    assert doc["tokens"] == pytest.approx(123)
+    assert doc["steps"] == 1
+    _assert_partition(doc)
+
+
+def test_goodput_status_is_none_without_opt_in():
+    from dmlc_tpu.telemetry import goodput as goodput_mod
+
+    led = StepLedger()
+    led.step_begin()
+    led.step_end(tokens=5)  # module-level on_step must not create one
+    assert goodput_mod.status() is None
+
+
+# ---------------------------------------------------------------------------
+# GoodputAggregator: ingest, death gaps, elastic renumbering
+# ---------------------------------------------------------------------------
+
+def _doc(anchor=100.0, wall=10.0, productive=6.0, tokens=600.0, seqs=()):
+    buckets = {b: 0.0 for b in BUCKETS}
+    buckets["productive"] = productive
+    buckets["startup"] = wall - productive
+    return {
+        "t": time.time(), "anchor": anchor, "wall_s": wall,
+        "buckets": buckets, "goodput_fraction": productive / wall,
+        "tokens": tokens, "steps": 3, "in_step_s": productive,
+        "effective_tokens_per_s": tokens / wall,
+        "in_step_tokens_per_s": tokens / productive,
+        "window": {"wall_s": wall, "tokens": tokens,
+                   "effective_tokens_per_s": tokens / wall,
+                   "in_step_tokens_per_s": tokens / productive},
+        "current": "productive",
+        "intervals": [{"seq": s, "bucket": "resize",
+                       "t0": 50.0 + s, "t1": 51.0 + s, "dur_s": 1.0}
+                      for s in seqs],
+    }
+
+
+def test_aggregator_report_and_fractions():
+    agg = GoodputAggregator()
+    agg.ingest(0, _doc(wall=10.0, productive=6.0))
+    agg.ingest(1, _doc(wall=10.0, productive=8.0))
+    rep = agg.report()
+    assert rep["ranks"] == 2
+    cl = rep["cluster"]
+    assert cl["wall_s"] == pytest.approx(20.0)
+    assert cl["goodput_fraction"] == pytest.approx(0.7)
+    assert sum(cl["fractions"].values()) == pytest.approx(1.0)
+    assert cl["effective_tokens_per_s"] == pytest.approx(
+        cl["tokens"] / cl["wall_s"])
+
+
+def test_aggregator_dead_rank_accrues_preempted_until_relaunch():
+    agg = GoodputAggregator()
+    agg.ingest(0, _doc(anchor=100.0))
+    agg.mark_dead(0)
+    time.sleep(0.05)
+    rep = agg.report()
+    assert rep["per_rank"]["0"]["buckets"]["preempted"] >= 0.04
+    # relaunch under the same rank (new anchor) closes the gap
+    agg.ingest(0, _doc(anchor=222.0))
+    gap1 = agg.report()["per_rank"]["0"]["buckets"]["preempted"]
+    assert gap1 >= 0.04
+    time.sleep(0.02)
+    gap2 = agg.report()["per_rank"]["0"]["buckets"]["preempted"]
+    assert gap2 == pytest.approx(gap1, abs=0.01)  # stopped accruing
+
+
+def test_aggregator_remap_ranks_moves_survivor_and_drops_dead():
+    # mirrors tests/test_flight_recorder.py: rank 1 dies, rank 2
+    # survives as the new rank 1 — cumulative seconds and the interval
+    # dedup high-water follow the surviving process.
+    agg = GoodputAggregator()
+    for r in (0, 1, 2):
+        agg.ingest(r, _doc(anchor=100.0 + r, wall=10.0 + r,
+                           productive=5.0 + r, seqs=(1,)))
+    agg.remap_ranks({0: 0, 2: 1})
+    rep = agg.report()
+    assert sorted(rep["per_rank"]) == ["0", "1"]
+    assert rep["per_rank"]["0"]["wall_s"] == pytest.approx(10.0)
+    # survivor's data moved intact under its new number
+    assert rep["per_rank"]["1"]["wall_s"] == pytest.approx(12.0)
+    assert rep["per_rank"]["1"]["buckets"]["productive"] == pytest.approx(7.0)
+    # re-shipping the survivor's already-seen interval under the NEW
+    # rank dedups by seq instead of duplicating the episode
+    agg.ingest(1, _doc(anchor=102.0, wall=12.5, productive=7.2,
+                       seqs=(1, 2)))
+    ivs = [iv for iv in agg.badput_intervals() if iv["rank"] == 1]
+    assert sorted(iv["seq"] for iv in ivs) == [1, 2]
+    # one fresh beat after the remap restores truth (self-correcting)
+    assert agg.report()["per_rank"]["1"]["wall_s"] == pytest.approx(12.5)
+
+
+def test_aggregator_badput_intervals_are_rank_tagged_and_ordered():
+    agg = GoodputAggregator()
+    agg.ingest(0, _doc(seqs=(2,)))
+    agg.ingest(1, _doc(seqs=(1,)))
+    ivs = agg.badput_intervals()
+    assert [iv["rank"] for iv in ivs] == [1, 0]  # wall-ordered by t0
+    assert all(iv["bucket"] == "resize" for iv in ivs)
+
+
+def test_aggregator_prometheus_text_validates():
+    agg = GoodputAggregator()
+    agg.ingest(0, _doc())
+    agg.ingest(1, _doc(wall=20.0, productive=4.0))
+    text = agg.prometheus_text()
+    exporters.validate_exposition_text(text)
+    assert 'dmlc_goodput_bucket_seconds{rank="0",bucket="productive"}' in text
+    assert "dmlc_goodput_cluster_fraction" in text
+    assert 'dmlc_goodput_fraction{rank="1"} 0.2' in text
+
+
+def test_aggregator_ignores_garbage():
+    agg = GoodputAggregator()
+    agg.ingest(0, None)
+    agg.ingest(0, {"no": "buckets"})
+    garbage = _doc()
+    garbage["intervals"] = [{"seq": "NaN"}, {"bucket": "resize"}, "nope"]
+    agg.ingest(0, garbage)
+    assert agg.report()["ranks"] == 1
+    assert agg.badput_intervals() == []
+
+
+# ---------------------------------------------------------------------------
+# AvailabilityLedger: the serving twin
+# ---------------------------------------------------------------------------
+
+def test_availability_fractions_sum_to_one():
+    led = AvailabilityLedger()
+    time.sleep(0.02)
+    led.set_state("draining")
+    time.sleep(0.02)
+    led.set_state("serving")
+    time.sleep(0.01)
+    rep = led.report()
+    assert sum(rep["fractions"].values()) == pytest.approx(1.0)
+    assert sum(rep["states"].values()) == pytest.approx(
+        rep["wall_s"], abs=1e-6)
+    assert rep["states"]["draining"] >= 0.015
+    assert rep["state"] == "serving"
+    assert 0.0 < rep["availability"] < 1.0
+
+
+def test_availability_tracks_capacity_tokens():
+    led = AvailabilityLedger()
+    led.note_tokens(100)
+    time.sleep(0.6)
+    led.note_tokens(300)
+    rep = led.report()
+    assert rep["tokens_served"] == pytest.approx(400)
+    assert rep["capacity_tokens_per_s"] > 0
+    assert rep["capacity_tokens"] >= rep["tokens_served"] * 0.5
+    exporters.validate_exposition_text(led.prometheus_text())
+
+
+def test_availability_rejects_unknown_state():
+    with pytest.raises(ValueError):
+        AvailabilityLedger().set_state("on_fire")
+
+
+# ---------------------------------------------------------------------------
+# Watchdog: effective-goodput-collapse anomaly
+# ---------------------------------------------------------------------------
+
+def _goodput_subdoc(eff, in_step):
+    return {"goodput_fraction": 0.5, "effective_tokens_per_s": eff,
+            "in_step_tokens_per_s": in_step, "current": "feed_stall",
+            "window": {"wall_s": 30.0, "tokens": eff * 30.0,
+                       "effective_tokens_per_s": eff,
+                       "in_step_tokens_per_s": in_step}}
+
+
+def test_watchdog_flags_effective_goodput_collapse():
+    wd = Watchdog()
+    before = telemetry.snapshot()["counters"].get(
+        "anomaly", {}).get("effective_goodput_collapse_flags", 0)
+    wd.ingest_goodput(0, _goodput_subdoc(eff=10.0, in_step=100.0))
+    rep = wd.report()
+    assert "effective_goodput_collapse" in rep["ranks"]["0"]["flags"]
+    assert rep["ranks"]["0"]["goodput"]["effective_tokens_per_s"] == 10.0
+    assert telemetry.snapshot()["counters"]["anomaly"][
+        "effective_goodput_collapse_flags"] == before + 1
+    text = wd.prometheus_text()
+    exporters.validate_exposition_text(text)
+    assert 'kind="effective_goodput_collapse"' in text
+    # recovery above the threshold clears the flag (direct-apply)
+    wd.ingest_goodput(0, _goodput_subdoc(eff=90.0, in_step=100.0))
+    assert wd.report()["ranks"]["0"]["flags"] == []
+
+
+def test_watchdog_goodput_threshold_env(monkeypatch):
+    monkeypatch.setenv("DMLC_GOODPUT_MIN_FRACTION", "0.05")
+    wd = Watchdog()
+    wd.ingest_goodput(0, _goodput_subdoc(eff=10.0, in_step=100.0))
+    assert wd.report()["ranks"]["0"]["flags"] == []
+
+
+def test_watchdog_routes_goodput_from_heartbeat_json():
+    import json
+
+    wd = Watchdog()
+    wd.ingest_json(0, json.dumps(
+        {"goodput": _goodput_subdoc(eff=1.0, in_step=100.0)}))
+    assert "effective_goodput_collapse" in wd.report()["ranks"]["0"]["flags"]
+
+
+# ---------------------------------------------------------------------------
+# forensics: incidents from intervals + decision chains
+# ---------------------------------------------------------------------------
+
+def test_build_incidents_joins_intervals_and_decisions():
+    t = 1000.0
+    incidents = build_incidents(
+        intervals=[{"bucket": "resize", "t0": t, "t1": t + 3.0,
+                    "dur_s": 3.0, "rank": 2}],
+        decisions=[{"kind": "preempt_kill_rank", "t": t + 1.0, "seq": 7},
+                   {"kind": "unrelated", "t": t + 500.0, "seq": 8}],
+        events=[{"kind": "world_resized", "t": t + 2.0, "seq": 3}],
+        anomalies=[{"kind": "straggler", "rank": 2, "t": t + 1.5}],
+    )
+    assert len(incidents) == 1
+    inc = incidents[0]
+    assert inc["kinds"] == ["preempt_kill_rank", "resize"]
+    assert inc["ranks"] == [2]
+    assert inc["badput_s"] == pytest.approx(3.0)
+    assert inc["decision_kinds"] == ["preempt_kill_rank"]
+    assert [r["what"] for r in inc["timeline"]] == ["decision", "event"]
+    assert inc["anomalies"] == [{"kind": "straggler", "rank": 2}]
+    assert "badput" in inc["summary"]
+
+
+def test_build_incidents_merges_decision_chain_into_one_episode():
+    t = 2000.0
+    chain = ["autoscale_verdict", "preempt_acquire", "preempt_kill_rank",
+             "preempt_resize", "preempt_replica_added", "scale_up"]
+    decisions = [{"kind": k, "t": t + i, "seq": i}
+                 for i, k in enumerate(chain)]
+    incidents = build_incidents(decisions=decisions)
+    assert len(incidents) == 1
+    assert incidents[0]["decision_kinds"] == chain
+
+
+def test_build_incidents_bridges_open_chains_past_gap():
+    """A chain kind awaiting its causal successor holds the incident
+    open past gap_s (replica gang-launch between preempt_resize and
+    preempt_replica_added can take tens of seconds) — but two terminal
+    decisions the same distance apart stay separate incidents."""
+    t = 3000.0
+    incidents = build_incidents(decisions=[
+        {"kind": "preempt_resize", "t": t, "seq": 1},
+        {"kind": "preempt_replica_added", "t": t + 30.0, "seq": 2},
+        {"kind": "scale_up", "t": t + 31.0, "seq": 3}])
+    assert len(incidents) == 1
+    assert incidents[0]["decision_kinds"] == [
+        "preempt_resize", "preempt_replica_added", "scale_up"]
+    incidents = build_incidents(decisions=[
+        {"kind": "scale_up", "t": t, "seq": 1},
+        {"kind": "scale_down", "t": t + 30.0, "seq": 2}])
+    assert len(incidents) == 2
+
+
+def test_build_incidents_separates_distant_episodes_newest_first():
+    incidents = build_incidents(
+        intervals=[{"bucket": "resize", "t0": 100.0, "t1": 101.0,
+                    "dur_s": 1.0},
+                   {"bucket": "preempted", "t0": 500.0, "t1": 502.0,
+                    "dur_s": 2.0}])
+    assert len(incidents) == 2
+    assert incidents[0]["kinds"] == ["preempted"]   # newest first
+    assert incidents[1]["kinds"] == ["resize"]
+
+
+def test_incident_reporter_survives_failing_sources():
+    rep = IncidentReporter(
+        intervals_source=lambda: (_ for _ in ()).throw(RuntimeError()),
+        decisions_source=lambda: [{"kind": "scale_up", "t": 10.0,
+                                   "seq": 1}])
+    doc = rep.report()
+    assert doc["count"] == 1
+    assert doc["incidents"][0]["decision_kinds"] == ["scale_up"]
+
+
+def test_watchdog_anomaly_records_flatten():
+    recs = watchdog_anomaly_records(
+        {"active": [{"rank": 3, "kind": "straggler", "since": 42.0}]})
+    assert recs == [{"kind": "straggler", "rank": 3, "t": 42.0}]
+    assert watchdog_anomaly_records({}) == []
+    assert watchdog_anomaly_records(None) == []
+
+
+def test_badput_buckets_exclude_productive():
+    assert "productive" not in BADPUT_BUCKETS
+    assert set(BADPUT_BUCKETS) | {"productive"} == set(BUCKETS)

@@ -643,9 +643,12 @@ def test_http_tenant_gate_and_fleet_endpoint():
         by_name = {v["tenant"]: v for v in gov.views()}
         assert by_name["paid"]["tokens_generated"] == 9
         assert by_name["free"]["tokens_generated"] == 3
-        # /fleet renders the fleet_source report
+        # /fleet renders the fleet_source report, augmented with the
+        # per-replica availability shipped on health polls (None here:
+        # FakeReplica's /healthz carries no availability ledger)
         fl = json.loads(urllib.request.urlopen(
             srv.url + "/fleet", timeout=5).read())
+        assert fl.pop("replica_availability") == {a.url: None}
         assert fl == {"replicas": 2, "owned": [], "saturated": False}
         # /metrics concatenates router + tenant + fleet families
         from dmlc_tpu.telemetry.exporters import validate_exposition_text
